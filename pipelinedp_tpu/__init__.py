@@ -1,0 +1,36 @@
+"""pipelinedp_tpu — a TPU-native differential-privacy aggregation framework.
+
+Same capability surface as the reference PipelineDP
+(``/root/reference/pipeline_dp/__init__.py:14-36``): DP count /
+privacy-id count / sum / mean / variance / percentiles / vector sum over
+keyed data, with contribution bounding, private partition selection and
+two-phase budget accounting — but the data plane is JAX/XLA: integer-encoded
+records in HBM, segment reductions over all partition keys, batched noise and
+batched partition selection in one fused compiled program, sharded over a
+`jax.sharding.Mesh` for multi-chip scale.
+"""
+
+from pipelinedp_tpu.aggregate_params import (
+    AggregateParams,
+    CountParams,
+    MeanParams,
+    MechanismType,
+    Metric,
+    Metrics,
+    NoiseKind,
+    NormKind,
+    PartitionSelectionStrategy,
+    PrivacyIdCountParams,
+    SelectPartitionsParams,
+    SumParams,
+    VarianceParams,
+)
+from pipelinedp_tpu.budget_accounting import (
+    Budget,
+    BudgetAccountant,
+    MechanismSpec,
+    NaiveBudgetAccountant,
+    PLDBudgetAccountant,
+)
+
+__version__ = "0.1.0"

@@ -1,0 +1,219 @@
+"""Validation-matrix tests for aggregate_params.
+
+Modeled on the reference's test strategy (tests/aggregate_params_test.py:22 —
+parameterized unit tests of __post_init__ validation)."""
+
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu.aggregate_params import parameters_to_readable_string
+
+
+def _valid_count_kwargs(**overrides):
+    kw = dict(metrics=[pdp.Metrics.COUNT],
+              noise_kind=pdp.NoiseKind.LAPLACE,
+              max_partitions_contributed=2,
+              max_contributions_per_partition=3)
+    kw.update(overrides)
+    return kw
+
+
+class TestAggregateParamsValidation:
+
+    def test_valid_count(self):
+        pdp.AggregateParams(**_valid_count_kwargs())
+
+    def test_valid_sum_with_value_bounds(self):
+        pdp.AggregateParams(metrics=[pdp.Metrics.SUM],
+                            max_partitions_contributed=1,
+                            max_contributions_per_partition=1,
+                            min_value=-1.0,
+                            max_value=5.0)
+
+    def test_valid_sum_with_partition_sum_bounds(self):
+        # per-partition sum bounds do not require a linf bound for SUM
+        pdp.AggregateParams(metrics=[pdp.Metrics.SUM],
+                            max_partitions_contributed=1,
+                            min_sum_per_partition=0.0,
+                            max_sum_per_partition=10.0)
+
+    @pytest.mark.parametrize("field,value", [
+        ("max_partitions_contributed", 0),
+        ("max_partitions_contributed", -1),
+        ("max_partitions_contributed", 1.5),
+        ("max_contributions_per_partition", 0),
+        ("max_contributions_per_partition", -3),
+    ])
+    def test_invalid_contribution_bounds(self, field, value):
+        with pytest.raises(ValueError):
+            pdp.AggregateParams(**_valid_count_kwargs(**{field: value}))
+
+    def test_max_contributions_exclusive_with_pair(self):
+        with pytest.raises(ValueError, match="not both"):
+            pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                max_contributions=5,
+                                max_partitions_contributed=2)
+
+    def test_max_contributions_alone_ok(self):
+        pdp.AggregateParams(metrics=[pdp.Metrics.COUNT], max_contributions=5)
+
+    def test_sum_requires_value_bounds(self):
+        with pytest.raises(ValueError, match="bounds"):
+            pdp.AggregateParams(metrics=[pdp.Metrics.SUM],
+                                max_partitions_contributed=1,
+                                max_contributions_per_partition=1)
+
+    def test_min_above_max_rejected(self):
+        with pytest.raises(ValueError):
+            pdp.AggregateParams(metrics=[pdp.Metrics.SUM],
+                                max_partitions_contributed=1,
+                                max_contributions_per_partition=1,
+                                min_value=2.0,
+                                max_value=1.0)
+
+    def test_value_bounds_must_come_in_pairs(self):
+        with pytest.raises(ValueError, match="together"):
+            pdp.AggregateParams(metrics=[pdp.Metrics.SUM],
+                                max_partitions_contributed=1,
+                                max_contributions_per_partition=1,
+                                min_value=0.0)
+
+    def test_both_bound_kinds_rejected(self):
+        with pytest.raises(ValueError, match="not both"):
+            pdp.AggregateParams(metrics=[pdp.Metrics.SUM],
+                                max_partitions_contributed=1,
+                                max_contributions_per_partition=1,
+                                min_value=0.0,
+                                max_value=1.0,
+                                min_sum_per_partition=0.0,
+                                max_sum_per_partition=1.0)
+
+    def test_partition_sum_bounds_reject_mean(self):
+        with pytest.raises(ValueError):
+            pdp.AggregateParams(metrics=[pdp.Metrics.MEAN],
+                                max_partitions_contributed=1,
+                                max_contributions_per_partition=1,
+                                min_sum_per_partition=0.0,
+                                max_sum_per_partition=1.0)
+
+    def test_vector_sum_rejects_scalar_metrics(self):
+        with pytest.raises(ValueError, match="VECTOR_SUM"):
+            pdp.AggregateParams(
+                metrics=[pdp.Metrics.VECTOR_SUM, pdp.Metrics.COUNT],
+                max_partitions_contributed=1,
+                vector_size=4,
+                vector_max_norm=1.0)
+
+    def test_vector_sum_needs_size_and_norm(self):
+        with pytest.raises(ValueError):
+            pdp.AggregateParams(metrics=[pdp.Metrics.VECTOR_SUM],
+                                max_partitions_contributed=1)
+
+    def test_vector_sum_valid(self):
+        pdp.AggregateParams(metrics=[pdp.Metrics.VECTOR_SUM],
+                            max_partitions_contributed=1,
+                            vector_size=8,
+                            vector_max_norm=2.0,
+                            vector_norm_kind=pdp.NormKind.L2)
+
+    def test_privacy_id_count_with_bounds_already_enforced_rejected(self):
+        with pytest.raises(ValueError, match="PRIVACY_ID_COUNT"):
+            pdp.AggregateParams(metrics=[pdp.Metrics.PRIVACY_ID_COUNT],
+                                max_partitions_contributed=1,
+                                contribution_bounds_already_enforced=True)
+
+    def test_duplicate_metrics_rejected(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            pdp.AggregateParams(**_valid_count_kwargs(
+                metrics=[pdp.Metrics.COUNT, pdp.Metrics.COUNT]))
+
+    def test_budget_weight_positive(self):
+        with pytest.raises(ValueError, match="budget_weight"):
+            pdp.AggregateParams(**_valid_count_kwargs(budget_weight=0))
+
+    def test_pre_threshold_positive(self):
+        with pytest.raises(ValueError, match="pre_threshold"):
+            pdp.AggregateParams(**_valid_count_kwargs(pre_threshold=0))
+
+    def test_custom_combiners_exclusive_with_metrics(self):
+        with pytest.raises(ValueError, match="custom_combiners"):
+            pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                max_partitions_contributed=1,
+                                custom_combiners=[object()])
+
+    def test_percentiles(self):
+        p = pdp.AggregateParams(
+            metrics=[pdp.Metrics.PERCENTILE(50),
+                     pdp.Metrics.PERCENTILE(90)],
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1,
+            min_value=0.0,
+            max_value=1.0)
+        assert p.metrics[0].parameter == 50
+
+
+class TestMetric:
+
+    def test_equality_and_hash(self):
+        assert pdp.Metrics.COUNT == pdp.Metrics.COUNT
+        assert pdp.Metrics.PERCENTILE(10) == pdp.Metrics.PERCENTILE(10)
+        assert pdp.Metrics.PERCENTILE(10) != pdp.Metrics.PERCENTILE(20)
+        assert len({pdp.Metrics.COUNT, pdp.Metrics.COUNT}) == 1
+
+    def test_repr(self):
+        assert str(pdp.Metrics.PERCENTILE(90)) == "PERCENTILE(90)"
+        assert str(pdp.Metrics.SUM) == "SUM"
+
+
+class TestConvenienceParams:
+
+    def test_count_params_lowering(self):
+        cp = pdp.CountParams(noise_kind=pdp.NoiseKind.GAUSSIAN,
+                             max_partitions_contributed=4,
+                             max_contributions_per_partition=2)
+        ap = cp.to_aggregate_params()
+        assert ap.metrics == [pdp.Metrics.COUNT]
+        assert ap.noise_kind == pdp.NoiseKind.GAUSSIAN
+        assert ap.max_partitions_contributed == 4
+
+    def test_privacy_id_count_forces_linf_1(self):
+        ap = pdp.PrivacyIdCountParams(
+            max_partitions_contributed=3).to_aggregate_params()
+        assert ap.max_contributions_per_partition == 1
+
+    def test_sum_params_lowering(self):
+        ap = pdp.SumParams(max_partitions_contributed=1,
+                           max_contributions_per_partition=2,
+                           min_value=0.0,
+                           max_value=1.0).to_aggregate_params()
+        assert ap.metrics == [pdp.Metrics.SUM]
+        assert ap.max_value == 1.0
+
+    def test_mean_variance_params(self):
+        m = pdp.MeanParams(max_partitions_contributed=1,
+                           max_contributions_per_partition=1,
+                           min_value=0.0,
+                           max_value=1.0).to_aggregate_params()
+        v = pdp.VarianceParams(max_partitions_contributed=1,
+                               max_contributions_per_partition=1,
+                               min_value=0.0,
+                               max_value=1.0).to_aggregate_params()
+        assert m.metrics == [pdp.Metrics.MEAN]
+        assert v.metrics == [pdp.Metrics.VARIANCE]
+
+
+class TestSelectPartitionsParams:
+
+    def test_valid(self):
+        pdp.SelectPartitionsParams(max_partitions_contributed=2)
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            pdp.SelectPartitionsParams(max_partitions_contributed=0)
+
+
+def test_readable_string():
+    p = pdp.AggregateParams(**_valid_count_kwargs())
+    s = parameters_to_readable_string(p, is_public_partition=False)
+    assert "COUNT" in s
+    assert "private" in s

@@ -1,0 +1,134 @@
+"""Budget accounting tests (modeled on reference tests/budget_accounting_test.py:27)."""
+
+import pytest
+
+from pipelinedp_tpu.aggregate_params import MechanismType
+from pipelinedp_tpu.budget_accounting import (MechanismSpec,
+                                              NaiveBudgetAccountant)
+
+
+class TestMechanismSpec:
+
+    def test_raises_before_compute(self):
+        spec = MechanismSpec(MechanismType.LAPLACE)
+        with pytest.raises(AssertionError):
+            _ = spec.eps
+        with pytest.raises(AssertionError):
+            _ = spec.delta
+
+    def test_set_then_read(self):
+        spec = MechanismSpec(MechanismType.GAUSSIAN)
+        spec.set_eps_delta(0.5, 1e-6)
+        assert spec.eps == 0.5
+        assert spec.delta == 1e-6
+
+    def test_use_delta(self):
+        assert not MechanismSpec(MechanismType.LAPLACE).use_delta()
+        assert MechanismSpec(MechanismType.GAUSSIAN).use_delta()
+        assert MechanismSpec(MechanismType.GENERIC).use_delta()
+
+
+class TestNaiveBudgetAccountant:
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            NaiveBudgetAccountant(total_epsilon=0, total_delta=1e-7)
+        with pytest.raises(ValueError):
+            NaiveBudgetAccountant(total_epsilon=1, total_delta=-1e-7)
+        with pytest.raises(ValueError):
+            NaiveBudgetAccountant(total_epsilon=1, total_delta=1.0)
+
+    def test_single_mechanism_gets_everything(self):
+        acc = NaiveBudgetAccountant(total_epsilon=1.0, total_delta=1e-6)
+        spec = acc.request_budget(MechanismType.GAUSSIAN)
+        acc.compute_budgets()
+        assert spec.eps == 1.0
+        assert spec.delta == 1e-6
+
+    def test_equal_split(self):
+        acc = NaiveBudgetAccountant(total_epsilon=1.0, total_delta=1e-6)
+        s1 = acc.request_budget(MechanismType.LAPLACE)
+        s2 = acc.request_budget(MechanismType.LAPLACE)
+        acc.compute_budgets()
+        assert s1.eps == pytest.approx(0.5)
+        assert s2.eps == pytest.approx(0.5)
+
+    def test_delta_only_to_delta_users(self):
+        # Laplace gets eps share but no delta; Gaussian gets the whole delta
+        # (reference budget_accounting.py:384-395).
+        acc = NaiveBudgetAccountant(total_epsilon=1.0, total_delta=1e-6)
+        lap = acc.request_budget(MechanismType.LAPLACE)
+        gau = acc.request_budget(MechanismType.GAUSSIAN)
+        acc.compute_budgets()
+        assert lap.eps == pytest.approx(0.5)
+        assert lap.delta == 0
+        assert gau.eps == pytest.approx(0.5)
+        assert gau.delta == pytest.approx(1e-6)
+
+    def test_weighted_split(self):
+        acc = NaiveBudgetAccountant(total_epsilon=3.0, total_delta=0)
+        s1 = acc.request_budget(MechanismType.LAPLACE, weight=1)
+        s2 = acc.request_budget(MechanismType.LAPLACE, weight=2)
+        acc.compute_budgets()
+        assert s1.eps == pytest.approx(1.0)
+        assert s2.eps == pytest.approx(2.0)
+
+    def test_gaussian_requires_delta(self):
+        acc = NaiveBudgetAccountant(total_epsilon=1.0, total_delta=0)
+        with pytest.raises(AssertionError):
+            acc.request_budget(MechanismType.GAUSSIAN)
+
+    def test_request_after_compute_raises(self):
+        acc = NaiveBudgetAccountant(total_epsilon=1.0, total_delta=0)
+        acc.request_budget(MechanismType.LAPLACE)
+        acc.compute_budgets()
+        with pytest.raises(AssertionError):
+            acc.request_budget(MechanismType.LAPLACE)
+
+    def test_scope_normalises_weights(self):
+        # Two mechanisms inside a scope of weight 1 plus one outside with
+        # weight 1: the scope's two mechanisms together consume half.
+        acc = NaiveBudgetAccountant(total_epsilon=4.0, total_delta=0)
+        with acc.scope(weight=1):
+            s1 = acc.request_budget(MechanismType.LAPLACE)
+            s2 = acc.request_budget(MechanismType.LAPLACE)
+        with acc.scope(weight=1):
+            s3 = acc.request_budget(MechanismType.LAPLACE)
+        acc.compute_budgets()
+        assert s1.eps == pytest.approx(1.0)
+        assert s2.eps == pytest.approx(1.0)
+        assert s3.eps == pytest.approx(2.0)
+
+    def test_num_aggregations_contract_enforced(self):
+        acc = NaiveBudgetAccountant(total_epsilon=1.0, total_delta=0,
+                                    num_aggregations=2)
+        with acc.scope(weight=1):
+            acc.request_budget(MechanismType.LAPLACE)
+        with pytest.raises(ValueError, match="aggregations"):
+            acc.compute_budgets()
+
+    def test_aggregation_weights_contract(self):
+        acc = NaiveBudgetAccountant(total_epsilon=1.0, total_delta=0,
+                                    aggregation_weights=[1, 2])
+        with acc.scope(weight=1):
+            acc.request_budget(MechanismType.LAPLACE)
+        with acc.scope(weight=3):
+            acc.request_budget(MechanismType.LAPLACE)
+        with pytest.raises(ValueError, match="weight"):
+            acc.compute_budgets()
+
+    def test_num_aggregations_and_weights_exclusive(self):
+        with pytest.raises(ValueError):
+            NaiveBudgetAccountant(total_epsilon=1.0, total_delta=0,
+                                  num_aggregations=1,
+                                  aggregation_weights=[1])
+
+    def test_budget_for_aggregation_annotation(self):
+        acc = NaiveBudgetAccountant(total_epsilon=2.0, total_delta=2e-6)
+        with acc.scope(weight=1):
+            acc.request_budget(MechanismType.GAUSSIAN)
+        with acc.scope(weight=3):
+            acc.request_budget(MechanismType.GAUSSIAN)
+        budget = acc._compute_budget_for_aggregation(1)
+        assert budget.epsilon == pytest.approx(0.5)
+        assert budget.delta == pytest.approx(5e-7)
