@@ -237,14 +237,15 @@ class AggregateParams:
                 raise ValueError("max_partitions_contributed must be set")
             _check_positive_int(self.max_partitions_contributed,
                                 "max_partitions_contributed")
-            needs_linf = self._needs_linf_bound()
-            if needs_linf:
-                if self.max_contributions_per_partition is None:
-                    raise ValueError(
-                        "max_contributions_per_partition must be set for "
-                        f"metrics {self.metrics_str}")
+            if self.max_contributions_per_partition is not None:
+                # Validated whenever set, even if the metric does not need
+                # the linf bound (reference aggregate_params.py:266-269).
                 _check_positive_int(self.max_contributions_per_partition,
                                     "max_contributions_per_partition")
+            elif self._needs_linf_bound():
+                raise ValueError(
+                    "max_contributions_per_partition must be set for "
+                    f"metrics {self.metrics_str}")
 
     def _needs_linf_bound(self) -> bool:
         if not self.metrics:

@@ -195,6 +195,14 @@ class BudgetAccountant(abc.ABC):
             scope._mechanisms.append(mechanism)
         return mechanism
 
+    def _check_not_in_scope(self):
+        """compute_budgets inside an open scope would see un-normalised
+        weights (normalisation happens on scope exit) — the reference raises
+        here too (``budget_accounting.py:505-507``)."""
+        if self._scopes_stack:
+            raise Exception(
+                "Cannot call compute_budgets from within a budget scope.")
+
     def _check_aggregation_restrictions(self):
         """Verifies the declared pipeline shape (reference :203-235)."""
         weights = self._actual_aggregation_weights
@@ -274,6 +282,7 @@ class NaiveBudgetAccountant(BudgetAccountant):
         return spec
 
     def compute_budgets(self) -> None:
+        self._check_not_in_scope()
         self._check_aggregation_restrictions()
         self._finalized = True
         if not self._mechanisms:
@@ -318,9 +327,6 @@ class PLDBudgetAccountant(BudgetAccountant):
                  aggregation_weights: Optional[List[float]] = None):
         super().__init__(total_epsilon, total_delta, num_aggregations,
                          aggregation_weights)
-        if total_delta <= 0:
-            raise ValueError(
-                "PLDBudgetAccountant requires total_delta > 0")
         self._pld_discretization = pld_discretization
         self.minimum_noise_std: Optional[float] = None
 
@@ -335,6 +341,13 @@ class PLDBudgetAccountant(BudgetAccountant):
             raise NotImplementedError(
                 "count/noise_standard_deviation are not supported by "
                 "PLDBudgetAccountant yet.")
+        if mechanism_type == MechanismType.GAUSSIAN and (
+                self._total_delta == 0):
+            # A finite-sigma Gaussian always has delta > 0 — calibrating it
+            # under a pure-DP budget would be non-private (reference
+            # budget_accounting.py:460-463).
+            raise AssertionError(
+                "The Gaussian mechanism requires delta > 0")
         spec = MechanismSpec(mechanism_type)
         self._register_mechanism(
             MechanismSpecInternal(sensitivity=sensitivity,
@@ -343,31 +356,37 @@ class PLDBudgetAccountant(BudgetAccountant):
         return spec
 
     def compute_budgets(self) -> None:
+        self._check_not_in_scope()
         self._check_aggregation_restrictions()
         self._finalized = True
         if not self._mechanisms:
             logging.warning("No budgets were requested.")
             return
         from pipelinedp_tpu import pld as pld_lib
-        minimum_noise_std = pld_lib.find_minimum_noise_std(
-            mechanisms=[(m.mechanism_spec.mechanism_type, m.sensitivity,
-                         m.weight) for m in self._mechanisms],
-            total_epsilon=self._total_epsilon,
-            total_delta=self._total_delta,
-            discretization=self._pld_discretization)
+        if self._total_delta == 0:
+            # Pure-DP pipeline: only Laplace-style composition is possible;
+            # the reference uses the closed form sum(weights)/eps * sqrt(2)
+            # (``budget_accounting.py:509-514``).
+            sum_weights = sum(m.weight for m in self._mechanisms)
+            minimum_noise_std = (sum_weights / self._total_epsilon *
+                                 math.sqrt(2.0))
+        else:
+            minimum_noise_std = pld_lib.find_minimum_noise_std(
+                mechanisms=[(m.mechanism_spec.mechanism_type, m.sensitivity,
+                             m.weight) for m in self._mechanisms],
+                total_epsilon=self._total_epsilon,
+                total_delta=self._total_delta,
+                discretization=self._pld_discretization)
         self.minimum_noise_std = minimum_noise_std
         for m in self._mechanisms:
             # Weight semantics mirror the reference (:506-524): a mechanism
             # with a larger weight receives proportionally *less* noise.
             stddev = m.sensitivity * minimum_noise_std / m.weight
             spec = m.mechanism_spec
+            spec.set_noise_standard_deviation(stddev)
             if spec.mechanism_type == MechanismType.GENERIC:
-                # Generic mechanisms consume raw (eps, delta); the reference
-                # models them on the PLD side as eps0 = sqrt(2)/sigma and
-                # delta0 = eps0 * delta / (2 * eps)  (:586-596, :521-524).
-                eps0 = math.sqrt(2.0) / stddev
-                delta0 = (eps0 * self._total_delta /
-                          (2.0 * self._total_epsilon))
+                # Generic mechanisms consume raw (eps, delta), derived from
+                # the granted noise level by the shared conversion helper.
+                eps0, delta0 = pld_lib.generic_mechanism_eps_delta(
+                    stddev, self._total_epsilon, self._total_delta)
                 spec.set_eps_delta(eps0, delta0)
-            else:
-                spec.set_noise_standard_deviation(stddev)

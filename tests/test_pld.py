@@ -1,0 +1,199 @@
+"""Tests for the discretized PLD engine against closed-form ground truth.
+
+Mirrors the reference's PLD accountant tests
+(``tests/budget_accounting_test.py:198`` onward) but checks our own engine
+against analytic formulas instead of the external dp_accounting library.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from pipelinedp_tpu import pld
+from pipelinedp_tpu.aggregate_params import MechanismType
+from pipelinedp_tpu.budget_accounting import PLDBudgetAccountant
+
+
+def analytic_gaussian_delta(eps: float, sigma: float, s: float = 1.0):
+    """Exact delta(eps) of the Gaussian mechanism (Balle & Wang 2018)."""
+
+    def phi(z):
+        return 0.5 * (1 + math.erf(z / math.sqrt(2)))
+
+    return phi(s / (2 * sigma) - eps * sigma / s) - math.exp(eps) * phi(
+        -s / (2 * sigma) - eps * sigma / s)
+
+
+class TestGaussianPLD:
+
+    @pytest.mark.parametrize("sigma,eps", [(1.0, 1.0), (2.0, 0.5),
+                                           (0.5, 3.0), (4.0, 0.1)])
+    def test_delta_matches_analytic(self, sigma, eps):
+        p = pld.gaussian_pld(sigma, sensitivity=1.0, discretization=1e-4)
+        expected = analytic_gaussian_delta(eps, sigma)
+        got = p.delta_for_epsilon(eps)
+        # Pessimistic rounding: got >= expected, but close.
+        assert got >= expected - 1e-6
+        assert got == pytest.approx(expected, abs=5e-4)
+
+    def test_composition_equals_scaled_sensitivity(self):
+        # k-fold composition of Gaussian(sigma, s=1) == single Gaussian with
+        # sensitivity sqrt(k) (losses are normal; means/variances add).
+        k, sigma, eps = 4, 2.0, 1.0
+        single = pld.gaussian_pld(sigma, discretization=1e-4)
+        composed = single.self_compose(k)
+        expected = analytic_gaussian_delta(eps, sigma, s=math.sqrt(k))
+        assert composed.delta_for_epsilon(eps) == pytest.approx(expected,
+                                                                abs=2e-3)
+
+    def test_mass_conservation(self):
+        p = pld.gaussian_pld(1.0)
+        assert p.probs.sum() + p.infinity_mass == pytest.approx(1.0, abs=1e-9)
+
+
+class TestLaplacePLD:
+
+    def test_pure_dp_above_eps(self):
+        # Laplace(b=1, s=1) is 1-DP: delta(eps) == 0 for eps >= 1.
+        p = pld.laplace_pld(1.0, sensitivity=1.0)
+        assert p.delta_for_epsilon(1.0 + 1e-3) == pytest.approx(0.0, abs=1e-9)
+
+    def test_delta_at_zero_matches_tv_distance(self):
+        # delta(0) = TV(Lap(0,b), Lap(s,b)) = 1 - e^(-s/(2b)).
+        b, s = 1.0, 1.0
+        p = pld.laplace_pld(b, sensitivity=s)
+        expected = 1 - math.exp(-s / (2 * b))
+        assert p.delta_for_epsilon(0.0) == pytest.approx(expected, abs=5e-4)
+
+    def test_atom_at_max_loss(self):
+        # P(L = s/b) = 1/2 (all x <= 0). The topmost bucket must hold ~1/2.
+        p = pld.laplace_pld(1.0, sensitivity=1.0)
+        assert p.probs[-1] == pytest.approx(0.5, abs=1e-3)
+
+    def test_composition_of_two_laplace(self):
+        # delta(eps) of 2 compositions at eps = 2*s/b must be 0 (pure DP
+        # composition: eps totals add).
+        p = pld.laplace_pld(1.0).self_compose(2)
+        assert p.delta_for_epsilon(2.0 + 1e-2) == pytest.approx(0.0,
+                                                                abs=1e-9)
+        # And strictly positive below the total eps.
+        assert p.delta_for_epsilon(1.0) > 1e-4
+
+
+class TestPureDpPLD:
+
+    def test_delta_profile(self):
+        eps0, delta0 = 1.0, 1e-3
+        p = pld.pure_dp_pld(eps0, delta0)
+        assert p.delta_for_epsilon(eps0) == pytest.approx(delta0, abs=1e-9)
+        assert p.delta_for_epsilon(0.0) > delta0
+
+
+class TestFindMinimumNoiseStd:
+
+    def test_single_gaussian_matches_analytic_calibration(self):
+        eps, delta = 1.0, 1e-6
+        std = pld.find_minimum_noise_std(
+            [(MechanismType.GAUSSIAN, 1.0, 1.0)], eps, delta,
+            discretization=1e-3)
+        # Check the analytic delta at the found sigma is <= delta and that
+        # slightly less noise would violate it.
+        assert analytic_gaussian_delta(eps, std) <= delta
+        assert analytic_gaussian_delta(eps, std * 0.9) > delta
+
+    def test_single_laplace_close_to_pure_dp_scale(self):
+        # One Laplace mechanism, delta tiny: b -> s/eps, std = b*sqrt(2).
+        eps, delta = 1.0, 1e-9
+        std = pld.find_minimum_noise_std(
+            [(MechanismType.LAPLACE, 1.0, 1.0)], eps, delta,
+            discretization=1e-3)
+        expected = math.sqrt(2.0) / eps
+        assert std == pytest.approx(expected, rel=0.05)
+
+    def test_more_mechanisms_need_more_noise(self):
+        eps, delta = 1.0, 1e-6
+        one = pld.find_minimum_noise_std([(MechanismType.GAUSSIAN, 1.0, 1.0)],
+                                         eps, delta, discretization=1e-3)
+        four = pld.find_minimum_noise_std(
+            [(MechanismType.GAUSSIAN, 1.0, 1.0)] * 4, eps, delta,
+            discretization=1e-3)
+        assert four > one
+        # Advanced composition: roughly sqrt(4)=2x, certainly < 4x (naive).
+        assert four < 4 * one
+        assert four == pytest.approx(2 * one, rel=0.15)
+
+    def test_weight_scales_noise(self):
+        eps, delta = 1.0, 1e-6
+        mechs = [(MechanismType.GAUSSIAN, 1.0, 1.0),
+                 (MechanismType.GAUSSIAN, 1.0, 3.0)]
+        std = pld.find_minimum_noise_std(mechs, eps, delta,
+                                         discretization=1e-3)
+        assert std > 0  # weighted mechanisms compose; smoke-level check
+
+
+class TestPLDBudgetAccountant:
+
+    def test_end_to_end_fills_noise_std(self):
+        acc = PLDBudgetAccountant(total_epsilon=1.0, total_delta=1e-6,
+                                  pld_discretization=1e-3)
+        spec_g = acc.request_budget(MechanismType.GAUSSIAN, sensitivity=2.0)
+        spec_l = acc.request_budget(MechanismType.LAPLACE, sensitivity=1.0)
+        acc.compute_budgets()
+        assert acc.minimum_noise_std is not None
+        assert spec_g.noise_standard_deviation == pytest.approx(
+            2.0 * acc.minimum_noise_std)
+        assert spec_l.noise_standard_deviation == pytest.approx(
+            acc.minimum_noise_std)
+
+    def test_generic_mechanism_gets_eps_delta(self):
+        acc = PLDBudgetAccountant(total_epsilon=1.0, total_delta=1e-6,
+                                  pld_discretization=1e-3)
+        spec = acc.request_budget(MechanismType.GENERIC)
+        acc.compute_budgets()
+        assert spec.eps > 0
+        assert spec.delta > 0
+
+    def test_zero_delta_uses_laplace_closed_form(self):
+        # Reference budget_accounting.py:509-514: delta=0 =>
+        # minimum_noise_std = sum(weights)/eps * sqrt(2).
+        acc = PLDBudgetAccountant(total_epsilon=2.0, total_delta=0.0)
+        spec = acc.request_budget(MechanismType.LAPLACE, weight=1.0)
+        acc.request_budget(MechanismType.LAPLACE, weight=3.0)
+        acc.compute_budgets()
+        assert acc.minimum_noise_std == pytest.approx(4.0 / 2.0 *
+                                                      math.sqrt(2.0))
+        assert spec.noise_standard_deviation == pytest.approx(
+            acc.minimum_noise_std)
+
+    def test_compute_budgets_inside_scope_raises(self):
+        acc = PLDBudgetAccountant(total_epsilon=1.0, total_delta=1e-6)
+        with pytest.raises(Exception, match="within a budget scope"):
+            with acc.scope(weight=1.0):
+                acc.request_budget(MechanismType.GAUSSIAN)
+                acc.compute_budgets()
+
+    def test_naive_compute_budgets_inside_scope_raises(self):
+        from pipelinedp_tpu.budget_accounting import NaiveBudgetAccountant
+        acc = NaiveBudgetAccountant(total_epsilon=1.0, total_delta=1e-6)
+        with pytest.raises(Exception, match="within a budget scope"):
+            with acc.scope(weight=1.0):
+                acc.request_budget(MechanismType.LAPLACE)
+                acc.compute_budgets()
+
+    def test_less_noise_than_naive_for_many_mechanisms(self):
+        # The whole point of PLD accounting: with many mechanisms the
+        # required noise grows ~sqrt(k), not k.
+        k, eps, delta = 9, 1.0, 1e-6
+        acc = PLDBudgetAccountant(total_epsilon=eps, total_delta=delta,
+                                  pld_discretization=1e-3)
+        specs = [
+            acc.request_budget(MechanismType.GAUSSIAN) for _ in range(k)
+        ]
+        acc.compute_budgets()
+        pld_std = specs[0].noise_standard_deviation
+        # Naive split: each mechanism gets eps/k -> sigma grows ~linearly.
+        naive_single = pld.find_minimum_noise_std(
+            [(MechanismType.GAUSSIAN, 1.0, 1.0)], eps / k, delta / k,
+            discretization=1e-3)
+        assert pld_std < naive_single
