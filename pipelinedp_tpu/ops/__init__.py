@@ -1,0 +1,12 @@
+"""DP primitive kernels — the TPU-native replacement for the reference's
+native PyDP/C++ layer (see SURVEY.md §2.9).
+
+Calibration (scale/sigma/threshold arithmetic) is host-side NumPy evaluated
+at trace time or fed into compiled programs as runtime inputs; sampling is
+batched ``jax.random`` on-device (with NumPy twins for the pure-host
+backends).
+"""
+
+from pipelinedp_tpu.ops import noise
+from pipelinedp_tpu.ops import partition_selection
+from pipelinedp_tpu.ops import quantile_tree

@@ -321,7 +321,26 @@ def _compose_for_noise_std(mechanisms: Iterable[Mechanism],
                             discretization=discretization))
         else:
             raise ValueError(f"unsupported mechanism type {mech_type}")
-    return compose_all(plds)
+    return _compose_grouped(mechanisms, plds)
+
+
+def _compose_grouped(mechanisms: Sequence[Mechanism],
+                     plds: Sequence[DiscretePLD]) -> DiscretePLD:
+    """Composes per-mechanism PLDs, self-composing groups of identical
+    (type, sensitivity, weight) mechanisms by squaring — O(log k)
+    convolutions for k identical mechanisms instead of O(k)."""
+    groups = {}
+    for mech, p in zip(mechanisms, plds):
+        key = (mech[0], mech[1], mech[2])
+        if key in groups:
+            groups[key] = (groups[key][0], groups[key][1] + 1)
+        else:
+            groups[key] = (p, 1)
+    out = None
+    for p, count in groups.values():
+        composed = p.self_compose(count) if count > 1 else p
+        out = composed if out is None else out.compose(composed)
+    return out
 
 
 def find_minimum_noise_std(mechanisms: Sequence[Mechanism],

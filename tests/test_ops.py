@@ -1,0 +1,244 @@
+"""Tests for the DP primitive kernels (the PyDP replacement layer).
+
+Statistical/calibration tests follow the reference's pattern
+(``tests/dp_computations_test.py:32``): closed-form identities for
+calibration, moment checks for sampling, and exact-probability checks for
+partition selection.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from pipelinedp_tpu.aggregate_params import (NoiseKind,
+                                             PartitionSelectionStrategy)
+from pipelinedp_tpu.ops import noise, partition_selection, quantile_tree
+
+
+class TestNoiseCalibration:
+
+    def test_laplace_scale(self):
+        assert noise.laplace_scale(2.0, 4.0) == 2.0
+        assert noise.laplace_std(1.0, 1.0) == pytest.approx(math.sqrt(2))
+
+    @pytest.mark.parametrize("eps,delta", [(1.0, 1e-6), (0.1, 1e-8),
+                                           (5.0, 1e-3)])
+    def test_gaussian_sigma_is_tight(self, eps, delta):
+        sigma = noise.gaussian_sigma(eps, delta, 1.0)
+        assert noise.gaussian_delta(eps, sigma, 1.0) <= delta * 1.0001
+        assert noise.gaussian_delta(eps, sigma * 0.95, 1.0) > delta
+
+    def test_gaussian_sigma_scales_with_sensitivity(self):
+        s1 = noise.gaussian_sigma(1.0, 1e-6, 1.0)
+        s3 = noise.gaussian_sigma(1.0, 1e-6, 3.0)
+        assert s3 == pytest.approx(3 * s1, rel=1e-6)
+
+    def test_sensitivity_calculus(self):
+        # L1 = l0*linf, L2 = sqrt(l0)*linf (reference dp_computations.py:72,85)
+        assert noise.compute_l1_sensitivity(4, 3) == 12
+        assert noise.compute_l2_sensitivity(4, 3) == pytest.approx(6.0)
+
+    def test_invalid_args(self):
+        with pytest.raises(ValueError):
+            noise.laplace_scale(0.0, 1.0)
+        with pytest.raises(ValueError):
+            noise.gaussian_sigma(1.0, 0.0, 1.0)
+
+
+class TestSampling:
+
+    def test_np_laplace_moments(self):
+        noise.seed_host_rng(0)
+        x = noise.np_laplace(2.0, shape=200_000)
+        assert np.mean(x) == pytest.approx(0.0, abs=0.05)
+        assert np.std(x) == pytest.approx(2.0 * math.sqrt(2), rel=0.02)
+
+    def test_jax_laplace_moments(self):
+        import jax
+        x = noise.jax_laplace(jax.random.PRNGKey(0), (200_000,), 2.0)
+        assert float(np.mean(x)) == pytest.approx(0.0, abs=0.05)
+        assert float(np.std(x)) == pytest.approx(2.0 * math.sqrt(2),
+                                                 rel=0.02)
+
+    def test_jax_gaussian_moments(self):
+        import jax
+        x = noise.jax_gaussian(jax.random.PRNGKey(1), (200_000,), 3.0)
+        assert float(np.std(x)) == pytest.approx(3.0, rel=0.02)
+
+
+class TestTruncatedGeometric:
+
+    def test_basic_properties(self):
+        s = partition_selection.TruncatedGeometricPartitionStrategy(
+            epsilon=1.0, delta=1e-5, max_partitions_contributed=1)
+        assert s.probability_of_keep(0) == 0.0
+        table = s.keep_table
+        # Monotone nondecreasing, bounded by 1, saturates.
+        assert np.all(np.diff(table) >= -1e-15)
+        assert table[-1] == 1.0
+        # DP constraint holds along the whole table.
+        eps, delta = 1.0, 1e-5
+        pi = table
+        assert np.all(pi[1:] <= np.exp(eps) * pi[:-1] + delta + 1e-12)
+
+    def test_single_user_leq_delta(self):
+        # P(keep | 1 user) <= delta (the core privacy property).
+        s = partition_selection.TruncatedGeometricPartitionStrategy(
+            epsilon=1.0, delta=1e-5, max_partitions_contributed=1)
+        assert s.probability_of_keep(1) <= 1e-5
+
+    def test_large_count_kept(self):
+        s = partition_selection.TruncatedGeometricPartitionStrategy(
+            epsilon=1.0, delta=1e-5, max_partitions_contributed=1)
+        assert s.probability_of_keep(10_000) == 1.0
+        assert s.should_keep(10_000)
+
+    def test_max_partitions_needs_more_users(self):
+        s1 = partition_selection.TruncatedGeometricPartitionStrategy(
+            1.0, 1e-5, max_partitions_contributed=1)
+        s4 = partition_selection.TruncatedGeometricPartitionStrategy(
+            1.0, 1e-5, max_partitions_contributed=4)
+        n = 30
+        assert s4.probability_of_keep(n) <= s1.probability_of_keep(n)
+
+    def test_pre_threshold(self):
+        s = partition_selection.TruncatedGeometricPartitionStrategy(
+            1.0, 1e-5, 1, pre_threshold=10)
+        assert s.probability_of_keep(9) == 0.0
+        base = partition_selection.TruncatedGeometricPartitionStrategy(
+            1.0, 1e-5, 1)
+        assert s.probability_of_keep(15) == pytest.approx(
+            base.probability_of_keep(6))
+
+    def test_should_keep_statistics(self):
+        noise.seed_host_rng(7)
+        s = partition_selection.TruncatedGeometricPartitionStrategy(
+            1.0, 0.01, 1)
+        n = 6
+        p = s.probability_of_keep(n)
+        assert 0.05 < p < 0.95  # interesting regime
+        keeps = sum(s.should_keep(n) for _ in range(4000)) / 4000
+        assert keeps == pytest.approx(p, abs=0.04)
+
+
+@pytest.mark.parametrize("strategy_cls", [
+    partition_selection.LaplaceThresholdingPartitionStrategy,
+    partition_selection.GaussianThresholdingPartitionStrategy,
+])
+class TestThresholding:
+
+    def test_single_user_leq_delta(self, strategy_cls):
+        s = strategy_cls(epsilon=1.0, delta=1e-5,
+                         max_partitions_contributed=1)
+        assert s.probability_of_keep(1) <= 1e-5 * 1.001
+
+    def test_monotone_and_saturating(self, strategy_cls):
+        s = strategy_cls(1.0, 1e-5, 1)
+        probs = s.probabilities(np.arange(0, 500))
+        assert np.all(np.diff(probs) >= -1e-12)
+        assert probs[-1] > 0.999
+
+    def test_should_keep_matches_probability(self, strategy_cls):
+        noise.seed_host_rng(3)
+        s = strategy_cls(1.0, 0.05, 1)
+        # pick n near the threshold for an interesting keep probability
+        n = int(s.threshold)
+        p = s.probability_of_keep(n)
+        keeps = sum(s.should_keep(n) for _ in range(4000)) / 4000
+        assert keeps == pytest.approx(p, abs=0.04)
+
+    def test_pre_threshold_blocks_small(self, strategy_cls):
+        s = strategy_cls(1.0, 1e-5, 1, pre_threshold=100)
+        assert s.probability_of_keep(99) == 0.0
+        assert not s.should_keep(99)
+
+
+class TestFactory:
+
+    @pytest.mark.parametrize("strategy,cls", [
+        (PartitionSelectionStrategy.TRUNCATED_GEOMETRIC,
+         partition_selection.TruncatedGeometricPartitionStrategy),
+        (PartitionSelectionStrategy.LAPLACE_THRESHOLDING,
+         partition_selection.LaplaceThresholdingPartitionStrategy),
+        (PartitionSelectionStrategy.GAUSSIAN_THRESHOLDING,
+         partition_selection.GaussianThresholdingPartitionStrategy),
+    ])
+    def test_creates_right_class(self, strategy, cls):
+        s = partition_selection.create_partition_selection_strategy(
+            strategy, 1.0, 1e-5, 2)
+        assert isinstance(s, cls)
+
+
+class TestQuantileTree:
+
+    def _build(self, values, lo=0.0, hi=100.0):
+        t = quantile_tree.QuantileTree(lo, hi)
+        for v in values:
+            t.add_entry(v)
+        return t
+
+    def test_quantiles_with_huge_eps(self):
+        # Big-eps determinism pattern (reference tests use eps=1e5).
+        noise.seed_host_rng(0)
+        values = np.random.default_rng(0).uniform(0, 100, size=5000)
+        t = self._build(values)
+        got = t.compute_quantiles(eps=1e5, delta=0.0,
+                                  max_partitions_contributed=1,
+                                  max_contributions_per_partition=1,
+                                  quantiles=[0.1, 0.5, 0.9])
+        for g, expected in zip(got, [10, 50, 90]):
+            assert g == pytest.approx(expected, abs=2.0)
+
+    def test_merge_is_addition(self):
+        t1 = self._build([1, 2, 3])
+        t2 = self._build([50, 60])
+        t1.merge(t2)
+        dense = t1.to_dense()
+        both = self._build([1, 2, 3, 50, 60]).to_dense()
+        assert np.array_equal(dense, both)
+
+    def test_serialize_roundtrip(self):
+        t = self._build([5, 10, 20])
+        t2 = quantile_tree.QuantileTree.deserialize(t.serialize())
+        assert np.array_equal(t.to_dense(), t2.to_dense())
+
+    def test_merge_from_bytes(self):
+        t1 = self._build([1])
+        t1.merge(self._build([2]).serialize())
+        assert t1.to_dense().sum() == 2 * t1.height
+
+    def test_dense_roundtrip(self):
+        t = self._build([7, 42, 99])
+        dense = t.to_dense()
+        t2 = quantile_tree.QuantileTree.from_dense(dense, 0.0, 100.0)
+        assert np.array_equal(dense, t2.to_dense())
+
+    def test_values_clipped_to_bounds(self):
+        t = self._build([-50, 150])
+        got = t.compute_quantiles(1e5, 0.0, 1, 1, [0.0, 1.0])
+        assert got[0] >= 0.0 and got[1] <= 100.0
+
+    def test_monotone_output(self):
+        noise.seed_host_rng(5)
+        t = self._build(np.random.default_rng(1).uniform(0, 100, 200))
+        got = t.compute_quantiles(0.5, 0.0, 1, 1,
+                                  [0.1, 0.25, 0.5, 0.75, 0.9])
+        assert got == sorted(got)
+
+    def test_gaussian_noise_kind(self):
+        noise.seed_host_rng(6)
+        t = self._build(np.random.default_rng(2).uniform(0, 100, 5000))
+        got = t.compute_quantiles(1e5, 1e-6, 1, 1, [0.5],
+                                  noise_kind=NoiseKind.GAUSSIAN)
+        assert got[0] == pytest.approx(50, abs=3.0)
+
+    def test_dense_paths_match_sparse(self):
+        values = np.array([0.0, 37.5, 99.9])
+        paths = quantile_tree.values_to_dense_paths(values, 0.0, 100.0)
+        t = self._build(values)
+        dense = t.to_dense()
+        flat = paths.ravel()
+        expected = np.zeros_like(dense)
+        np.add.at(expected, flat, 1.0)
+        assert np.array_equal(dense, expected)
