@@ -32,5 +32,21 @@ from pipelinedp_tpu.budget_accounting import (
     NaiveBudgetAccountant,
     PLDBudgetAccountant,
 )
+from pipelinedp_tpu.combiners import Combiner, CustomCombiner
+from pipelinedp_tpu.dp_engine import DataExtractors, DPEngine
+from pipelinedp_tpu.pipeline_backend import (
+    Annotator,
+    LocalBackend,
+    MultiProcLocalBackend,
+    PipelineBackend,
+    SparkRDDBackend,
+    register_annotator,
+)
+from pipelinedp_tpu.report_generator import ExplainComputationReport
+
+try:
+    from pipelinedp_tpu.pipeline_backend import BeamBackend
+except ImportError:  # apache_beam not installed
+    pass
 
 __version__ = "0.1.0"

@@ -186,6 +186,16 @@ class AggregateParams:
     # --- validation (mirrors the reference's matrix at :175-270) ---
 
     def _validate(self):
+        # Contribution bounds, budget weight and pre-threshold are required
+        # regardless of custom combiners (the reference validates bounds
+        # before its custom-combiner handling, aggregate_params.py:246-270).
+        self._validate_contribution_bounds()
+        if self.budget_weight <= 0:
+            raise ValueError("budget_weight must be positive")
+        if self.pre_threshold is not None and self.pre_threshold <= 0:
+            raise ValueError(
+                f"pre_threshold must be positive, not {self.pre_threshold}")
+
         if self.custom_combiners:
             logging.warning("Warning: custom combiners are an experimental"
                             " feature. The API may change without notice.")
@@ -195,14 +205,8 @@ class AggregateParams:
             return
 
         self._validate_metrics()
-        self._validate_contribution_bounds()
         self._validate_value_bounds()
         self._validate_vector_params()
-        if self.budget_weight <= 0:
-            raise ValueError("budget_weight must be positive")
-        if self.pre_threshold is not None and self.pre_threshold <= 0:
-            raise ValueError(
-                f"pre_threshold must be positive, not {self.pre_threshold}")
 
     def _validate_metrics(self):
         if not self.metrics:
@@ -233,28 +237,24 @@ class AggregateParams:
                     "max_contributions_per_partition), not both")
             _check_positive_int(self.max_contributions, "max_contributions")
         else:
-            if self.max_partitions_contributed is None:
-                raise ValueError("max_partitions_contributed must be set")
+            # The pair must be set together, regardless of metrics
+            # (reference aggregate_params.py:255-270).
+            n_set = sum(x is not None
+                        for x in (self.max_partitions_contributed,
+                                  self.max_contributions_per_partition))
+            if n_set == 0:
+                raise ValueError(
+                    "either max_contributions must be set or both "
+                    "max_partitions_contributed and "
+                    "max_contributions_per_partition must be set")
+            if n_set == 1:
+                raise ValueError(
+                    "either none or both of max_partitions_contributed and "
+                    "max_contributions_per_partition must be set")
             _check_positive_int(self.max_partitions_contributed,
                                 "max_partitions_contributed")
-            if self.max_contributions_per_partition is not None:
-                # Validated whenever set, even if the metric does not need
-                # the linf bound (reference aggregate_params.py:266-269).
-                _check_positive_int(self.max_contributions_per_partition,
-                                    "max_contributions_per_partition")
-            elif self._needs_linf_bound():
-                raise ValueError(
-                    "max_contributions_per_partition must be set for "
-                    f"metrics {self.metrics_str}")
-
-    def _needs_linf_bound(self) -> bool:
-        if not self.metrics:
-            return False
-        if self.bounds_per_partition_are_set:
-            # per-partition-sum clipping subsumes the per-row cap for SUM.
-            return any(m != Metrics.SUM for m in self.metrics)
-        linf_free = {Metrics.PRIVACY_ID_COUNT, Metrics.VECTOR_SUM}
-        return any(m not in linf_free for m in self.metrics)
+            _check_positive_int(self.max_contributions_per_partition,
+                                "max_contributions_per_partition")
 
     def _validate_value_bounds(self):
         needs_values = any(

@@ -1,0 +1,346 @@
+"""DPEngine — builds the lazy DP aggregation graph over backend ops
+(capability parity with the reference's ``pipeline_dp/dp_engine.py``:
+``aggregate`` :66, ``select_partitions`` :204, public-partition handling
+:283-310, private selection filter :312-362, validation :390-418).
+
+The engine is host-side and backend-agnostic. When the backend is the JAX
+backend, the same logical graph lowers to a fused XLA program (the backend
+recognizes the engine's op sequence through its array-native op
+implementations); for host backends the graph is generator chains.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Optional, Tuple
+
+from pipelinedp_tpu import (combiners, contribution_bounders,
+                            partition_selection, report_generator,
+                            sampling_utils)
+from pipelinedp_tpu.aggregate_params import (AggregateParams, MechanismType,
+                                             Metrics,
+                                             PartitionSelectionStrategy,
+                                             SelectPartitionsParams)
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_partition_selection_strategy(strategy, eps, delta,
+                                         max_partitions, pre_threshold):
+    return partition_selection.create_partition_selection_strategy(
+        strategy, eps, delta, max_partitions, pre_threshold)
+
+
+@dataclasses.dataclass
+class DataExtractors:
+    """Extractor triple (reference :27-37): given an input row, return its
+    privacy id, partition key, and value."""
+    privacy_id_extractor: Callable = None
+    partition_extractor: Callable = None
+    value_extractor: Callable = None
+
+
+class DPEngine:
+    """Performs DP aggregations (reference :40)."""
+
+    def __init__(self, budget_accountant, backend):
+        self._budget_accountant = budget_accountant
+        self._backend = backend
+        self._report_generators = []
+
+    @property
+    def _current_report_generator(self):
+        return self._report_generators[-1]
+
+    def _add_report_stage(self, stage_description):
+        self._current_report_generator.add_stage(stage_description)
+
+    def _add_report_stages(self, stages_description):
+        for stage_description in stages_description:
+            self._add_report_stage(stage_description)
+
+    def explain_computations_report(self):
+        return [gen.report() for gen in self._report_generators]
+
+    # ------------------------------------------------------------------
+    # aggregate
+    # ------------------------------------------------------------------
+
+    def aggregate(self,
+                  col,
+                  params: AggregateParams,
+                  data_extractors: DataExtractors,
+                  public_partitions=None,
+                  out_explain_computation_report: Optional[
+                      report_generator.ExplainComputationReport] = None):
+        """Computes DP metrics per partition key.
+
+        Returns a collection of (partition_key, MetricsTuple). The graph is
+        lazy: execution happens when the backend's runner pulls it, after
+        ``budget_accountant.compute_budgets()``.
+        """
+        self._check_aggregate_params(col, params, data_extractors)
+
+        with self._budget_accountant.scope(weight=params.budget_weight):
+            self._report_generators.append(
+                report_generator.ReportGenerator(
+                    params, "aggregate", public_partitions is not None))
+            if out_explain_computation_report is not None:
+                out_explain_computation_report._set_report_generator(
+                    self._current_report_generator)
+            col = self._aggregate(col, params, data_extractors,
+                                  public_partitions)
+            budget = self._budget_accountant._compute_budget_for_aggregation(
+                params.budget_weight)
+            return self._backend.annotate(col, "annotation", params=params,
+                                          budget=budget)
+
+    def _aggregate(self, col, params, data_extractors, public_partitions):
+        if params.custom_combiners:
+            combiner = combiners.create_compound_combiner_with_custom_combiners(
+                params, self._budget_accountant, params.custom_combiners)
+        else:
+            combiner = self._create_compound_combiner(params)
+
+        if public_partitions is not None and (
+                not params.public_partitions_already_filtered):
+            col = self._drop_not_public_partitions(col, public_partitions,
+                                                   data_extractors)
+        if not params.contribution_bounds_already_enforced:
+            col = self._extract_columns(col, data_extractors)
+            # col: (privacy_id, partition_key, value)
+            bounder = self._create_contribution_bounder(params)
+            col = bounder.bound_contributions(
+                col, params, self._backend, self._current_report_generator,
+                combiner.create_accumulator)
+            # col: ((privacy_id, partition_key), accumulator)
+            col = self._backend.map_tuple(
+                col, lambda pid_pk, acc: (pid_pk[1], acc), "Drop privacy id")
+        else:
+            col = self._backend.map(
+                col, lambda row: (data_extractors.partition_extractor(row),
+                                  data_extractors.value_extractor(row)),
+                "Extract (partition_key, value)")
+            col = self._backend.map_values(
+                col, lambda value: combiner.create_accumulator([value]),
+                "Wrap values into accumulators")
+        # col: (partition_key, accumulator)
+
+        if public_partitions:
+            col = self._add_empty_public_partitions(
+                col, public_partitions, combiner.create_accumulator)
+
+        col = self._backend.combine_accumulators_per_key(
+            col, combiner, "Reduce accumulators per partition key")
+
+        if public_partitions is None:
+            max_rows_per_privacy_id = 1
+            if params.contribution_bounds_already_enforced:
+                # Without privacy ids, one row is not necessarily one user;
+                # ceil(row_count / max_rows_per_privacy_id) lower-bounds the
+                # user count (reference :163-169, :341-348).
+                max_rows_per_privacy_id = (
+                    params.max_contributions or
+                    params.max_contributions_per_partition)
+            col = self._select_private_partitions_internal(
+                col, params.max_partitions_contributed,
+                max_rows_per_privacy_id,
+                params.partition_selection_strategy,
+                params.pre_threshold)
+
+        self._add_report_stages(combiner.explain_computation())
+        col = self._backend.map_values(col, combiner.compute_metrics,
+                                       "Compute DP metrics")
+        return col
+
+    # ------------------------------------------------------------------
+    # select_partitions
+    # ------------------------------------------------------------------
+
+    def select_partitions(self, col, params: SelectPartitionsParams,
+                          data_extractors: DataExtractors):
+        """DP set of partition keys present in the data (reference :204)."""
+        self._check_select_private_partitions(col, params, data_extractors)
+
+        with self._budget_accountant.scope(weight=params.budget_weight):
+            self._report_generators.append(
+                report_generator.ReportGenerator(params,
+                                                 "select_partitions"))
+            col = self._select_partitions(col, params, data_extractors)
+            budget = self._budget_accountant._compute_budget_for_aggregation(
+                params.budget_weight)
+            return self._backend.annotate(col, "annotation", params=params,
+                                          budget=budget)
+
+    def _select_partitions(self, col, params, data_extractors):
+        max_partitions_contributed = params.max_partitions_contributed
+        col = self._backend.map(
+            col, lambda row: (data_extractors.privacy_id_extractor(row),
+                              data_extractors.partition_extractor(row)),
+            "Extract (privacy_id, partition_key)")
+        col = self._backend.group_by_key(col, "Group by privacy_id")
+
+        # May be slow if one privacy id contributes to very many partitions
+        # (same caveat as reference :247-248).
+        def sample_unique_elements_fn(pid_and_pks):
+            pid, pks = pid_and_pks
+            unique_pks = list(set(pks))
+            sampled = sampling_utils.choose_from_list_without_replacement(
+                unique_pks, max_partitions_contributed)
+            return ((pid, pk) for pk in sampled)
+
+        col = self._backend.flat_map(col, sample_unique_elements_fn,
+                                     "Sample cross-partition contributions")
+
+        # An empty compound accumulator tracks the raw privacy-id count.
+        compound_combiner = combiners.CompoundCombiner(
+            [], return_named_tuple=False)
+        col = self._backend.map_tuple(
+            col, lambda pid, pk:
+            (pk, compound_combiner.create_accumulator([])),
+            "Drop privacy id and add accumulator")
+        col = self._backend.combine_accumulators_per_key(
+            col, compound_combiner, "Combine accumulators per partition key")
+        col = self._select_private_partitions_internal(
+            col, max_partitions_contributed, max_rows_per_privacy_id=1,
+            strategy=params.partition_selection_strategy,
+            pre_threshold=params.pre_threshold)
+        return self._backend.keys(
+            col, "Drop accumulators, keep only partition keys")
+
+    # ------------------------------------------------------------------
+    # helpers
+    # ------------------------------------------------------------------
+
+    def _drop_not_public_partitions(self, col, public_partitions,
+                                    data_extractors):
+        col = self._backend.map(
+            col, lambda row: (data_extractors.partition_extractor(row), row),
+            "Extract partition id")
+        col = self._backend.filter_by_key(
+            col, public_partitions, "Filtering out non-public partitions")
+        self._add_report_stage(
+            "Public partition selection: dropped non public partitions")
+        return self._backend.map_tuple(col, lambda k, v: v, "Drop key")
+
+    def _add_empty_public_partitions(self, col, public_partitions,
+                                     aggregator_fn):
+        self._add_report_stage(
+            "Adding empty partitions for public partitions that are missing "
+            "in data")
+        public_partitions = self._backend.to_collection(
+            public_partitions, col, "Public partitions to collection")
+        empty_accumulators = self._backend.map(
+            public_partitions,
+            lambda pk: (pk, aggregator_fn([])), "Build empty accumulators")
+        return self._backend.flatten(
+            (col, empty_accumulators),
+            "Join public partitions with partitions from data")
+
+    def _select_private_partitions_internal(
+            self, col, max_partitions_contributed: int,
+            max_rows_per_privacy_id: int,
+            strategy: PartitionSelectionStrategy,
+            pre_threshold: Optional[int] = None):
+        """DP filter keeping only partitions whose (estimated) privacy-id
+        count passes the selection strategy (reference :312-362)."""
+        budget = self._budget_accountant.request_budget(
+            mechanism_type=MechanismType.GENERIC)
+
+        def filter_fn(budget, max_partitions, max_rows_per_privacy_id,
+                      strategy, pre_threshold, row) -> bool:
+            # Strategy objects are created lazily on workers, after budgets
+            # are computed (reference :350-352) — but cached per
+            # (strategy, eps, delta, ...) so the truncated-geometric
+            # probability table is built once per worker, not per partition.
+            row_count, _ = row[1]
+            privacy_id_count = (row_count + max_rows_per_privacy_id -
+                                1) // max_rows_per_privacy_id
+            strategy_object = _cached_partition_selection_strategy(
+                strategy, budget.eps, budget.delta, max_partitions,
+                pre_threshold)
+            return strategy_object.should_keep(privacy_id_count)
+
+        filter_fn = functools.partial(filter_fn, budget,
+                                      max_partitions_contributed,
+                                      max_rows_per_privacy_id, strategy,
+                                      pre_threshold)
+        self._add_report_stage(
+            lambda: f"Private Partition selection: using {strategy.value} "
+            f"method with (eps={budget.eps}, delta={budget.delta})")
+        return self._backend.filter(col, filter_fn,
+                                    "Filter private partitions")
+
+    def _create_compound_combiner(
+            self, params: AggregateParams) -> combiners.CompoundCombiner:
+        return combiners.create_compound_combiner(params,
+                                                  self._budget_accountant)
+
+    def _create_contribution_bounder(
+            self, params: AggregateParams
+    ) -> contribution_bounders.ContributionBounder:
+        if params.max_contributions:
+            return (contribution_bounders.
+                    SamplingPerPrivacyIdContributionBounder())
+        return (contribution_bounders.
+                SamplingCrossAndPerPartitionContributionBounder())
+
+    def _extract_columns(self, col, data_extractors: DataExtractors):
+        return self._backend.map(
+            col, lambda row: (data_extractors.privacy_id_extractor(row),
+                              data_extractors.partition_extractor(row),
+                              data_extractors.value_extractor(row)),
+            "Extract (privacy_id, partition_key, value)")
+
+    # ------------------------------------------------------------------
+    # validation
+    # ------------------------------------------------------------------
+
+    def _check_aggregate_params(self, col, params, data_extractors,
+                                check_data_extractors: bool = True):
+        if params is not None and getattr(params, "max_contributions",
+                                          None) is not None:
+            raise NotImplementedError(
+                "max_contributions is not supported yet.")
+        if col is None or not col:
+            raise ValueError("col must be non-empty")
+        if params is None:
+            raise ValueError("params must be set to a valid AggregateParams")
+        if not isinstance(params, AggregateParams):
+            raise TypeError("params must be set to a valid AggregateParams")
+        if check_data_extractors:
+            if data_extractors is None:
+                raise ValueError(
+                    "data_extractors must be set to a DataExtractors")
+            if not isinstance(data_extractors, DataExtractors):
+                raise TypeError(
+                    "data_extractors must be set to a DataExtractors")
+        if params.contribution_bounds_already_enforced:
+            if data_extractors.privacy_id_extractor:
+                raise ValueError(
+                    "privacy_id_extractor should be set iff "
+                    "contribution_bounds_already_enforced is False")
+            if Metrics.PRIVACY_ID_COUNT in params.metrics:
+                raise ValueError(
+                    "PRIVACY_ID_COUNT cannot be computed when "
+                    "contribution_bounds_already_enforced is True.")
+
+    def _check_select_private_partitions(self, col, params, data_extractors):
+        if col is None or not col:
+            raise ValueError("col must be non-empty")
+        if params is None:
+            raise ValueError(
+                "params must be set to a valid SelectPartitionsParams")
+        if not isinstance(params, SelectPartitionsParams):
+            raise TypeError(
+                "params must be set to a valid SelectPartitionsParams")
+        if not isinstance(params.max_partitions_contributed,
+                          int) or params.max_partitions_contributed <= 0:
+            raise ValueError("params.max_partitions_contributed must be set "
+                             "(to a positive integer)")
+        if data_extractors is None:
+            raise ValueError("data_extractors must be set to a "
+                             "DataExtractors")
+        if not isinstance(data_extractors, DataExtractors):
+            raise TypeError("data_extractors must be set to a "
+                            "DataExtractors")

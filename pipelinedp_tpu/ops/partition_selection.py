@@ -139,7 +139,10 @@ def _truncated_geometric_table(eps: float, delta: float) -> np.ndarray:
     """Precomputes pi_n until saturation (pi_n == 1)."""
     if delta <= 0:
         raise ValueError("truncated geometric selection requires delta > 0")
-    e_pos = math.exp(eps)
+    # exp(eps) only ever multiplies probabilities >= delta before a min(.., 1)
+    # — clamping the exponent avoids OverflowError at huge eps without
+    # changing the saturated result.
+    e_pos = math.exp(min(eps, 700.0))
     e_neg = math.exp(-eps)
     probs = [0.0]
     pi = 0.0

@@ -1,0 +1,20 @@
+"""Partition-selection strategy factory (parity with the reference module
+``pipeline_dp/partition_selection.py:19-33``). The actual strategies are
+TPU-native kernels in ``pipelinedp_tpu.ops.partition_selection`` — this
+module keeps the reference's import path and factory signature."""
+
+from pipelinedp_tpu.ops.partition_selection import (
+    GaussianThresholdingPartitionStrategy,
+    LaplaceThresholdingPartitionStrategy,
+    PartitionSelectionStrategyBase,
+    TruncatedGeometricPartitionStrategy,
+    create_partition_selection_strategy,
+)
+
+__all__ = [
+    "GaussianThresholdingPartitionStrategy",
+    "LaplaceThresholdingPartitionStrategy",
+    "PartitionSelectionStrategyBase",
+    "TruncatedGeometricPartitionStrategy",
+    "create_partition_selection_strategy",
+]

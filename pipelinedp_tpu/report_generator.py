@@ -1,0 +1,68 @@
+"""'Explain Computation' reports (capability parity with the reference's
+``pipeline_dp/report_generator.py``): each aggregation collects an ordered
+list of stage descriptions — strings or zero-arg callables evaluated lazily
+so budget values resolved only after ``compute_budgets()`` still render
+(reference :66-75; consumed from ``dp_engine`` stages)."""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Union
+
+from pipelinedp_tpu import aggregate_params as agg
+
+
+class ReportGenerator:
+    """Collects stages of one DP aggregation (reference :46-89)."""
+
+    def __init__(self,
+                 params,
+                 method_name: str,
+                 is_public_partition: Optional[bool] = None):
+        self._params_str = None
+        if params:
+            if isinstance(params, agg.AggregateParams):
+                self._params_str = agg.parameters_to_readable_string(
+                    params, is_public_partition)
+            else:
+                self._params_str = str(params)
+        self._method_name = method_name
+        self._stages: List[Union[Callable, str]] = []
+
+    def add_stage(self, stage_description: Union[Callable, str]) -> None:
+        self._stages.append(stage_description)
+
+    def add_stages(self, stage_descriptions) -> None:
+        for s in stage_descriptions:
+            self.add_stage(s)
+
+    def report(self) -> str:
+        if not self._params_str:
+            return ""
+        lines = [f"DPEngine method: {self._method_name}", self._params_str,
+                 "Computation graph:"]
+        for i, stage in enumerate(self._stages):
+            text = stage() if callable(stage) else stage
+            lines.append(f" {i + 1}. {text}")
+        return "\n".join(lines)
+
+
+class ExplainComputationReport:
+    """User-facing handle for one aggregation's report (reference :92-115)."""
+
+    def __init__(self):
+        self._report_generator: Optional[ReportGenerator] = None
+
+    def _set_report_generator(self, report_generator: ReportGenerator):
+        self._report_generator = report_generator
+
+    def text(self) -> str:
+        if self._report_generator is None:
+            raise ValueError(
+                "The report_generator is not set.\nWas this object passed as "
+                "an argument to a DP aggregation method?")
+        try:
+            return self._report_generator.report()
+        except Exception as e:
+            raise ValueError(
+                "Explain computation report failed to be generated.\nWas "
+                "BudgetAccountant.compute_budgets() called?") from e

@@ -1,0 +1,48 @@
+"""Sampling helpers (capability parity with the reference's
+``pipeline_dp/sampling_utils.py``)."""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Optional
+
+import numpy as np
+
+from pipelinedp_tpu.ops import noise as noise_ops
+
+
+def choose_from_list_without_replacement(
+        a: List, size: int,
+        rng: Optional[np.random.Generator] = None) -> List:
+    """Uniform sample without replacement, preserving element types.
+
+    Indices (not elements) are drawn so values never round-trip through numpy
+    scalar types — the reference needs this for Beam serialization and to
+    avoid precision loss on big ints (``sampling_utils.py:19-33``); we keep
+    it because accumulator objects must survive untouched too."""
+    if len(a) <= size:
+        return a
+    rng = rng or noise_ops._host_rng
+    sampled_indices = rng.choice(len(a), size, replace=False)
+    return [a[i] for i in sampled_indices]
+
+
+def _compute_64bit_hash(v) -> int:
+    m = hashlib.sha1()
+    m.update(repr(v).encode())
+    return int(m.hexdigest()[:16], 16)
+
+
+class ValueSampler:
+    """Deterministic keep-decision by hashing (reference :38-51): a fixed
+    value always gets the same decision; over random values the keep rate is
+    ``sampling_rate``. Used for reproducible partition subsampling in the
+    utility-analysis paths."""
+
+    def __init__(self, sampling_rate: float):
+        if not 0 <= sampling_rate <= 1:
+            raise ValueError("sampling_rate must be in [0, 1]")
+        self._sample_bound = int(round(2**64 * sampling_rate))
+
+    def keep(self, value) -> bool:
+        return _compute_64bit_hash(value) < self._sample_bound

@@ -31,11 +31,19 @@ class TestAggregateParamsValidation:
                             max_value=5.0)
 
     def test_valid_sum_with_partition_sum_bounds(self):
-        # per-partition sum bounds do not require a linf bound for SUM
+        # Per-partition sum bounds replace value clipping for SUM, but the
+        # contribution-bound pair is still required (reference
+        # aggregate_params.py:255-270 demands both unconditionally).
         pdp.AggregateParams(metrics=[pdp.Metrics.SUM],
                             max_partitions_contributed=1,
+                            max_contributions_per_partition=1,
                             min_sum_per_partition=0.0,
                             max_sum_per_partition=10.0)
+        with pytest.raises(ValueError, match="both"):
+            pdp.AggregateParams(metrics=[pdp.Metrics.SUM],
+                                max_partitions_contributed=1,
+                                min_sum_per_partition=0.0,
+                                max_sum_per_partition=10.0)
 
     @pytest.mark.parametrize("field,value", [
         ("max_partitions_contributed", 0),
@@ -100,6 +108,7 @@ class TestAggregateParamsValidation:
         with pytest.raises(ValueError, match="VECTOR_SUM"):
             pdp.AggregateParams(
                 metrics=[pdp.Metrics.VECTOR_SUM, pdp.Metrics.COUNT],
+                max_contributions_per_partition=1,
                 max_partitions_contributed=1,
                 vector_size=4,
                 vector_max_norm=1.0)
@@ -112,6 +121,7 @@ class TestAggregateParamsValidation:
     def test_vector_sum_valid(self):
         pdp.AggregateParams(metrics=[pdp.Metrics.VECTOR_SUM],
                             max_partitions_contributed=1,
+                            max_contributions_per_partition=1,
                             vector_size=8,
                             vector_max_norm=2.0,
                             vector_norm_kind=pdp.NormKind.L2)
@@ -120,6 +130,7 @@ class TestAggregateParamsValidation:
         with pytest.raises(ValueError, match="PRIVACY_ID_COUNT"):
             pdp.AggregateParams(metrics=[pdp.Metrics.PRIVACY_ID_COUNT],
                                 max_partitions_contributed=1,
+                                max_contributions_per_partition=1,
                                 contribution_bounds_already_enforced=True)
 
     def test_duplicate_metrics_rejected(self):
@@ -139,6 +150,7 @@ class TestAggregateParamsValidation:
         with pytest.raises(ValueError, match="custom_combiners"):
             pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
                                 max_partitions_contributed=1,
+                                max_contributions_per_partition=1,
                                 custom_combiners=[object()])
 
     def test_percentiles(self):

@@ -1,0 +1,255 @@
+"""Combiner tests — create/merge/compute per combiner, compound fusion, and
+the factory's budget-request pattern (mirrors the reference's
+``tests/combiners_test.py:160-628``)."""
+
+import numpy as np
+import pytest
+
+from pipelinedp_tpu import budget_accounting, combiners
+from pipelinedp_tpu.aggregate_params import (AggregateParams, MechanismType,
+                                             Metrics, NoiseKind, NormKind)
+
+
+def make_params(metrics, **kwargs):
+    defaults = dict(max_partitions_contributed=2,
+                    max_contributions_per_partition=3, min_value=0.0,
+                    max_value=10.0)
+    defaults.update(kwargs)
+    return AggregateParams(metrics=metrics, **defaults)
+
+
+def combiner_params(agg_params, eps=1e5, delta=1e-10):
+    spec = budget_accounting.MechanismSpec(MechanismType.LAPLACE, _eps=eps,
+                                           _delta=delta)
+    return combiners.CombinerParams(spec, agg_params)
+
+
+class TestCountCombiner:
+
+    def test_create_merge_compute(self):
+        c = combiners.CountCombiner(
+            combiner_params(make_params([Metrics.COUNT])))
+        acc = c.create_accumulator([1, 2, 3])
+        assert acc == 3
+        acc = c.merge_accumulators(acc, c.create_accumulator([4]))
+        assert acc == 4
+        assert c.compute_metrics(acc)["count"] == pytest.approx(4, abs=0.01)
+        assert c.metrics_names() == ["count"]
+
+
+class TestPrivacyIdCountCombiner:
+
+    def test_zero_or_one_per_create(self):
+        c = combiners.PrivacyIdCountCombiner(
+            combiner_params(make_params([Metrics.PRIVACY_ID_COUNT])))
+        assert c.create_accumulator([1, 2, 3]) == 1
+        assert c.create_accumulator([]) == 0
+        acc = c.merge_accumulators(1, 1)
+        assert c.compute_metrics(acc)["privacy_id_count"] == pytest.approx(
+            2, abs=0.01)
+
+
+class TestSumCombiner:
+
+    def test_per_value_clipping(self):
+        c = combiners.SumCombiner(
+            combiner_params(make_params([Metrics.SUM])))
+        # values clipped to [0, 10]: -5 -> 0, 20 -> 10, 3 -> 3
+        acc = c.create_accumulator([-5, 20, 3])
+        assert acc == 13.0
+        assert c.compute_metrics(acc)["sum"] == pytest.approx(13, abs=0.01)
+
+    def test_per_partition_sum_clipping(self):
+        params = make_params([Metrics.SUM], min_value=None, max_value=None,
+                             min_sum_per_partition=0.0,
+                             max_sum_per_partition=5.0)
+        c = combiners.SumCombiner(combiner_params(params))
+        assert c.create_accumulator([10, 20]) == 5.0  # sum 30 clipped to 5
+        assert c.create_accumulator([-10]) == 0.0
+
+
+class TestMeanCombiner:
+
+    def test_accumulator_is_count_and_normalized_sum(self):
+        c = combiners.MeanCombiner(
+            combiner_params(make_params([Metrics.MEAN])), ["mean"])
+        count, nsum = c.create_accumulator([0.0, 10.0])  # middle 5
+        assert count == 2
+        assert nsum == pytest.approx(0.0)  # (0-5) + (10-5)
+
+    def test_compute_metrics_subset(self):
+        c = combiners.MeanCombiner(
+            combiner_params(make_params([Metrics.MEAN, Metrics.COUNT])),
+            ["mean", "count"])
+        acc = c.create_accumulator([7.0] * 10)
+        out = c.compute_metrics(acc)
+        assert set(out) == {"mean", "count"}
+        assert out["mean"] == pytest.approx(7.0, abs=0.01)
+        assert out["count"] == pytest.approx(10, abs=0.01)
+
+    def test_requires_mean_metric(self):
+        with pytest.raises(ValueError):
+            combiners.MeanCombiner(
+                combiner_params(make_params([Metrics.MEAN])), ["count"])
+
+
+class TestVarianceCombiner:
+
+    def test_variance_computation(self):
+        c = combiners.VarianceCombiner(
+            combiner_params(make_params([Metrics.VARIANCE])), ["variance"])
+        values = [2.0] * 50 + [8.0] * 50
+        acc = c.create_accumulator(values)
+        out = c.compute_metrics(acc)
+        assert out["variance"] == pytest.approx(9.0, abs=0.1)
+
+
+class TestQuantileCombiner:
+
+    def test_percentiles(self):
+        params = combiner_params(
+            make_params([Metrics.PERCENTILE(50), Metrics.PERCENTILE(90)],
+                        min_value=0.0, max_value=100.0))
+        c = combiners.QuantileCombiner(params, [50, 90])
+        rng = np.random.default_rng(0)
+        acc = c.create_accumulator(rng.uniform(0, 100, 2000))
+        out = c.compute_metrics(acc)
+        assert out["percentile_50"] == pytest.approx(50, abs=3)
+        assert out["percentile_90"] == pytest.approx(90, abs=3)
+        assert c.metrics_names() == ["percentile_50", "percentile_90"]
+
+    def test_merge_serialized(self):
+        params = combiner_params(
+            make_params([Metrics.PERCENTILE(50)], min_value=0.0,
+                        max_value=100.0))
+        c = combiners.QuantileCombiner(params, [50])
+        acc = c.merge_accumulators(c.create_accumulator([10.0] * 100),
+                                   c.create_accumulator([90.0] * 100))
+        assert isinstance(acc, bytes)
+        out = c.compute_metrics(acc)
+        assert 5 < out["percentile_50"] < 95
+
+
+class TestVectorSumCombiner:
+
+    def test_create_and_noise(self):
+        params = combiner_params(
+            make_params([Metrics.VECTOR_SUM], min_value=None,
+                        max_value=None,
+                        vector_size=2, vector_max_norm=100.0,
+                        vector_norm_kind=NormKind.Linf))
+        c = combiners.VectorSumCombiner(params)
+        acc = c.create_accumulator([np.array([1.0, 2.0]),
+                                    np.array([3.0, 4.0])])
+        np.testing.assert_allclose(acc, [4.0, 6.0])
+        out = c.compute_metrics(acc)["vector_sum"]
+        np.testing.assert_allclose(out, [4.0, 6.0], atol=0.05)
+
+    def test_shape_mismatch_raises(self):
+        params = combiner_params(
+            make_params([Metrics.VECTOR_SUM], min_value=None,
+                        max_value=None,
+                        vector_size=2, vector_max_norm=100.0))
+        c = combiners.VectorSumCombiner(params)
+        with pytest.raises(TypeError):
+            c.create_accumulator([np.array([1.0, 2.0, 3.0])])
+
+
+class TestCompoundCombiner:
+
+    def _compound(self):
+        params = make_params([Metrics.COUNT, Metrics.SUM])
+        acc = budget_accounting.NaiveBudgetAccountant(total_epsilon=1e5,
+                                                      total_delta=1e-10)
+        compound = combiners.create_compound_combiner(params, acc)
+        acc.compute_budgets()
+        return compound
+
+    def test_row_count_tracks_creates(self):
+        compound = self._compound()
+        a1 = compound.create_accumulator([1.0])
+        a2 = compound.create_accumulator([2.0, 3.0])
+        merged = compound.merge_accumulators(a1, a2)
+        row_count, children = merged
+        assert row_count == 2
+        assert len(children) == 2  # count + sum accumulators
+
+    def test_metrics_tuple_output(self):
+        compound = self._compound()
+        acc = compound.create_accumulator([1.0, 2.0])
+        out = compound.compute_metrics(acc)
+        assert out.count == pytest.approx(2, abs=0.01)
+        assert out.sum == pytest.approx(3.0, abs=0.01)
+
+    def test_metrics_tuple_picklable(self):
+        import pickle
+        compound = self._compound()
+        out = compound.compute_metrics(compound.create_accumulator([1.0]))
+        out2 = pickle.loads(pickle.dumps(out))
+        assert out2 == out
+
+
+class TestCompoundFactory:
+
+    def test_variance_folds_mean_count_sum(self):
+        params = make_params(
+            [Metrics.VARIANCE, Metrics.MEAN, Metrics.COUNT, Metrics.SUM])
+        acc = budget_accounting.NaiveBudgetAccountant(1e5, 1e-10)
+        compound = combiners.create_compound_combiner(params, acc)
+        # All four metrics from ONE VarianceCombiner -> one budget request.
+        assert len(compound.combiners) == 1
+        assert isinstance(compound.combiners[0],
+                          combiners.VarianceCombiner)
+        assert len(acc._mechanisms) == 1
+
+    def test_mean_folds_count_sum(self):
+        params = make_params([Metrics.MEAN, Metrics.COUNT])
+        acc = budget_accounting.NaiveBudgetAccountant(1e5, 1e-10)
+        compound = combiners.create_compound_combiner(params, acc)
+        assert len(compound.combiners) == 1
+        assert isinstance(compound.combiners[0], combiners.MeanCombiner)
+
+    def test_separate_count_sum(self):
+        params = make_params([Metrics.COUNT, Metrics.SUM])
+        acc = budget_accounting.NaiveBudgetAccountant(1e5, 1e-10)
+        compound = combiners.create_compound_combiner(params, acc)
+        assert len(compound.combiners) == 2
+        assert len(acc._mechanisms) == 2
+
+    def test_percentiles_one_budget(self):
+        params = make_params(
+            [Metrics.PERCENTILE(50), Metrics.PERCENTILE(90)],
+            min_value=0.0, max_value=100.0)
+        acc = budget_accounting.NaiveBudgetAccountant(1e5, 1e-10)
+        compound = combiners.create_compound_combiner(params, acc)
+        assert len(compound.combiners) == 1
+        assert len(acc._mechanisms) == 1
+
+    def test_custom_combiners(self):
+
+        class MyCombiner(combiners.CustomCombiner):
+
+            def request_budget(self, accountant):
+                self._spec = accountant.request_budget(
+                    MechanismType.LAPLACE)
+
+            def create_accumulator(self, values):
+                return sum(values)
+
+            def merge_accumulators(self, a, b):
+                return a + b
+
+            def compute_metrics(self, acc):
+                return acc
+
+            def explain_computation(self):
+                return "custom"
+
+        params = AggregateParams(custom_combiners=[MyCombiner()],
+                                 max_partitions_contributed=1,
+                                 max_contributions_per_partition=1)
+        acc = budget_accounting.NaiveBudgetAccountant(1e5, 1e-10)
+        compound = combiners.create_compound_combiner_with_custom_combiners(
+            params, acc, params.custom_combiners)
+        out = compound.compute_metrics(compound.create_accumulator([1, 2]))
+        assert out == (3,)

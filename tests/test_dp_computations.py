@@ -1,0 +1,151 @@
+"""Tests for dp_computations — mirrors the reference's statistical test
+strategy (``tests/dp_computations_test.py``): calibration identities and
+moment checks, plus vectorized-path equivalence (ours accepts arrays)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from pipelinedp_tpu import dp_computations as dpc
+from pipelinedp_tpu.aggregate_params import NoiseKind, NormKind
+from pipelinedp_tpu.ops import noise as noise_ops
+
+
+def scalar_params(eps=2e5, delta=1e-10, min_value=0.0, max_value=10.0,
+                  min_sum=None, max_sum=None, l0=2, linf=3,
+                  noise_kind=NoiseKind.LAPLACE):
+    return dpc.ScalarNoiseParams(
+        eps=eps, delta=delta, min_value=min_value, max_value=max_value,
+        min_sum_per_partition=min_sum, max_sum_per_partition=max_sum,
+        max_partitions_contributed=l0,
+        max_contributions_per_partition=linf, noise_kind=noise_kind)
+
+
+class TestHelpers:
+
+    def test_middle_and_squares(self):
+        assert dpc.compute_middle(2, 10) == 6
+        assert dpc.compute_squares_interval(-3, 2) == (0, 9)
+        assert dpc.compute_squares_interval(1, 4) == (1, 16)
+        assert dpc.compute_squares_interval(-5, -2) == (25, 4)
+
+    def test_equally_split_budget_sums_exactly(self):
+        budgets = dpc.equally_split_budget(1.0, 1e-6, 3)
+        assert len(budgets) == 3
+        assert sum(b[0] for b in budgets) == 1.0
+        assert sum(b[1] for b in budgets) == 1e-6
+        with pytest.raises(ValueError):
+            dpc.equally_split_budget(1.0, 0.0, 0)
+
+
+class TestCount:
+
+    def test_big_eps_deterministic(self):
+        p = scalar_params()
+        assert dpc.compute_dp_count(42, p) == pytest.approx(42, abs=0.01)
+
+    def test_vectorized(self):
+        p = scalar_params()
+        counts = np.array([1.0, 10.0, 100.0])
+        got = dpc.compute_dp_count(counts, p)
+        assert got.shape == (3,)
+        np.testing.assert_allclose(got, counts, atol=0.01)
+
+    def test_noise_std_laplace(self):
+        # linf=3, l0=2 -> L1=6; eps=1 -> b=6 -> std = 6*sqrt(2).
+        p = scalar_params(eps=1.0, noise_kind=NoiseKind.LAPLACE)
+        noise_ops.seed_host_rng(0)
+        draws = np.array([dpc.compute_dp_count(0, p) for _ in range(20000)])
+        assert np.std(draws) == pytest.approx(6 * math.sqrt(2), rel=0.05)
+        assert dpc.compute_dp_count_noise_std(p) == pytest.approx(
+            6 * math.sqrt(2))
+
+    def test_noise_std_gaussian(self):
+        p = scalar_params(eps=1.0, delta=1e-6,
+                          noise_kind=NoiseKind.GAUSSIAN)
+        expected = noise_ops.gaussian_sigma(1.0, 1e-6,
+                                            math.sqrt(2) * 3)
+        assert dpc.compute_dp_count_noise_std(p) == pytest.approx(expected)
+
+
+class TestSum:
+
+    def test_per_value_bounds(self):
+        p = scalar_params()
+        assert dpc.compute_dp_sum(100.0, p) == pytest.approx(100, abs=0.01)
+
+    def test_per_partition_bounds(self):
+        p = scalar_params(min_value=None, max_value=None, min_sum=0.0,
+                          max_sum=5.0)
+        assert dpc.compute_dp_sum(4.0, p) == pytest.approx(4.0, abs=0.01)
+        assert dpc.compute_dp_sum_noise_std(p) > 0
+
+    def test_zero_sensitivity_returns_zero_exactly(self):
+        p = scalar_params(min_value=0.0, max_value=0.0)
+        assert dpc.compute_dp_sum(123.0, p) == 0
+
+
+class TestMeanVariance:
+
+    def test_mean_big_eps(self):
+        p = scalar_params(min_value=0.0, max_value=10.0, linf=1)
+        count, total, mean = dpc.compute_dp_mean(
+            100, 100 * (7.0 - 5.0), p)  # normalized sum: values at 7
+        assert count == pytest.approx(100, abs=0.01)
+        assert mean == pytest.approx(7.0, abs=0.01)
+        assert total == pytest.approx(700.0, rel=0.001)
+
+    def test_mean_degenerate_interval(self):
+        p = scalar_params(min_value=5.0, max_value=5.0, linf=1)
+        _, _, mean = dpc.compute_dp_mean(10, 0.0, p)
+        assert mean == pytest.approx(5.0)
+
+    def test_var_big_eps(self):
+        # Values: half at 2, half at 8 in [0,10]: mean 5, var 9.
+        p = scalar_params(min_value=0.0, max_value=10.0, linf=1)
+        n = 100
+        normalized = (2 - 5) * 50 + (8 - 5) * 50  # 0
+        normalized_sq = 9 * 50 + 9 * 50
+        count, total, mean, var = dpc.compute_dp_var(
+            n, normalized, normalized_sq, p)
+        assert count == pytest.approx(100, abs=0.01)
+        assert mean == pytest.approx(5.0, abs=0.01)
+        assert var == pytest.approx(9.0, abs=0.1)
+
+    def test_vectorized_mean(self):
+        p = scalar_params(min_value=0.0, max_value=10.0, linf=1)
+        counts = np.array([10.0, 20.0])
+        nsums = np.array([10 * 2.0, 20 * -1.0])
+        count, total, mean = dpc.compute_dp_mean(counts, nsums, p)
+        np.testing.assert_allclose(mean, [7.0, 4.0], atol=0.01)
+        np.testing.assert_allclose(count, counts, atol=0.01)
+
+
+class TestVectorSum:
+
+    def _params(self, norm_kind, max_norm=10.0, eps=1e6):
+        return dpc.AdditiveVectorNoiseParams(
+            eps_per_coordinate=eps, delta_per_coordinate=0.0,
+            max_norm=max_norm, l0_sensitivity=1, linf_sensitivity=1,
+            norm_kind=norm_kind, noise_kind=NoiseKind.LAPLACE)
+
+    def test_linf_clipping(self):
+        got = dpc.add_noise_vector(
+            np.array([5.0, -20.0, 15.0]), self._params(NormKind.Linf))
+        np.testing.assert_allclose(got, [5.0, -10.0, 10.0], atol=0.01)
+
+    def test_l2_clipping(self):
+        vec = np.array([30.0, 40.0])  # norm 50, clip to 10 -> [6, 8]
+        got = dpc.add_noise_vector(vec, self._params(NormKind.L2))
+        np.testing.assert_allclose(got, [6.0, 8.0], atol=0.01)
+
+    def test_l1_clipping(self):
+        vec = np.array([15.0, 5.0])  # l1 20, clip to 10 -> [7.5, 2.5]
+        got = dpc.add_noise_vector(vec, self._params(NormKind.L1))
+        np.testing.assert_allclose(got, [7.5, 2.5], atol=0.01)
+
+    def test_zero_vector_unchanged(self):
+        got = dpc.add_noise_vector(
+            np.zeros(3), self._params(NormKind.L2))
+        np.testing.assert_allclose(got, np.zeros(3), atol=0.01)

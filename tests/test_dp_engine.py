@@ -1,0 +1,328 @@
+"""DPEngine tests — big-eps determinism, public partitions, partition
+selection, bounding, reports (mirrors the reference's
+``tests/dp_engine_test.py`` strategy: deterministic DP via huge eps,
+mockable selection boundary, E2E on the local backend)."""
+
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu.ops import noise as noise_ops
+
+BIG_EPS = 1e5
+
+
+def make_engine(eps=BIG_EPS, delta=1e-10, backend=None):
+    backend = backend or pdp.LocalBackend()
+    accountant = pdp.NaiveBudgetAccountant(total_epsilon=eps,
+                                           total_delta=delta)
+    return pdp.DPEngine(accountant, backend), accountant
+
+
+def extractors():
+    return pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                              partition_extractor=lambda r: r[1],
+                              value_extractor=lambda r: r[2])
+
+
+def dataset(n_users=50, partitions=("a", "b", "c"), value=5.0):
+    return [(u, pk, value) for u in range(n_users) for pk in partitions]
+
+
+class TestAggregateCount:
+
+    def test_count_big_eps(self):
+        noise_ops.seed_host_rng(0)
+        engine, acc = make_engine()
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=3,
+                                     max_contributions_per_partition=1)
+        result = engine.aggregate(dataset(), params, extractors())
+        acc.compute_budgets()
+        out = dict(result)
+        assert set(out) == {"a", "b", "c"}
+        for v in out.values():
+            assert v.count == pytest.approx(50, abs=0.5)
+
+    def test_contribution_bounding_caps_counts(self):
+        noise_ops.seed_host_rng(0)
+        # One user contributes 100 rows to one partition; linf=2 caps it.
+        data = [(0, "a", 1.0)] * 100
+        engine, acc = make_engine()
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=2)
+        result = engine.aggregate(data, params, extractors(),
+                                  public_partitions=["a"])
+        acc.compute_budgets()
+        out = dict(result)
+        assert out["a"].count == pytest.approx(2, abs=0.5)
+
+    def test_l0_bounding_drops_partitions(self):
+        noise_ops.seed_host_rng(0)
+        # Each user contributes to 4 partitions, L0 bound = 2: the total
+        # count across partitions must be ~ n_users * 2.
+        data = [(u, pk, 1.0) for u in range(100)
+                for pk in ("a", "b", "c", "d")]
+        engine, acc = make_engine()
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=2,
+                                     max_contributions_per_partition=1)
+        result = engine.aggregate(data, params, extractors(),
+                                  public_partitions=["a", "b", "c", "d"])
+        acc.compute_budgets()
+        total = sum(v.count for v in dict(result).values())
+        assert total == pytest.approx(200, rel=0.15)
+
+
+class TestAggregateMultiMetric:
+
+    def test_count_sum_mean(self):
+        noise_ops.seed_host_rng(1)
+        engine, acc = make_engine()
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.MEAN, pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            max_partitions_contributed=3,
+            max_contributions_per_partition=1, min_value=0.0,
+            max_value=10.0)
+        result = engine.aggregate(dataset(value=5.0), params, extractors())
+        acc.compute_budgets()
+        out = dict(result)
+        for v in out.values():
+            assert v.count == pytest.approx(50, abs=0.5)
+            assert v.sum == pytest.approx(250, rel=0.01)
+            assert v.mean == pytest.approx(5.0, abs=0.05)
+
+    def test_variance(self):
+        noise_ops.seed_host_rng(2)
+        data = [(u, "a", 2.0) for u in range(100)] + [
+            (u, "a", 8.0) for u in range(100, 200)
+        ]
+        engine, acc = make_engine()
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.VARIANCE],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1,
+                                     min_value=0.0, max_value=10.0)
+        result = engine.aggregate(data, params, extractors())
+        acc.compute_budgets()
+        out = dict(result)
+        assert out["a"].variance == pytest.approx(9.0, abs=0.3)
+
+    def test_percentiles(self):
+        noise_ops.seed_host_rng(3)
+        rng = np.random.default_rng(0)
+        data = [(u, "a", float(v))
+                for u, v in enumerate(rng.uniform(0, 100, 2000))]
+        engine, acc = make_engine()
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.PERCENTILE(50),
+                     pdp.Metrics.PERCENTILE(90)],
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1, min_value=0.0,
+            max_value=100.0)
+        result = engine.aggregate(data, params, extractors())
+        acc.compute_budgets()
+        out = dict(result)
+        assert out["a"].percentile_50 == pytest.approx(50, abs=5)
+        assert out["a"].percentile_90 == pytest.approx(90, abs=5)
+
+
+class TestPublicPartitions:
+
+    def test_empty_public_partition_injected(self):
+        noise_ops.seed_host_rng(0)
+        engine, acc = make_engine()
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=3,
+                                     max_contributions_per_partition=1)
+        result = engine.aggregate(dataset(partitions=("a",)), params,
+                                  extractors(),
+                                  public_partitions=["a", "zz"])
+        acc.compute_budgets()
+        out = dict(result)
+        assert set(out) == {"a", "zz"}
+        assert out["zz"].count == pytest.approx(0, abs=0.5)
+
+    def test_non_public_partitions_dropped(self):
+        noise_ops.seed_host_rng(0)
+        engine, acc = make_engine()
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=3,
+                                     max_contributions_per_partition=1)
+        result = engine.aggregate(dataset(), params, extractors(),
+                                  public_partitions=["a"])
+        acc.compute_budgets()
+        assert set(dict(result)) == {"a"}
+
+
+class TestPrivatePartitionSelection:
+
+    def test_small_partitions_dropped(self):
+        noise_ops.seed_host_rng(0)
+        # Partition 'big' has 1000 users, 'tiny' has 1: with reasonable
+        # eps/delta 'big' survives, 'tiny' is dropped.
+        data = [(u, "big", 1.0) for u in range(1000)] + [(2000, "tiny", 1.0)]
+        engine, acc = make_engine(eps=1.0, delta=1e-6)
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1)
+        result = engine.aggregate(data, params, extractors())
+        acc.compute_budgets()
+        out = dict(result)
+        assert "big" in out
+        assert "tiny" not in out
+
+    @pytest.mark.parametrize("strategy", [
+        pdp.PartitionSelectionStrategy.TRUNCATED_GEOMETRIC,
+        pdp.PartitionSelectionStrategy.LAPLACE_THRESHOLDING,
+        pdp.PartitionSelectionStrategy.GAUSSIAN_THRESHOLDING,
+    ])
+    def test_all_strategies_run(self, strategy):
+        noise_ops.seed_host_rng(0)
+        data = [(u, "big", 1.0) for u in range(1000)]
+        engine, acc = make_engine(eps=1.0, delta=1e-6)
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1,
+                                     partition_selection_strategy=strategy)
+        result = engine.aggregate(data, params, extractors())
+        acc.compute_budgets()
+        assert "big" in dict(result)
+
+    def test_pre_threshold_blocks(self):
+        noise_ops.seed_host_rng(0)
+        data = [(u, "mid", 1.0) for u in range(50)]
+        engine, acc = make_engine(eps=BIG_EPS, delta=1e-6)
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1,
+                                     pre_threshold=100)
+        result = engine.aggregate(data, params, extractors())
+        acc.compute_budgets()
+        assert dict(result) == {}
+
+
+class TestSelectPartitions:
+
+    def test_select_partitions_basic(self):
+        noise_ops.seed_host_rng(0)
+        data = [(u, "big") for u in range(1000)] + [(1, "tiny")]
+        engine, acc = make_engine(eps=1.0, delta=1e-6)
+        params = pdp.SelectPartitionsParams(max_partitions_contributed=1)
+        ext = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                                 partition_extractor=lambda r: r[1])
+        result = engine.select_partitions(data, params, ext)
+        acc.compute_budgets()
+        got = list(result)
+        assert "big" in got
+        assert "tiny" not in got
+
+
+class TestBoundsAlreadyEnforced:
+
+    def test_no_privacy_id_needed(self):
+        noise_ops.seed_host_rng(0)
+        data = [("a", 4.0)] * 100
+        engine, acc = make_engine()
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.SUM], max_partitions_contributed=1,
+            max_contributions_per_partition=1, min_value=0.0,
+            max_value=10.0, contribution_bounds_already_enforced=True)
+        ext = pdp.DataExtractors(partition_extractor=lambda r: r[0],
+                                 value_extractor=lambda r: r[1])
+        result = engine.aggregate(data, params, ext)
+        acc.compute_budgets()
+        out = dict(result)
+        assert out["a"].sum == pytest.approx(400.0, rel=0.01)
+
+
+class TestValidation:
+
+    def test_empty_col_rejected(self):
+        engine, _ = make_engine()
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1)
+        with pytest.raises(ValueError, match="non-empty"):
+            engine.aggregate([], params, extractors())
+
+    def test_max_contributions_not_supported(self):
+        engine, _ = make_engine()
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_contributions=5)
+        with pytest.raises(NotImplementedError):
+            engine.aggregate([1], params, extractors())
+
+    def test_wrong_types(self):
+        engine, _ = make_engine()
+        with pytest.raises(ValueError):
+            engine.aggregate([1], None, extractors())
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1)
+        with pytest.raises(TypeError):
+            engine.aggregate([1], params, "not extractors")
+
+
+class TestExplainComputation:
+
+    def test_report_content(self):
+        noise_ops.seed_host_rng(0)
+        engine, acc = make_engine()
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=3,
+                                     max_contributions_per_partition=1)
+        report = pdp.ExplainComputationReport()
+        result = engine.aggregate(dataset(), params, extractors(),
+                                  out_explain_computation_report=report)
+        acc.compute_budgets()
+        list(result)
+        text = report.text()
+        assert "DPEngine method: aggregate" in text
+        assert "COUNT" in text
+        assert "Partition selection" in text
+        assert "Computed count" in text
+
+    def test_report_before_budget_raises(self):
+        report = pdp.ExplainComputationReport()
+        with pytest.raises(ValueError):
+            report.text()
+
+
+class TestMultiProcEndToEnd:
+
+    def test_count_on_multiproc(self):
+        noise_ops.seed_host_rng(0)
+        backend = pdp.MultiProcLocalBackend(n_jobs=2)
+        engine, acc = make_engine(backend=backend)
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=3,
+                                     max_contributions_per_partition=1)
+        result = engine.aggregate(dataset(n_users=30), params,
+                                  _module_extractors(),
+                                  public_partitions=["a", "b", "c"])
+        acc.compute_budgets()
+        out = dict(result)
+        for v in out.values():
+            assert v.count == pytest.approx(30, abs=0.5)
+
+
+# Module-level extractor functions: picklable for multiprocessing.
+
+
+def _pid(r):
+    return r[0]
+
+
+def _pk(r):
+    return r[1]
+
+
+def _val(r):
+    return r[2]
+
+
+def _module_extractors():
+    return pdp.DataExtractors(privacy_id_extractor=_pid,
+                              partition_extractor=_pk,
+                              value_extractor=_val)

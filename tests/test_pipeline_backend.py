@@ -1,0 +1,193 @@
+"""Backend conformance suite: every op of every backend is tested against
+the same expected collections (the reference's pattern,
+``tests/pipeline_backend_test.py:31-614``). Multiprocessing functions live
+at module level so they pickle into worker processes."""
+
+import operator
+
+import pytest
+
+from pipelinedp_tpu import pipeline_backend
+from pipelinedp_tpu.ops import noise as noise_ops
+
+
+def double(x):
+    return 2 * x
+
+
+def explode(x):
+    return [x, x]
+
+
+def add_pair(a, b):
+    return a + b
+
+
+def is_even(x):
+    return x % 2 == 0
+
+
+def kv_swap(k, v):
+    return (v, k)
+
+
+class _SumCombiner:
+
+    def merge_accumulators(self, a, b):
+        return a + b
+
+
+def _run(col):
+    """Materializes any backend collection (element order is not part of
+    the op contract, so results are sorted)."""
+    return sorted(list(col))
+
+
+BACKENDS = [
+    pipeline_backend.LocalBackend(),
+    pipeline_backend.MultiProcLocalBackend(n_jobs=2, chunk_size=4),
+]
+IDS = ["local", "multiproc"]
+
+
+@pytest.mark.parametrize("backend", BACKENDS, ids=IDS)
+class TestBackendConformance:
+
+    def test_map(self, backend):
+        assert _run(backend.map([1, 2, 3], double, "map")) == [2, 4, 6]
+
+    def test_flat_map(self, backend):
+        assert _run(backend.flat_map([1, 2], explode,
+                                     "fm")) == [1, 1, 2, 2]
+
+    def test_map_tuple(self, backend):
+        got = _run(backend.map_tuple([(1, "a"), (2, "b")], kv_swap, "mt"))
+        assert got == [("a", 1), ("b", 2)]
+
+    def test_map_values(self, backend):
+        got = _run(backend.map_values([(1, 2), (2, 3)], double, "mv"))
+        assert got == [(1, 4), (2, 6)]
+
+    def test_group_by_key(self, backend):
+        got = dict(backend.group_by_key([(1, "a"), (2, "b"), (1, "c")],
+                                        "gbk"))
+        assert sorted(got[1]) == ["a", "c"]
+        assert got[2] == ["b"]
+
+    def test_filter(self, backend):
+        assert _run(backend.filter([1, 2, 3, 4], is_even, "f")) == [2, 4]
+
+    def test_filter_by_key(self, backend):
+        col = [(1, "a"), (2, "b"), (3, "c")]
+        got = _run(backend.filter_by_key(col, [1, 3], "fbk"))
+        assert got == [(1, "a"), (3, "c")]
+
+    def test_keys_values(self, backend):
+        col = [(1, "a"), (2, "b")]
+        assert _run(backend.keys(col, "k")) == [1, 2]
+        assert _run(backend.values(col, "v")) == ["a", "b"]
+
+    def test_sample_fixed_per_key(self, backend):
+        noise_ops.seed_host_rng(0)
+        col = [(1, i) for i in range(100)] + [(2, 0)]
+        got = dict(backend.sample_fixed_per_key(col, 5, "sample"))
+        assert len(got[1]) == 5
+        assert set(got[1]) <= set(range(100))
+        assert got[2] == [0]
+
+    def test_count_per_element(self, backend):
+        got = dict(backend.count_per_element(["a", "b", "a"], "cpe"))
+        assert got == {"a": 2, "b": 1}
+
+    def test_sum_per_key(self, backend):
+        got = dict(backend.sum_per_key([(1, 2), (1, 3), (2, 5)], "spk"))
+        assert got == {1: 5, 2: 5}
+
+    def test_combine_accumulators_per_key(self, backend):
+        got = dict(
+            backend.combine_accumulators_per_key(
+                [(1, 2), (1, 3), (2, 5)], _SumCombiner(), "combine"))
+        assert got == {1: 5, 2: 5}
+
+    def test_reduce_per_key(self, backend):
+        got = dict(
+            backend.reduce_per_key([(1, 2), (1, 3)], add_pair, "reduce"))
+        assert got == {1: 5}
+
+    def test_flatten(self, backend):
+        got = _run(backend.flatten(([1, 2], [3]), "flat"))
+        assert got == [1, 2, 3]
+
+    def test_distinct(self, backend):
+        assert _run(backend.distinct([1, 2, 1, 3], "d")) == [1, 2, 3]
+
+    def test_to_list(self, backend):
+        got = list(backend.to_list([1, 2, 3], "tl"))
+        assert got == [[1, 2, 3]]
+
+    def test_laziness_chain(self, backend):
+        # A multi-stage chain end-to-end.
+        col = backend.map([1, 2, 3, 4], double, "m")  # 2,4,6,8
+        col = backend.filter(col, is_even, "f")  # all
+        col = backend.map(col, double, "m2")  # 4,8,12,16
+        assert _run(col) == [4, 8, 12, 16]
+
+
+class TestLocalBackendLaziness:
+
+    def test_generators_are_lazy(self):
+        calls = []
+
+        def track(x):
+            calls.append(x)
+            return x
+
+        backend = pipeline_backend.LocalBackend()
+        col = backend.map([1, 2, 3], track, "m")
+        assert calls == []  # nothing executed yet
+        list(col)
+        assert calls == [1, 2, 3]
+
+    def test_to_multi_transformable(self):
+        backend = pipeline_backend.LocalBackend()
+        col = backend.map([1, 2], double, "m")
+        col = backend.to_multi_transformable_collection(col)
+        assert list(col) == [2, 4]
+        assert list(col) == [2, 4]  # second pass works
+
+
+class TestUniqueLabels:
+
+    def test_unique_labels(self):
+        gen = pipeline_backend.UniqueLabelsGenerator("sfx")
+        a = gen.unique("stage")
+        b = gen.unique("stage")
+        c = gen.unique("")
+        assert a == "stage_sfx"
+        assert b == "stage_1_sfx"
+        assert "UNDEFINED" in c
+        assert len({a, b, c}) == 3
+
+
+class TestAnnotators:
+
+    def test_annotator_applied(self):
+
+        class Recorder(pipeline_backend.Annotator):
+
+            def __init__(self):
+                self.calls = []
+
+            def annotate(self, col, params=None, budget=None):
+                self.calls.append((params, budget))
+                return col
+
+        rec = Recorder()
+        pipeline_backend.register_annotator(rec)
+        try:
+            backend = pipeline_backend.LocalBackend()
+            col = backend.annotate([1, 2], "ann", params="p", budget="b")
+            assert list(col) == [1, 2]
+            assert rec.calls == [("p", "b")]
+        finally:
+            pipeline_backend._annotators.remove(rec)
