@@ -1,0 +1,4 @@
+"""Execution backends. The host backends live in
+``pipelinedp_tpu.pipeline_backend``; this package holds the TPU plane."""
+
+from pipelinedp_tpu.backends.jax_backend import JaxBackend

@@ -1,0 +1,38 @@
+"""JaxBackend — the TPU-native execution plane.
+
+For standard aggregations (COUNT / PRIVACY_ID_COUNT / SUM / MEAN /
+VARIANCE / VECTOR_SUM) the engine bypasses the op-by-op graph entirely and
+lowers to the fused XLA program in ``pipelinedp_tpu.jax_engine`` (one
+device program for bounding + combine + selection + noise). Everything
+else (percentiles, custom combiners, the analysis graphs, arbitrary user
+``map``s) falls back to the host generator semantics inherited from
+``LocalBackend`` — correctness everywhere, compiled speed on the hot
+path.
+
+Multi-chip execution goes through ``pipelinedp_tpu.parallel`` (shard rows
+over a ``jax.sharding.Mesh``, per-shard segment reduction, ``psum`` for
+the per-partition accumulator exchange); construct the backend with a
+mesh to enable it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from pipelinedp_tpu.pipeline_backend import LocalBackend
+
+
+class JaxBackend(LocalBackend):
+    """Marker + host-fallback backend for the fused JAX plane.
+
+    Attributes:
+      mesh: optional ``jax.sharding.Mesh`` for multi-chip runs (rows are
+        sharded by privacy id over the first mesh axis).
+      rng_seed: optional fixed seed for reproducible runs (tests).
+    """
+
+    supports_fused_aggregation = True
+
+    def __init__(self, mesh=None, rng_seed: Optional[int] = None):
+        self.mesh = mesh
+        self.rng_seed = rng_seed

@@ -96,6 +96,15 @@ class DPEngine:
                                           budget=budget)
 
     def _aggregate(self, col, params, data_extractors, public_partitions):
+        if getattr(self._backend, "supports_fused_aggregation", False):
+            from pipelinedp_tpu import jax_engine
+            if jax_engine.params_are_fusable(params):
+                return jax_engine.build_fused_aggregation(
+                    col, params, data_extractors, public_partitions,
+                    self._budget_accountant,
+                    self._current_report_generator,
+                    rng_seed=getattr(self._backend, "rng_seed", None),
+                    mesh=getattr(self._backend, "mesh", None))
         if params.custom_combiners:
             combiner = combiners.create_compound_combiner_with_custom_combiners(
                 params, self._budget_accountant, params.custom_combiners)

@@ -1,0 +1,747 @@
+"""The fused TPU aggregation path.
+
+This is the performance core of the framework: the entire
+``DPEngine.aggregate`` dataflow (reference call stack §3.1 of SURVEY.md —
+extract → bound contributions → combine per key → select partitions →
+noise) compiled into ONE XLA program over integer-encoded arrays:
+
+    host:   extract + integer-encode (pid, pk, value); calibrate noise
+    device: lexsort by (pid, pk, rand)            [shuffle 1+2 fused]
+            → segment boundaries per (pid, pk)
+            → linf bound  = rank-in-segment < max_contributions_per_partition
+            → per-segment accumulators (segment_sum)    [create_accumulator]
+            → L0 bound    = random rank of segment within pid < l0
+            → per-pk accumulators (segment_sum)         [merge/combine]
+            → batched partition selection over the pk axis
+            → one batched noise draw per mechanism
+    host:   decode pk vocabulary, wrap MetricsTuple rows
+
+Two-phase budget protocol: noise scales, selection tables/thresholds and
+the PRNG key are *runtime inputs* to the compiled function — budgets are
+computed after graph construction and never trigger recompilation. Shapes
+are padded to powers of two so repeated runs with similar sizes reuse the
+compile cache.
+
+Supported in the fused plane: COUNT, PRIVACY_ID_COUNT, SUM (both clipping
+modes), MEAN, VARIANCE, VECTOR_SUM, public and private partitions,
+``contribution_bounds_already_enforced``. PERCENTILE falls back to the
+generic backend graph (dense-tree batching lands with the analysis work).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from pipelinedp_tpu import dp_computations
+from pipelinedp_tpu.aggregate_params import (AggregateParams, NoiseKind,
+                                             NormKind,
+                                             PartitionSelectionStrategy)
+from pipelinedp_tpu.combiners import _create_named_tuple_instance
+from pipelinedp_tpu.ops import partition_selection as ps_ops
+from pipelinedp_tpu.ops import segment as seg_ops
+
+
+def _pad_pow2(n: int, minimum: int = 8) -> int:
+    return max(minimum, 1 << (n - 1).bit_length())
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedConfig:
+    """Static (compile-time) configuration derived from AggregateParams."""
+    metrics: Tuple[str, ...]  # subset of the fused metric names, in order
+    noise_kind: NoiseKind
+    linf: Optional[int]
+    l0: int
+    per_partition_bounds: bool  # SUM clips the per-(pid,pk) sum, not rows
+    min_value: Optional[float]
+    max_value: Optional[float]
+    min_sum_per_partition: Optional[float]
+    max_sum_per_partition: Optional[float]
+    vector_size: Optional[int]
+    vector_norm_kind: Optional[NormKind]
+    vector_max_norm: Optional[float]
+    selection: Optional[PartitionSelectionStrategy]  # None = public
+    bounds_already_enforced: bool
+
+    @staticmethod
+    def from_params(params: AggregateParams,
+                    public: bool) -> "FusedConfig":
+        names = []
+        for m in params.metrics:
+            names.append(m.name)
+        return FusedConfig(
+            metrics=tuple(names),
+            noise_kind=params.noise_kind,
+            linf=params.max_contributions_per_partition,
+            l0=params.max_partitions_contributed,
+            per_partition_bounds=params.bounds_per_partition_are_set,
+            min_value=params.min_value,
+            max_value=params.max_value,
+            min_sum_per_partition=params.min_sum_per_partition,
+            max_sum_per_partition=params.max_sum_per_partition,
+            vector_size=params.vector_size,
+            vector_norm_kind=params.vector_norm_kind,
+            vector_max_norm=params.vector_max_norm,
+            selection=(None if public else
+                       params.partition_selection_strategy),
+            bounds_already_enforced=(
+                params.contribution_bounds_already_enforced),
+        )
+
+
+FUSABLE_METRICS = {"COUNT", "PRIVACY_ID_COUNT", "SUM", "MEAN", "VARIANCE",
+                   "VECTOR_SUM"}
+
+
+def params_are_fusable(params: AggregateParams) -> bool:
+    if params.custom_combiners:
+        return False
+    return all(m.name in FUSABLE_METRICS for m in params.metrics)
+
+
+# ---------------------------------------------------------------------------
+# Host-side encoding
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class EncodedData:
+    """Integer-encoded rows + the pk vocabulary for decoding."""
+    pid: np.ndarray  # int32 [N]
+    pk: np.ndarray  # int32 [N]
+    values: np.ndarray  # f32 [N] or [N, D]
+    pk_vocab: List[Any]  # dense pk index -> original key
+    n_rows: int
+
+
+def encode(rows, data_extractors, vector_size: Optional[int],
+           public_partitions: Optional[Sequence] = None) -> EncodedData:
+    """Extract + integer-encode on host. With public partitions the pk
+    vocabulary IS the public list — non-public rows are dropped and missing
+    public partitions appear as all-zero accumulator rows for free."""
+    pids, pks, vals = [], [], []
+    pid_ex = data_extractors.privacy_id_extractor
+    pk_ex = data_extractors.partition_extractor
+    val_ex = data_extractors.value_extractor
+    for row in rows:
+        pids.append(pid_ex(row) if pid_ex else 0)
+        pks.append(pk_ex(row))
+        vals.append(val_ex(row) if val_ex else 0.0)
+
+    if public_partitions is not None:
+        pk_vocab = list(public_partitions)
+        pk_index = {k: i for i, k in enumerate(pk_vocab)}
+        keep = [i for i, k in enumerate(pks) if k in pk_index]
+        pids = [pids[i] for i in keep]
+        vals = [vals[i] for i in keep]
+        pk_idx = np.fromiter((pk_index[pks[i]] for i in keep),
+                             dtype=np.int32, count=len(keep))
+    else:
+        uniq = sorted(set(pks), key=repr)
+        pk_index = {k: i for i, k in enumerate(uniq)}
+        pk_vocab = uniq
+        pk_idx = np.fromiter((pk_index[k] for k in pks), dtype=np.int32,
+                             count=len(pks))
+
+    uniq_pids = {p: i for i, p in enumerate(dict.fromkeys(pids))}
+    pid_idx = np.fromiter((uniq_pids[p] for p in pids), dtype=np.int32,
+                          count=len(pids))
+    if vector_size:
+        values = np.asarray(vals, dtype=np.float32).reshape(
+            len(vals), vector_size)
+    else:
+        values = np.asarray(vals, dtype=np.float32)
+    return EncodedData(pid=pid_idx, pk=pk_idx, values=values,
+                       pk_vocab=pk_vocab, n_rows=len(pid_idx))
+
+
+# ---------------------------------------------------------------------------
+# The fused kernel
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("config", "num_partitions"))
+def fused_aggregate_kernel(config: FusedConfig, num_partitions: int, pid,
+                           pk, values, valid, noise_scales, keep_table,
+                           sel_threshold, sel_scale, sel_min_count,
+                           sel_rows_per_uid, key):
+    """One compiled program for the whole aggregation. See module docstring.
+
+    Runtime inputs:
+      pid, pk: int32[N] (padded); values: f32[N] or f32[N, D]; valid:
+      bool[N] row mask; noise_scales: f32[K] per-mechanism noise scales in
+      metric order (see _noise_scales); keep_table: f32[T] truncated-
+      geometric keep probabilities (unused for thresholding strategies);
+      sel_threshold/sel_scale: f32 scalars for thresholding strategies;
+      key: PRNG key.
+    """
+    k_bound, k_sel, k_noise = jax.random.split(key, 3)
+    part, part_nseg = _partials(config, num_partitions, pid, pk, values,
+                                valid, k_bound)
+    return _selection_and_metrics(config, num_partitions, part, part_nseg,
+                                  noise_scales, keep_table, sel_threshold,
+                                  sel_scale, sel_min_count,
+                                  sel_rows_per_uid, k_sel, k_noise)
+
+
+def _partials(config: FusedConfig, num_partitions: int, pid, pk, values,
+              valid, key):
+    """Contribution bounding + per-pk accumulator partials. Shardable by
+    privacy id: every pid's rows must live in one shard, pks may be
+    spread — partials then combine across shards by plain addition
+    (psum)."""
+    n = pid.shape[0]
+    P = num_partitions
+    k_sort, k_l0 = jax.random.split(key, 2)
+
+    if config.bounds_already_enforced:
+        # No privacy ids: every row is its own "segment"; no sampling.
+        seg_pk = jnp.where(valid, pk, 0)
+        seg_valid = valid
+        row_keep = valid
+        seg_of_row = jnp.arange(n)
+        seg_count = row_keep.astype(jnp.float32)
+        clipped = _clip_values(config, values)
+        seg_values = jnp.where(
+            _expand(row_keep, clipped), clipped, 0.0)
+        seg_sums = _segment_fields(config, seg_values, seg_count,
+                                   seg_of_row, n)
+        keep_seg = seg_valid
+        seg_pk_final = seg_pk
+    else:
+        sort_idx, spid, spk = seg_ops.sort_rows(k_sort, pid, pk, valid)
+        svalid = valid[sort_idx]
+        svalues = values[sort_idx]
+        seg_id, new_seg = seg_ops.segment_ids(spid, spk)
+        rank = seg_ops.rank_in_segment(seg_id, new_seg)
+        # Linf bound: keep the first linf (randomly ordered) rows.
+        row_keep = svalid & (rank < config.linf)
+        clipped = _clip_values(config, svalues)
+        masked = jnp.where(_expand(row_keep, clipped), clipped, 0.0)
+        seg_count = jax.ops.segment_sum(row_keep.astype(jnp.float32),
+                                        seg_id, num_segments=n)
+        seg_sums = _segment_fields(config, masked, seg_count, seg_id, n)
+        # Segment -> (pid, pk) mapping.
+        seg_pid = seg_ops.per_segment_first(spid, seg_id, new_seg, n)
+        seg_pk_final = seg_ops.per_segment_first(spk, seg_id, new_seg, n)
+        seg_valid = jax.ops.segment_sum(svalid.astype(jnp.int32), seg_id,
+                                        num_segments=n) > 0
+        # L0 bound: keep at most l0 segments per pid, randomly.
+        l0_rank = seg_ops.rank_within_group(seg_pid, k_l0, seg_valid)
+        keep_seg = seg_valid & (l0_rank < config.l0)
+        seg_pk_final = jnp.where(keep_seg, seg_pk_final, 0)
+
+    # --- per-pk reduction (shuffle 3 fused into a segment_sum) ---
+    kf = keep_seg.astype(jnp.float32)
+    part = {}
+    for name, arr in seg_sums.items():
+        contrib = jnp.where(_expand(keep_seg, arr), arr, 0.0)
+        part[name] = jax.ops.segment_sum(contrib, seg_pk_final,
+                                         num_segments=P)
+    # Privacy-id count per pk = number of kept segments (row_count in the
+    # reference's compound accumulator, dp_engine.py:339).
+    part_nseg = jax.ops.segment_sum(kf, seg_pk_final, num_segments=P)
+    return part, part_nseg
+
+
+def _selection_and_metrics(config: FusedConfig, num_partitions: int, part,
+                           part_nseg, noise_scales, keep_table,
+                           sel_threshold, sel_scale, sel_min_count,
+                           sel_rows_per_uid, k_sel, k_noise):
+    """Batched partition selection + metric noising over the full pk axis.
+    Runs replicated in the multi-chip path (identical keys on every
+    device)."""
+    P = num_partitions
+    # --- partition selection (batched over all partitions) ---
+    if config.selection is None:
+        keep_pk = jnp.ones(P, dtype=bool)
+        if config.per_partition_bounds:
+            # Public-partition parity with the generic path: every public
+            # partition receives one empty accumulator whose clipped sum is
+            # clip(0, min_sum, max_sum) (reference
+            # _add_empty_public_partitions + SumCombiner.create([])).
+            empty_sum = float(
+                np.clip(0.0, config.min_sum_per_partition,
+                        config.max_sum_per_partition))
+            if "sum" in part:
+                part = dict(part)
+                part["sum"] = part["sum"] + empty_sum
+    else:
+        # Without privacy ids one row is not one user; the conservative
+        # user-count estimate is ceil(rows / max_rows_per_privacy_id)
+        # (reference dp_engine.py:341-348).
+        est_users = jnp.ceil(part_nseg / sel_rows_per_uid)
+        counts = est_users.astype(jnp.int32)
+        if config.selection == (
+                PartitionSelectionStrategy.TRUNCATED_GEOMETRIC):
+            idx = jnp.clip(counts, 0, keep_table.shape[0] - 1)
+            p_keep = keep_table[idx]
+            keep_pk = jax.random.uniform(k_sel, (P,)) < p_keep
+        else:
+            if config.selection == (
+                    PartitionSelectionStrategy.LAPLACE_THRESHOLDING):
+                noise_sel = jax.random.laplace(k_sel, (P,)) * sel_scale
+            else:
+                noise_sel = jax.random.normal(k_sel, (P,)) * sel_scale
+            keep_pk = ((est_users + noise_sel) >= sel_threshold) & (
+                est_users >= sel_min_count)  # pre-threshold hard floor
+        keep_pk = keep_pk & (part_nseg > 0)
+
+    # --- metrics + one batched noise draw per mechanism ---
+    metrics = _compute_metrics(config, part, part_nseg, noise_scales,
+                               k_noise, P)
+    return keep_pk, metrics
+
+
+def _expand(mask, like):
+    """Broadcasts a [N] mask against [N] or [N, D] data."""
+    if like.ndim == 2:
+        return mask[:, None]
+    return mask
+
+
+def _clip_values(config: FusedConfig, values):
+    if config.vector_size:
+        if config.vector_norm_kind == NormKind.Linf:
+            # Per-coordinate clip can be applied per row.
+            return values  # clipping happens on the summed vector
+        return values
+    if config.per_partition_bounds or config.min_value is None:
+        return values
+    return jnp.clip(values, config.min_value, config.max_value)
+
+
+def _segment_fields(config: FusedConfig, masked_values, seg_count, seg_id,
+                    num_segments) -> Dict[str, jnp.ndarray]:
+    """Per-(pid,pk) accumulator columns — the fused create_accumulator."""
+    out = {"count": seg_count}
+    names = set(config.metrics)
+    if "VECTOR_SUM" in names:
+        out["vector_sum"] = jax.ops.segment_sum(
+            masked_values, seg_id, num_segments=num_segments)
+        return out
+    if "SUM" in names:
+        ssum = jax.ops.segment_sum(masked_values, seg_id,
+                                   num_segments=num_segments)
+        if config.per_partition_bounds:
+            ssum = jnp.clip(ssum, config.min_sum_per_partition,
+                            config.max_sum_per_partition)
+        out["sum"] = ssum
+    if "MEAN" in names or "VARIANCE" in names:
+        middle = dp_computations.compute_middle(config.min_value,
+                                                config.max_value)
+        # Masked-out rows are zeroed, so they must not contribute -middle:
+        # sum(clip(x) - middle over kept rows) = raw_sum - middle * count.
+        raw_sum = jax.ops.segment_sum(masked_values, seg_id,
+                                      num_segments=num_segments)
+        out["nsum"] = raw_sum - middle * seg_count
+        if "VARIANCE" in names:
+            raw_sumsq = jax.ops.segment_sum(masked_values**2, seg_id,
+                                            num_segments=num_segments)
+            # sum((x-mid)^2) = sum(x^2) - 2 mid sum(x) + count mid^2
+            out["nsumsq"] = (raw_sumsq - 2.0 * middle * raw_sum +
+                             seg_count * middle * middle)
+    return out
+
+
+
+
+def _compute_metrics(config: FusedConfig, part, part_nseg, noise_scales,
+                     key, P):
+    """Vectorized mirror of dp_computations.compute_dp_* over the pk axis.
+    ``noise_scales`` is indexed in the order produced by _noise_scales."""
+    keys = jax.random.split(key, 8)
+    names = set(config.metrics)
+    out = {}
+    si = 0
+
+    def draw(k, shape):
+        if config.noise_kind == NoiseKind.LAPLACE:
+            return jax.random.laplace(k, shape)
+        return jax.random.normal(k, shape)
+
+    if "VARIANCE" in names or "MEAN" in names:
+        count = part["count"]
+        dp_count = count + draw(keys[0], (P,)) * noise_scales[si]
+        si += 1
+        dp_nmean = (part["nsum"] + draw(keys[1], (P,)) * noise_scales[si]
+                    ) / jnp.maximum(1.0, dp_count)
+        si += 1
+        middle = dp_computations.compute_middle(config.min_value,
+                                                config.max_value)
+        if "VARIANCE" in names:
+            dp_nmean_sq = (part["nsumsq"] +
+                           draw(keys[2], (P,)) * noise_scales[si]
+                           ) / jnp.maximum(1.0, dp_count)
+            si += 1
+            out["variance"] = dp_nmean_sq - dp_nmean**2
+        dp_mean = dp_nmean + middle
+        if config.min_value == config.max_value:
+            dp_mean = jnp.full((P,), config.min_value)
+        out["mean"] = dp_mean
+        if "COUNT" in names:
+            out["count"] = dp_count
+        if "SUM" in names:
+            out["sum"] = dp_mean * dp_count
+        if "VARIANCE" not in names:
+            out.pop("variance", None)
+        if "MEAN" not in names:
+            out.pop("mean", None)
+    else:
+        if "COUNT" in names:
+            out["count"] = part["count"] + draw(keys[0],
+                                                (P,)) * noise_scales[si]
+            si += 1
+        if "SUM" in names:
+            out["sum"] = part["sum"] + draw(keys[1],
+                                            (P,)) * noise_scales[si]
+            si += 1
+    if "PRIVACY_ID_COUNT" in names:
+        out["privacy_id_count"] = part_nseg + draw(keys[3],
+                                                   (P,)) * noise_scales[si]
+        si += 1
+    if "VECTOR_SUM" in names:
+        vec = part["vector_sum"]
+        vec = _apply_vector_norm_clip(config, vec)
+        out["vector_sum"] = vec + draw(keys[4],
+                                       vec.shape) * noise_scales[si]
+        si += 1
+    return out
+
+
+def _apply_vector_norm_clip(config: FusedConfig, vec):
+    """Clips the per-pk vector by the configured norm before noising —
+    exactly where the reference clips (``dp_computations.py:189-222``:
+    ``add_noise_vector`` clips the queried vector, then noises)."""
+    max_norm = config.vector_max_norm
+    kind = config.vector_norm_kind
+    if kind == NormKind.Linf:
+        return jnp.clip(vec, -max_norm, max_norm)
+    ord_ = 1 if kind == NormKind.L1 else 2
+    norms = jnp.linalg.norm(vec, ord=ord_, axis=-1, keepdims=True)
+    factor = jnp.minimum(1.0, max_norm / jnp.maximum(norms, 1e-30))
+    return vec * factor
+
+
+# ---------------------------------------------------------------------------
+# Budget -> runtime inputs
+# ---------------------------------------------------------------------------
+
+
+def _noise_scales(config: FusedConfig,
+                  specs: Dict[str, Any]) -> np.ndarray:
+    """Per-mechanism noise scales in the order _compute_metrics consumes
+    them. For Laplace the scale is b = L1/eps; for Gaussian it is sigma."""
+    from pipelinedp_tpu.ops import noise as noise_ops
+
+    scales = []
+    names = set(config.metrics)
+    l0 = config.l0
+    linf = config.linf
+
+    def scale(eps, delta, linf_sens):
+        if linf_sens == 0:
+            return 0.0
+        if config.noise_kind == NoiseKind.LAPLACE:
+            return noise_ops.laplace_scale(
+                eps, dp_computations.compute_l1_sensitivity(l0, linf_sens))
+        return noise_ops.gaussian_sigma(
+            eps, delta, dp_computations.compute_l2_sensitivity(
+                l0, linf_sens))
+
+    if "VARIANCE" in names or "MEAN" in names:
+        spec = specs["mean_var"]
+        n_mech = 3 if "VARIANCE" in names else 2
+        budgets = dp_computations.equally_split_budget(
+            spec.eps, spec.delta, n_mech)
+        scales.append(scale(budgets[0][0], budgets[0][1], linf))
+        middle = dp_computations.compute_middle(config.min_value,
+                                                config.max_value)
+        if config.min_value == config.max_value:
+            scales.append(0.0)
+        else:
+            scales.append(
+                scale(budgets[1][0], budgets[1][1],
+                      linf * abs(middle - config.min_value)))
+        if "VARIANCE" in names:
+            sq_lo, sq_hi = dp_computations.compute_squares_interval(
+                config.min_value, config.max_value)
+            sq_mid = dp_computations.compute_middle(sq_lo, sq_hi)
+            if sq_lo == sq_hi:
+                scales.append(0.0)
+            else:
+                scales.append(
+                    scale(budgets[2][0], budgets[2][1],
+                          linf * abs(sq_mid - sq_lo)))
+    else:
+        if "COUNT" in names:
+            spec = specs["count"]
+            scales.append(scale(spec.eps, spec.delta, linf))
+        if "SUM" in names:
+            spec = specs["sum"]
+            if config.per_partition_bounds:
+                linf_sum = max(abs(config.min_sum_per_partition),
+                               abs(config.max_sum_per_partition))
+            else:
+                linf_sum = linf * max(abs(config.min_value),
+                                      abs(config.max_value))
+            scales.append(scale(spec.eps, spec.delta, linf_sum))
+    if "PRIVACY_ID_COUNT" in names:
+        # linf = max_contributions_per_partition for parity with the
+        # generic path and the reference (dp_computations.py:255-266 via
+        # PrivacyIdCountCombiner) — conservative, the true sensitivity is 1.
+        spec = specs["privacy_id_count"]
+        scales.append(scale(spec.eps, spec.delta, linf))
+    if "VECTOR_SUM" in names:
+        spec = specs["vector_sum"]
+        eps_c = spec.eps / config.vector_size
+        delta_c = spec.delta / config.vector_size
+        scales.append(scale(eps_c, delta_c, linf))
+    return np.asarray(scales, dtype=np.float32)
+
+
+def selection_inputs(config: FusedConfig, eps: float, delta: float,
+                     pre_threshold: Optional[int]):
+    """(keep_table, threshold, scale, min_count) runtime inputs for the
+    selection stage. Only the entries relevant to the configured strategy
+    matter."""
+    if config.selection is None:
+        return np.zeros(2, np.float32), 0.0, 1.0, 0.0
+    strategy = ps_ops.create_partition_selection_strategy(
+        config.selection, eps, delta, config.l0, pre_threshold)
+    if isinstance(strategy, ps_ops.TruncatedGeometricPartitionStrategy):
+        # probabilities() already folds in pre-thresholding; materialize
+        # the effective table over [0, saturation + pre_threshold].
+        size = strategy.keep_table.size + (pre_threshold or 0)
+        table = strategy.probabilities(np.arange(size)).astype(np.float32)
+        return table, 0.0, 1.0, 0.0
+    thr = strategy.threshold
+    min_count = 0.0
+    if pre_threshold is not None:
+        # Thresholding with pre-threshold: never keep below the
+        # pre-threshold, else noisy(n - pre + 1) >= T
+        # <=> noisy(n) >= T + pre - 1.
+        thr = thr + pre_threshold - 1
+        min_count = float(pre_threshold)
+    if isinstance(strategy, ps_ops.LaplaceThresholdingPartitionStrategy):
+        return np.zeros(2, np.float32), thr, strategy.noise_scale, min_count
+    return np.zeros(2, np.float32), thr, strategy.noise_stddev, min_count
+
+
+# ---------------------------------------------------------------------------
+# Driver: budget wiring + lazy execution
+# ---------------------------------------------------------------------------
+
+
+def _metric_field_order(config: FusedConfig) -> List[str]:
+    """MetricsTuple field order mirroring the reference compound combiner
+    (VARIANCE > MEAN fold count/sum; then privacy_id_count, vector_sum)."""
+    names = set(config.metrics)
+    fields = []
+    if "VARIANCE" in names:
+        fields.append("variance")
+        if "MEAN" in names:
+            fields.append("mean")
+        if "COUNT" in names:
+            fields.append("count")
+        if "SUM" in names:
+            fields.append("sum")
+    elif "MEAN" in names:
+        fields.append("mean")
+        if "COUNT" in names:
+            fields.append("count")
+        if "SUM" in names:
+            fields.append("sum")
+    else:
+        if "COUNT" in names:
+            fields.append("count")
+        if "SUM" in names:
+            fields.append("sum")
+    if "PRIVACY_ID_COUNT" in names:
+        fields.append("privacy_id_count")
+    if "VECTOR_SUM" in names:
+        fields.append("vector_sum")
+    return fields
+
+
+def request_budgets(config: FusedConfig, params: AggregateParams,
+                    budget_accountant) -> Dict[str, Any]:
+    """Requests exactly the budgets the reference combiner factory would
+    (``combiners.py:652-721``): one mechanism per metric group, with the
+    aggregation's budget weight."""
+    mechanism_type = params.noise_kind.convert_to_mechanism_type()
+    weight = params.budget_weight
+    names = set(config.metrics)
+    specs: Dict[str, Any] = {}
+
+    def request():
+        return budget_accountant.request_budget(mechanism_type,
+                                                weight=weight)
+
+    if "VARIANCE" in names or "MEAN" in names:
+        specs["mean_var"] = request()
+    else:
+        if "COUNT" in names:
+            specs["count"] = request()
+        if "SUM" in names:
+            specs["sum"] = request()
+    if "PRIVACY_ID_COUNT" in names:
+        specs["privacy_id_count"] = request()
+    if "VECTOR_SUM" in names:
+        specs["vector_sum"] = request()
+    return specs
+
+
+class LazyFusedResult:
+    """Iterable of (partition_key, MetricsTuple); runs the fused kernel on
+    first iteration — after ``compute_budgets()``, honoring the two-phase
+    protocol. Iterating again reuses the cached result."""
+
+    def __init__(self, rows, params: AggregateParams, config: FusedConfig,
+                 data_extractors, public_partitions, specs,
+                 selection_spec, rng_seed: Optional[int] = None,
+                 mesh=None):
+        self._rows = rows
+        self._params = params
+        self._config = config
+        self._extractors = data_extractors
+        self._public = public_partitions
+        self._specs = specs
+        self._selection_spec = selection_spec
+        self._rng_seed = rng_seed
+        self._mesh = mesh
+        self._cache = None
+
+    def __iter__(self):
+        if self._cache is None:
+            self._cache = self._execute()
+        return iter(self._cache)
+
+    def _execute(self):
+        config = self._config
+        params = self._params
+        encoded = encode(self._rows, self._extractors, config.vector_size,
+                         self._public)
+        P = len(encoded.pk_vocab)
+        if P == 0:
+            return []
+        scales = _noise_scales(config, self._specs)
+        # Without privacy ids the selection user-count estimate divides by
+        # the max rows one user may own (reference dp_engine.py:163-169).
+        if config.bounds_already_enforced:
+            rows_per_uid = float(params.max_contributions or
+                                 params.max_contributions_per_partition)
+        else:
+            rows_per_uid = 1.0
+        if self._selection_spec is not None:
+            keep_table, thr, s_scale, min_count = selection_inputs(
+                config, self._selection_spec.eps,
+                self._selection_spec.delta, params.pre_threshold)
+        else:
+            keep_table, thr, s_scale, min_count = selection_inputs(
+                config, 1.0, 1e-9, None)
+
+        from pipelinedp_tpu.ops import noise as noise_ops
+        seed = (self._rng_seed if self._rng_seed is not None else
+                int(noise_ops._host_rng.integers(0, 2**31 - 1)))
+        key = jax.random.PRNGKey(seed)
+        P_pad = _pad_pow2(P)
+
+        if self._mesh is not None:
+            from pipelinedp_tpu.parallel import sharded_fused_aggregate
+            keep_pk, metrics = sharded_fused_aggregate(
+                self._mesh, config, P_pad, encoded.pid, encoded.pk,
+                encoded.values, np.ones(encoded.n_rows, bool), scales,
+                keep_table, thr, s_scale, min_count, rows_per_uid, key)
+        else:
+            n_pad = _pad_pow2(max(encoded.n_rows, 1))
+            pid = np.zeros(n_pad, np.int32)
+            pk = np.zeros(n_pad, np.int32)
+            valid = np.zeros(n_pad, bool)
+            pid[:encoded.n_rows] = encoded.pid
+            pk[:encoded.n_rows] = encoded.pk
+            valid[:encoded.n_rows] = True
+            if config.vector_size:
+                values = np.zeros((n_pad, config.vector_size), np.float32)
+                values[:encoded.n_rows] = encoded.values
+            else:
+                values = np.zeros(n_pad, np.float32)
+                values[:encoded.n_rows] = encoded.values
+            keep_pk, metrics = fused_aggregate_kernel(
+                config, P_pad, jnp.asarray(pid), jnp.asarray(pk),
+                jnp.asarray(values), jnp.asarray(valid),
+                jnp.asarray(scales), jnp.asarray(keep_table),
+                jnp.float32(thr), jnp.float32(s_scale),
+                jnp.float32(min_count), jnp.float32(rows_per_uid), key)
+
+        keep_np = np.asarray(keep_pk)[:P]
+        fields = _metric_field_order(config)
+        metric_arrays = {f: np.asarray(metrics[f]) for f in fields}
+        out = []
+        for i in range(P):
+            if self._public is None and not keep_np[i]:
+                continue
+            vals = tuple(
+                metric_arrays[f][i] if metric_arrays[f].ndim == 1 else
+                metric_arrays[f][i, :] for f in fields)
+            vals = tuple(
+                float(v) if np.ndim(v) == 0 else np.asarray(v)
+                for v in vals)
+            out.append((encoded.pk_vocab[i],
+                        _create_named_tuple_instance(
+                            "MetricsTuple", tuple(fields), vals)))
+        return out
+
+
+def build_fused_aggregation(col, params: AggregateParams, data_extractors,
+                            public_partitions, budget_accountant,
+                            report_gen, rng_seed=None,
+                            mesh=None) -> LazyFusedResult:
+    """Engine entry point for the fused plane: requests budgets (same
+    pattern as the generic path, so the privacy semantics are identical),
+    registers report stages, returns the lazy result."""
+    from pipelinedp_tpu.aggregate_params import MechanismType
+
+    public = public_partitions is not None
+    config = FusedConfig.from_params(params, public)
+    specs = request_budgets(config, params, budget_accountant)
+    selection_spec = None
+    if not public:
+        selection_spec = budget_accountant.request_budget(
+            mechanism_type=MechanismType.GENERIC)
+
+    if not config.bounds_already_enforced:
+        report_gen.add_stage(
+            f"Per-partition contribution bounding: for each privacy_id and "
+            f"each partition, randomly select "
+            f"max(actual_contributions_per_partition, {config.linf}) "
+            f"contributions (fused on device).")
+        report_gen.add_stage(
+            f"Cross-partition contribution bounding: for each privacy_id "
+            f"randomly select max(actual_partition_contributed, "
+            f"{config.l0}) partitions (fused on device).")
+    if public:
+        report_gen.add_stage(
+            "Public partition selection: dropped non public partitions; "
+            "missing public partitions added as empty (dense pk axis).")
+    else:
+        strategy = params.partition_selection_strategy
+        report_gen.add_stage(
+            lambda: f"Private Partition selection: using {strategy.value} "
+            f"method with (eps={selection_spec.eps}, "
+            f"delta={selection_spec.delta}) — batched over all partitions")
+    report_gen.add_stage(
+        lambda: "Computed metrics "
+        f"{sorted(set(m.lower() for m in config.metrics))} in one fused "
+        "XLA program")
+    return LazyFusedResult(col, params, config, data_extractors,
+                           public_partitions, specs, selection_spec,
+                           rng_seed=rng_seed, mesh=mesh)

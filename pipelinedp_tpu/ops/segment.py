@@ -1,0 +1,95 @@
+"""Segment kernels — the array-plane building blocks of the fused DP
+aggregation (SURVEY.md §7: ``group_by_key`` = sort + segment boundaries,
+``sample_fixed_per_key`` = random-tiebreak sort + rank-in-segment,
+``combine_accumulators_per_key`` = ``segment_sum``).
+
+Everything here is jit-compatible: static shapes, no data-dependent Python
+control flow. Padding rows carry a sentinel key that sorts last and a
+``valid=False`` mask. All functions operate on the *sorted* row order
+produced by ``sort_rows``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Sentinel for padding rows: sorts after all real ids.
+PAD_ID = jnp.iinfo(jnp.int32).max
+
+
+def sort_rows(key, pid, pk, valid):
+    """Sorts rows by (pid, pk, random tiebreak); padding (valid=False) rows
+    sort last. The random tiebreak makes 'first k rows of each segment' a
+    uniform without-replacement sample — this is what turns the reference's
+    ``sample_fixed_per_key`` into a sort.
+
+    Returns (sort_idx, spid, spk): permutation and sorted ids.
+    """
+    n = pid.shape[0]
+    tiebreak = jax.random.uniform(key, (n,))
+    big_pid = jnp.where(valid, pid, PAD_ID)
+    big_pk = jnp.where(valid, pk, PAD_ID)
+    sort_idx = jnp.lexsort((tiebreak, big_pk, big_pid))
+    return sort_idx, big_pid[sort_idx], big_pk[sort_idx]
+
+
+def segment_ids(spid, spk):
+    """Segment index per sorted row: a new segment starts whenever (pid, pk)
+    changes. Returns (seg_id[N] in [0, N), new_seg[N] bool)."""
+    n = spid.shape[0]
+    idx = jnp.arange(n)
+    new_seg = jnp.where(
+        idx == 0, True,
+        (spid != jnp.roll(spid, 1)) | (spk != jnp.roll(spk, 1)))
+    seg_id = jnp.cumsum(new_seg.astype(jnp.int32)) - 1
+    return seg_id, new_seg
+
+
+def rank_in_segment(seg_id, new_seg):
+    """0-based rank of each sorted row inside its segment."""
+    n = seg_id.shape[0]
+    idx = jnp.arange(n)
+    starts = jnp.where(new_seg, idx, 0)
+    # Rows are sorted, so the max recorded start per segment IS the start.
+    seg_start = jax.ops.segment_max(starts, seg_id, num_segments=n)
+    return idx - seg_start[seg_id]
+
+
+def rank_within_group(group_of_seg, key, valid_seg):
+    """Random 0-based rank of each segment within its group (= pid), used
+    for L0 cross-partition sampling: keep segments with rank < l0.
+
+    ``group_of_seg``: int32[S] group id per segment (PAD_ID for padding).
+    Returns rank[S]."""
+    s = group_of_seg.shape[0]
+    tiebreak = jax.random.uniform(key, (s,))
+    group = jnp.where(valid_seg, group_of_seg, PAD_ID)
+    order = jnp.lexsort((tiebreak, group))
+    sorted_group = group[order]
+    idx = jnp.arange(s)
+    new_group = jnp.where(
+        idx == 0, True, sorted_group != jnp.roll(sorted_group, 1))
+    group_seg_id = jnp.cumsum(new_group.astype(jnp.int32)) - 1
+    starts = jnp.where(new_group, idx, 0)
+    group_start = jax.ops.segment_max(starts, group_seg_id,
+                                      num_segments=s)
+    rank_sorted = idx - group_start[group_seg_id]
+    # Scatter ranks back to original segment order.
+    rank = jnp.zeros(s, dtype=jnp.int32).at[order].set(
+        rank_sorted.astype(jnp.int32))
+    return rank
+
+
+def per_segment_field(values, seg_id, num_segments):
+    """Segment sum of a per-row field (the fused ``create_accumulator`` /
+    ``merge_accumulators``)."""
+    return jax.ops.segment_sum(values, seg_id, num_segments=num_segments)
+
+
+def per_segment_first(values, seg_id, new_seg, num_segments):
+    """First row's value per segment (for constant-within-segment fields
+    like pid/pk)."""
+    return jax.ops.segment_max(
+        jnp.where(new_seg, values, jnp.iinfo(jnp.int32).min), seg_id,
+        num_segments=num_segments)

@@ -1,0 +1,4 @@
+"""Multi-chip execution: shard_map over a jax.sharding.Mesh."""
+
+from pipelinedp_tpu.parallel.sharded import (make_mesh,
+                                             sharded_fused_aggregate)

@@ -1,0 +1,134 @@
+"""Multi-chip fused aggregation via ``shard_map`` over a device mesh.
+
+The reference's "distributed runtime" is the host framework's shuffle
+(Beam ``GroupByKey``/Spark ``groupByKey`` — SURVEY.md §2.2/§5.8). The
+TPU-native equivalent implemented here:
+
+* Rows are sharded **by privacy id** over the mesh's ``data`` axis (host
+  assigns ``hash(pid) % n_devices``), so contribution bounding — which
+  must see all of one privacy unit's rows — is shard-local. This replaces
+  shuffles 1 and 2 of the reference call stack with a local sort.
+* Each shard computes per-pk accumulator *partials* over the full dense
+  partition axis; the cross-shard exchange (the reference's shuffle 3 /
+  ``CombinePerKey``) is a single ``psum`` over ICI — the collective rides
+  the mesh instead of a datacenter shuffle.
+* Selection probabilities and metric noise are drawn with identical PRNG
+  keys on every device, so the final per-partition results are replicated
+  and any host can read them.
+
+The same code runs on a virtual CPU mesh
+(``--xla_force_host_platform_device_count``) for tests and on real
+TPU slices; multi-host meshes extend the same program over DCN via jax's
+global device mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as PSpec
+
+from pipelinedp_tpu import jax_engine
+
+try:  # jax>=0.6 exposes shard_map at the top level
+    from jax import shard_map  # type: ignore
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+import inspect
+
+# The replication-check kwarg was renamed check_rep -> check_vma.
+_CHECK_KW = ("check_vma" if "check_vma" in inspect.signature(
+    shard_map).parameters else "check_rep")
+
+
+def make_mesh(n_devices: Optional[int] = None, axis_name: str = "data"
+              ) -> Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("config", "num_partitions", "mesh"))
+def _sharded_kernel(config, num_partitions, mesh, pid, pk, values, valid,
+                    noise_scales, keep_table, sel_threshold, sel_scale,
+                    sel_min_count, sel_rows_per_uid, key):
+    axis = mesh.axis_names[0]
+
+    def local_fn(pid, pk, values, valid, noise_scales, keep_table,
+                 sel_threshold, sel_scale, sel_min_count,
+                 sel_rows_per_uid, key):
+        # Distinct bounding randomness per shard; identical selection /
+        # noise randomness everywhere (replicated outputs).
+        k_bound = jax.random.fold_in(key, jax.lax.axis_index(axis))
+        k_sel, k_noise = jax.random.split(jax.random.fold_in(key, 1 << 20))
+        part, part_nseg = jax_engine._partials(
+            config, num_partitions, pid, pk, values, valid, k_bound)
+        # The only cross-chip exchange: per-pk partial accumulators.
+        part = jax.tree.map(lambda x: jax.lax.psum(x, axis), part)
+        part_nseg = jax.lax.psum(part_nseg, axis)
+        return jax_engine._selection_and_metrics(
+            config, num_partitions, part, part_nseg, noise_scales,
+            keep_table, sel_threshold, sel_scale, sel_min_count,
+            sel_rows_per_uid, k_sel, k_noise)
+
+    shard = PSpec(axis)
+    repl = PSpec()
+    mapped = shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(shard, shard, shard, shard, repl, repl, repl, repl,
+                  repl, repl, repl),
+        out_specs=repl,
+        **{_CHECK_KW: False})
+    return mapped(pid, pk, values, valid, noise_scales, keep_table,
+                  sel_threshold, sel_scale, sel_min_count,
+                  sel_rows_per_uid, key)
+
+
+def sharded_fused_aggregate(mesh: Mesh, config, num_partitions: int,
+                            pid: np.ndarray, pk: np.ndarray,
+                            values: np.ndarray, valid: np.ndarray,
+                            noise_scales, keep_table, sel_threshold,
+                            sel_scale, sel_min_count, sel_rows_per_uid,
+                            key):
+    """Host entry: re-shards rows by hash(pid), pads each shard to a
+    common length, places arrays over the mesh and runs the sharded
+    kernel. Returns (keep_pk[P], metrics dict) — replicated, so values
+    are addressable from the host."""
+    n_dev = mesh.devices.size
+    shard_of_row = (pid.astype(np.int64) % n_dev).astype(np.int32)
+    order = np.argsort(shard_of_row, kind="stable")
+    counts = np.bincount(shard_of_row, minlength=n_dev)
+    per_shard = jax_engine._pad_pow2(int(counts.max()) if len(pid) else 1)
+
+    def shard_array(arr, fill=0):
+        shape = (n_dev * per_shard,) + arr.shape[1:]
+        out = np.full(shape, fill, dtype=arr.dtype)
+        offset = 0
+        for d in range(n_dev):
+            rows = order[offset:offset + counts[d]]
+            out[d * per_shard:d * per_shard + counts[d]] = arr[rows]
+            offset += counts[d]
+        return out
+
+    pid_s = shard_array(pid)
+    pk_s = shard_array(pk)
+    values_s = shard_array(values)
+    valid_s = shard_array(valid, fill=False)
+
+    sharding = NamedSharding(mesh, PSpec(mesh.axis_names[0]))
+    dev = functools.partial(jax.device_put, device=sharding)
+    return _sharded_kernel(
+        config, num_partitions, mesh, dev(pid_s), dev(pk_s),
+        dev(values_s), dev(valid_s), jnp.asarray(noise_scales),
+        jnp.asarray(keep_table), jnp.float32(sel_threshold),
+        jnp.float32(sel_scale), jnp.float32(sel_min_count),
+        jnp.float32(sel_rows_per_uid), key)

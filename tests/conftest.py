@@ -8,9 +8,18 @@ This must run before anything imports jax.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the ambient environment may export
+# JAX_PLATFORMS=axon (the real TPU tunnel), and tests must never depend on
+# TPU hardware. jax may already be pre-imported at interpreter startup, so
+# the env var alone is too late — backend selection is lazy, and
+# jax.config.update still wins as long as no computation has run yet.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

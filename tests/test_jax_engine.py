@@ -1,0 +1,342 @@
+"""Differential tests: the fused JAX plane vs the LocalBackend oracle.
+
+Strategy (SURVEY.md §4/§7): run the same aggregation with huge eps on both
+planes — noise vanishes, so the raw bounded aggregates must agree; plus
+targeted tests of bounding, selection, public partitions and fallbacks.
+Runs on the virtual 8-device CPU mesh configured in conftest.py.
+"""
+
+import operator
+
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu.backends import JaxBackend
+from pipelinedp_tpu.ops import noise as noise_ops
+
+BIG_EPS = 1e5
+
+
+def extractors():
+    return pdp.DataExtractors(privacy_id_extractor=operator.itemgetter(0),
+                              partition_extractor=operator.itemgetter(1),
+                              value_extractor=operator.itemgetter(2))
+
+
+def run(backend, data, params, public_partitions=None, eps=BIG_EPS,
+        delta=1e-10, ext=None):
+    acc = pdp.NaiveBudgetAccountant(total_epsilon=eps, total_delta=delta)
+    engine = pdp.DPEngine(acc, backend)
+    result = engine.aggregate(data, params, ext or extractors(),
+                              public_partitions=public_partitions)
+    acc.compute_budgets()
+    return dict(result)
+
+
+def count_params(**kw):
+    base = dict(metrics=[pdp.Metrics.COUNT], max_partitions_contributed=3,
+                max_contributions_per_partition=2)
+    base.update(kw)
+    return pdp.AggregateParams(**base)
+
+
+class TestDifferentialVsLocal:
+
+    def test_count(self):
+        noise_ops.seed_host_rng(0)
+        data = [(u, pk, 1.0) for u in range(50) for pk in ("a", "b", "c")]
+        local = run(pdp.LocalBackend(), data, count_params())
+        fused = run(JaxBackend(rng_seed=1), data, count_params())
+        assert set(local) == set(fused) == {"a", "b", "c"}
+        for k in local:
+            assert fused[k].count == pytest.approx(local[k].count,
+                                                   abs=0.5)
+
+    def test_sum_mean_variance(self):
+        noise_ops.seed_host_rng(0)
+        rng = np.random.default_rng(0)
+        data = [(u, "p" + str(u % 4), float(v))
+                for u, v in enumerate(rng.uniform(0, 10, 400))]
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.VARIANCE, pdp.Metrics.MEAN,
+                     pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1, min_value=0.0,
+            max_value=10.0)
+        local = run(pdp.LocalBackend(), data, params)
+        fused = run(JaxBackend(rng_seed=2), data, params)
+        assert set(local) == set(fused)
+        for k in local:
+            assert fused[k].count == pytest.approx(local[k].count, abs=0.5)
+            assert fused[k].sum == pytest.approx(local[k].sum, rel=0.01)
+            assert fused[k].mean == pytest.approx(local[k].mean, abs=0.05)
+            assert fused[k].variance == pytest.approx(local[k].variance,
+                                                      abs=0.2)
+
+    def test_sum_per_partition_bounds(self):
+        noise_ops.seed_host_rng(0)
+        data = [(u, "a", 100.0) for u in range(20)]  # each user sum 100
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.SUM], max_partitions_contributed=1,
+            max_contributions_per_partition=5, min_sum_per_partition=0.0,
+            max_sum_per_partition=10.0)
+        fused = run(JaxBackend(rng_seed=3), data, params)
+        # 20 users, each clipped to 10 -> 200.
+        assert fused["a"].sum == pytest.approx(200.0, rel=0.01)
+
+    def test_privacy_id_count(self):
+        noise_ops.seed_host_rng(0)
+        # 30 users, each with 5 rows in partition a.
+        data = [(u, "a", 1.0) for u in range(30) for _ in range(5)]
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.PRIVACY_ID_COUNT],
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1)
+        fused = run(JaxBackend(rng_seed=4), data, params)
+        assert fused["a"].privacy_id_count == pytest.approx(30, abs=0.5)
+
+
+class TestFusedBounding:
+
+    def test_linf_caps_rows(self):
+        noise_ops.seed_host_rng(0)
+        data = [(0, "a", 1.0)] * 100  # one user, 100 rows
+        params = count_params(max_partitions_contributed=1,
+                              max_contributions_per_partition=7)
+        fused = run(JaxBackend(rng_seed=5), data, params,
+                    public_partitions=["a"])
+        assert fused["a"].count == pytest.approx(7, abs=0.5)
+
+    def test_l0_caps_partitions(self):
+        noise_ops.seed_host_rng(0)
+        pks = [f"p{i}" for i in range(10)]
+        data = [(u, pk, 1.0) for u in range(200) for pk in pks]
+        params = count_params(max_partitions_contributed=2,
+                              max_contributions_per_partition=1)
+        fused = run(JaxBackend(rng_seed=6), data, params,
+                    public_partitions=pks)
+        total = sum(v.count for v in fused.values())
+        # Each user contributes to exactly 2 of 10 partitions.
+        assert total == pytest.approx(400, rel=0.1)
+
+    def test_sum_clipping(self):
+        noise_ops.seed_host_rng(0)
+        data = [(u, "a", 100.0) for u in range(10)]  # clipped to 10 each
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.SUM], max_partitions_contributed=1,
+            max_contributions_per_partition=1, min_value=0.0,
+            max_value=10.0)
+        fused = run(JaxBackend(rng_seed=7), data, params)
+        assert fused["a"].sum == pytest.approx(100.0, rel=0.01)
+
+
+class TestFusedSelection:
+
+    def test_small_partition_dropped(self):
+        noise_ops.seed_host_rng(0)
+        data = [(u, "big", 1.0) for u in range(1000)] + [(5000, "tiny",
+                                                          1.0)]
+        params = count_params(max_partitions_contributed=1,
+                              max_contributions_per_partition=1)
+        fused = run(JaxBackend(rng_seed=8), data, params, eps=1.0,
+                    delta=1e-6)
+        assert "big" in fused
+        assert "tiny" not in fused
+
+    @pytest.mark.parametrize("strategy", [
+        pdp.PartitionSelectionStrategy.TRUNCATED_GEOMETRIC,
+        pdp.PartitionSelectionStrategy.LAPLACE_THRESHOLDING,
+        pdp.PartitionSelectionStrategy.GAUSSIAN_THRESHOLDING,
+    ])
+    def test_strategies(self, strategy):
+        noise_ops.seed_host_rng(0)
+        data = [(u, "big", 1.0) for u in range(1000)]
+        params = count_params(max_partitions_contributed=1,
+                              max_contributions_per_partition=1,
+                              partition_selection_strategy=strategy)
+        fused = run(JaxBackend(rng_seed=9), data, params, eps=1.0,
+                    delta=1e-6)
+        assert "big" in fused
+
+    def test_pre_threshold(self):
+        noise_ops.seed_host_rng(0)
+        data = [(u, "mid", 1.0) for u in range(50)]
+        params = count_params(max_partitions_contributed=1,
+                              max_contributions_per_partition=1,
+                              pre_threshold=100)
+        fused = run(JaxBackend(rng_seed=10), data, params, eps=BIG_EPS,
+                    delta=1e-6)
+        assert fused == {}
+
+
+class TestFusedPublicPartitions:
+
+    def test_empty_partition_injected(self):
+        noise_ops.seed_host_rng(0)
+        data = [(u, "a", 1.0) for u in range(40)]
+        params = count_params()
+        fused = run(JaxBackend(rng_seed=11), data, params,
+                    public_partitions=["a", "missing"])
+        assert fused["a"].count == pytest.approx(40, abs=0.5)
+        assert fused["missing"].count == pytest.approx(0, abs=0.5)
+
+    def test_non_public_dropped(self):
+        noise_ops.seed_host_rng(0)
+        data = [(u, pk, 1.0) for u in range(40) for pk in ("a", "b")]
+        fused = run(JaxBackend(rng_seed=12), data, count_params(),
+                    public_partitions=["a"])
+        assert set(fused) == {"a"}
+
+
+class TestFusedVectorSum:
+
+    def test_vector_sum_linf(self):
+        noise_ops.seed_host_rng(0)
+        data = [(u, "a", [1.0, 2.0]) for u in range(50)]
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.VECTOR_SUM],
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1, vector_size=2,
+            vector_max_norm=1000.0,
+            vector_norm_kind=pdp.NormKind.Linf)
+        fused = run(JaxBackend(rng_seed=13), data, params)
+        np.testing.assert_allclose(fused["a"].vector_sum, [50.0, 100.0],
+                                   atol=1.0)
+
+    def test_vector_sum_l2_clip(self):
+        noise_ops.seed_host_rng(0)
+        data = [(0, "a", [30.0, 40.0])]
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.VECTOR_SUM],
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1, vector_size=2,
+            vector_max_norm=10.0, vector_norm_kind=pdp.NormKind.L2)
+        fused = run(JaxBackend(rng_seed=14), data, params,
+                    public_partitions=["a"])
+        np.testing.assert_allclose(fused["a"].vector_sum, [6.0, 8.0],
+                                   atol=0.1)
+
+
+class TestBoundsAlreadyEnforcedFused:
+
+    def test_no_pid(self):
+        noise_ops.seed_host_rng(0)
+        data = [("a", 4.0)] * 100
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.SUM], max_partitions_contributed=1,
+            max_contributions_per_partition=1, min_value=0.0,
+            max_value=10.0, contribution_bounds_already_enforced=True)
+        ext = pdp.DataExtractors(partition_extractor=operator.itemgetter(0),
+                                 value_extractor=operator.itemgetter(1))
+        fused = run(JaxBackend(rng_seed=15), data, params, ext=ext)
+        assert fused["a"].sum == pytest.approx(400.0, rel=0.01)
+
+
+class TestFallbacks:
+
+    def test_percentile_falls_back_to_generic_graph(self):
+        noise_ops.seed_host_rng(0)
+        rng = np.random.default_rng(1)
+        data = [(u, "a", float(v))
+                for u, v in enumerate(rng.uniform(0, 100, 1000))]
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.PERCENTILE(50)],
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1, min_value=0.0,
+            max_value=100.0)
+        fused = run(JaxBackend(rng_seed=16), data, params)
+        assert fused["a"].percentile_50 == pytest.approx(50, abs=6)
+
+    def test_noise_actually_added_at_small_eps(self):
+        # Two different seeds must give different noisy outputs.
+        data = [(u, "a", 1.0) for u in range(2000)]
+        params = count_params(max_partitions_contributed=1,
+                              max_contributions_per_partition=1)
+        outs = []
+        for seed in (20, 21):
+            noise_ops.seed_host_rng(0)
+            fused = run(JaxBackend(rng_seed=seed), data, params, eps=0.5,
+                        delta=1e-6)
+            outs.append(fused["a"].count)
+        assert outs[0] != outs[1]
+        # But both near the true count.
+        for o in outs:
+            assert o == pytest.approx(2000, rel=0.05)
+
+
+class TestShardedMultiChip:
+    """The multi-chip path on the virtual 8-device CPU mesh."""
+
+    def _mesh(self):
+        import jax
+        from pipelinedp_tpu.parallel import make_mesh
+        assert len(jax.devices()) >= 8, (
+            "conftest must provide 8 virtual devices")
+        return make_mesh(8)
+
+    def test_matches_single_device(self):
+        noise_ops.seed_host_rng(0)
+        data = [(u, f"p{u % 5}", 3.0) for u in range(500)]
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1, min_value=0.0,
+            max_value=10.0)
+        single = run(JaxBackend(rng_seed=30), data, params)
+        sharded = run(JaxBackend(mesh=self._mesh(), rng_seed=30), data,
+                      params)
+        assert set(single) == set(sharded)
+        for k in single:
+            assert sharded[k].count == pytest.approx(single[k].count,
+                                                     abs=0.5)
+            assert sharded[k].sum == pytest.approx(single[k].sum,
+                                                   rel=0.01)
+
+    def test_bounding_across_shards(self):
+        noise_ops.seed_host_rng(0)
+        # Users contribute to 10 partitions, L0=2: bounding must hold
+        # globally even though rows are sharded by pid.
+        pks = [f"p{i}" for i in range(10)]
+        data = [(u, pk, 1.0) for u in range(160) for pk in pks]
+        params = count_params(max_partitions_contributed=2,
+                              max_contributions_per_partition=1)
+        sharded = run(JaxBackend(mesh=self._mesh(), rng_seed=31), data,
+                      params, public_partitions=pks)
+        total = sum(v.count for v in sharded.values())
+        assert total == pytest.approx(320, rel=0.1)
+
+    def test_selection_on_mesh(self):
+        noise_ops.seed_host_rng(0)
+        data = [(u, "big", 1.0) for u in range(1000)] + [(5000, "tiny",
+                                                          1.0)]
+        params = count_params(max_partitions_contributed=1,
+                              max_contributions_per_partition=1)
+        sharded = run(JaxBackend(mesh=self._mesh(), rng_seed=32), data,
+                      params, eps=1.0, delta=1e-6)
+        assert "big" in sharded
+        assert "tiny" not in sharded
+
+
+class TestEnforcedBoundsSelectionEstimate:
+
+    def test_rows_divided_by_max_rows_per_user(self):
+        # Privacy regression (user-count estimate): with
+        # contribution_bounds_already_enforced and linf=5, a partition with
+        # 5 rows could be ONE user — selection must see ceil(5/5)=1 user
+        # and (almost) never keep it, even though 5 users would often pass.
+        noise_ops.seed_host_rng(0)
+        data = [("solo", 1.0)] * 5
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT], max_partitions_contributed=1,
+            max_contributions_per_partition=5,
+            contribution_bounds_already_enforced=True)
+        ext = pdp.DataExtractors(partition_extractor=operator.itemgetter(0),
+                                 value_extractor=operator.itemgetter(1))
+        kept = 0
+        for seed in range(40):
+            fused = run(JaxBackend(rng_seed=100 + seed), data, params,
+                        eps=1.0, delta=1e-4, ext=ext)
+            kept += "solo" in fused
+        # P(keep | 1 user) <= delta = 1e-4: 40 trials should keep ~0.
+        assert kept == 0
