@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Benchmark: fused TPU plane vs the reference-architecture LocalBackend.
+
+Workload = BASELINE.md config (MovieLens-shaped): COUNT+SUM+MEAN over 60k
+partitions with private partition selection. The baseline is this repo's
+``LocalBackend`` — architecturally identical to the reference's
+(``pipeline_dp/pipeline_backend.py:458``: lazy pure-Python generators), and
+the reference publishes no numbers of its own (BASELINE.md). Throughput is
+measured as input rows/second end-to-end (encode + bound + combine +
+select + noise), fused timing excludes compilation (first run warms the
+cache).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def make_dataset(n_rows, n_users, n_partitions, seed=0):
+    rng = np.random.default_rng(seed)
+    import pipelinedp_tpu as pdp
+    # Zipf-ish partition popularity, like movie views.
+    raw = rng.zipf(1.3, size=n_rows) % n_partitions
+    return pdp.ArrayDataset(
+        privacy_ids=rng.integers(0, n_users, n_rows),
+        partition_keys=raw.astype(np.int64),
+        values=rng.uniform(0.0, 10.0, n_rows))
+
+
+def build_params():
+    import pipelinedp_tpu as pdp
+    return pdp.AggregateParams(
+        metrics=[pdp.Metrics.MEAN, pdp.Metrics.COUNT, pdp.Metrics.SUM],
+        noise_kind=pdp.NoiseKind.LAPLACE,
+        max_partitions_contributed=4,
+        max_contributions_per_partition=2,
+        min_value=0.0, max_value=10.0)
+
+
+def run_once(backend, dataset, eps=1.0, delta=1e-6):
+    import pipelinedp_tpu as pdp
+    acc = pdp.NaiveBudgetAccountant(total_epsilon=eps, total_delta=delta)
+    engine = pdp.DPEngine(acc, backend)
+    result = engine.aggregate(dataset, build_params(),
+                              pdp.DataExtractors())
+    acc.compute_budgets()
+    t0 = time.perf_counter()
+    out = list(result)
+    dt = time.perf_counter() - t0
+    return len(out), dt
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true",
+                        help="tiny sizes for a quick correctness pass")
+    parser.add_argument("--rows", type=int, default=None)
+    args = parser.parse_args()
+
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu.backends import JaxBackend
+
+    if args.smoke:
+        n_rows, n_users, n_parts, local_rows = 50_000, 5_000, 2_000, 20_000
+    else:
+        n_rows = args.rows or 5_000_000
+        n_users, n_parts, local_rows = 200_000, 60_000, 250_000
+
+    # Same distribution for both planes: the local baseline runs a prefix
+    # slice of the identical dataset, so per-row cost is comparable.
+    fused_ds = make_dataset(n_rows, n_users, n_parts)
+    local_ds = pdp.ArrayDataset(
+        privacy_ids=fused_ds.privacy_ids[:local_rows],
+        partition_keys=fused_ds.partition_keys[:local_rows],
+        values=fused_ds.values[:local_rows])
+
+    # Baseline: reference-architecture LocalBackend.
+    n_local, local_dt = run_once(pdp.LocalBackend(), local_ds)
+    local_rps = local_rows / local_dt
+
+    # Fused plane: warm-up run compiles; measured run reuses the cache.
+    backend = JaxBackend(rng_seed=0)
+    run_once(backend, fused_ds)
+    n_fused, fused_dt = run_once(backend, fused_ds)
+    fused_rps = n_rows / fused_dt
+
+    print(json.dumps({
+        "metric": "dp_count_sum_mean_rows_per_sec",
+        "value": round(fused_rps),
+        "unit": "rows/s",
+        "vs_baseline": round(fused_rps / local_rps, 2),
+    }))
+    print(f"# local: {local_rows} rows -> {n_local} partitions in "
+          f"{local_dt:.2f}s ({local_rps:.0f} rows/s)", file=sys.stderr)
+    print(f"# fused: {n_rows} rows -> {n_fused} partitions in "
+          f"{fused_dt:.2f}s ({fused_rps:.0f} rows/s)", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -42,6 +42,7 @@ from pipelinedp_tpu.pipeline_backend import (
     SparkRDDBackend,
     register_annotator,
 )
+from pipelinedp_tpu.jax_engine import ArrayDataset
 from pipelinedp_tpu.report_generator import ExplainComputationReport
 
 try:

@@ -1,0 +1,37 @@
+"""Utility analysis & parameter tuning (capability parity with the
+reference's ``analysis/`` package, ``analysis/__init__.py:15-28``):
+simulate, without running real DP repeatedly, the error a given parameter
+set would produce — sweeping many configurations in one pass."""
+
+from pipelinedp_tpu.analysis.data_structures import (
+    MultiParameterConfiguration,
+    PreAggregateExtractors,
+    UtilityAnalysisOptions,
+    get_aggregate_params,
+)
+from pipelinedp_tpu.analysis.histograms import (
+    DatasetHistograms,
+    compute_dataset_histograms,
+    compute_dataset_histograms_on_preaggregated_data,
+)
+from pipelinedp_tpu.analysis.metrics import (
+    AggregateErrorMetrics,
+    AggregateMetrics,
+    AggregateMetricType,
+    PartitionSelectionMetrics,
+    SumMetrics,
+)
+from pipelinedp_tpu.analysis.parameter_tuning import (
+    MinimizingFunction,
+    ParametersToTune,
+    TuneOptions,
+    TuneResult,
+    tune,
+)
+from pipelinedp_tpu.analysis.pre_aggregation import preaggregate
+from pipelinedp_tpu.analysis.utility_analysis import (
+    perform_utility_analysis,
+)
+from pipelinedp_tpu.analysis.utility_analysis_engine import (
+    UtilityAnalysisEngine,
+)

@@ -1,0 +1,612 @@
+"""Utility-analysis combiners — per-partition error models and
+cross-partition aggregation (capability parity with the reference's
+``analysis/combiners.py``).
+
+Per-partition accumulators are NumPy-vectorized over the per-user arrays
+(count, sum, n_partitions); partition-selection probability is tracked
+exactly (explicit probability list) while small and by moments of the
+Poisson-binomial distribution once it grows past
+``MAX_PROBABILITIES_IN_ACCUMULATOR`` (reference :32,70-175)."""
+
+from __future__ import annotations
+
+import abc
+import copy
+import math
+from dataclasses import dataclass
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+import scipy.stats
+
+from pipelinedp_tpu import combiners as dp_combiners
+from pipelinedp_tpu import dp_computations, partition_selection
+from pipelinedp_tpu.aggregate_params import (NoiseKind,
+                                             PartitionSelectionStrategy)
+from pipelinedp_tpu.analysis import metrics, poisson_binomial
+from pipelinedp_tpu.analysis import probability_computations
+
+MAX_PROBABILITIES_IN_ACCUMULATOR = 100
+
+# Aggregated per (privacy_id, partition_key): (count, sum, n_partitions).
+PreaggregatedData = Tuple[int, float, int]
+
+
+class UtilityAnalysisCombiner(dp_combiners.Combiner):
+
+    @abc.abstractmethod
+    def create_accumulator(self, data: Tuple[int, float, int]):
+        """data = (count, sum, n_partitions) arrays per privacy unit."""
+
+    def merge_accumulators(self, acc1: Tuple, acc2: Tuple):
+        return tuple(a + b for a, b in zip(acc1, acc2))
+
+    def explain_computation(self):
+        """No-op."""
+
+    def metrics_names(self) -> List[str]:
+        return []
+
+
+@dataclass
+class SumOfRandomVariablesMoments:
+    """Moments of a sum of independent random variables (reference :70)."""
+    count: int
+    expectation: float
+    variance: float
+    third_central_moment: float
+
+    def __add__(self, other):
+        return SumOfRandomVariablesMoments(
+            self.count + other.count,
+            self.expectation + other.expectation,
+            self.variance + other.variance,
+            self.third_central_moment + other.third_central_moment)
+
+
+def _probabilities_to_moments(
+        probabilities: List[float]) -> SumOfRandomVariablesMoments:
+    p = np.asarray(probabilities, dtype=np.float64)
+    return SumOfRandomVariablesMoments(
+        len(probabilities), float(p.sum()), float((p * (1 - p)).sum()),
+        float((p * (1 - p) * (1 - 2 * p)).sum()))
+
+
+@dataclass
+class PartitionSelectionCalculator:
+    """P(partition kept) from either the exact per-user keep probabilities
+    or the moment approximation (reference :87-141)."""
+    probabilities: Optional[List[float]] = None
+    moments: Optional[SumOfRandomVariablesMoments] = None
+
+    def __post_init__(self):
+        assert (self.probabilities is None) != (self.moments is None), (
+            "Exactly one of probabilities and moments must be set.")
+
+    def compute_probability_to_keep(
+            self, strategy: PartitionSelectionStrategy, eps: float,
+            delta: float, max_partitions_contributed: int) -> float:
+        pmf = self._compute_pmf()
+        ps_strategy = partition_selection.create_partition_selection_strategy(
+            strategy, eps, delta, max_partitions_contributed)
+        counts = np.arange(pmf.start, pmf.start + len(pmf.probabilities))
+        keep_probs = ps_strategy.probabilities(counts)
+        return float(np.dot(pmf.probabilities, keep_probs))
+
+    def _compute_pmf(self) -> poisson_binomial.PMF:
+        if self.probabilities:
+            return poisson_binomial.compute_pmf(self.probabilities)
+        moments = self.moments
+        std = math.sqrt(moments.variance)
+        skewness = (0 if std == 0 else
+                    moments.third_central_moment / std**3)
+        return poisson_binomial.compute_pmf_approximation(
+            moments.expectation, std, skewness, moments.count)
+
+
+# (probabilities, moments) — mutually exclusive, see calculator docstring.
+PartitionSelectionAccumulator = Tuple[Optional[List[float]],
+                                      Optional[SumOfRandomVariablesMoments]]
+
+
+def _merge_list(a: List, b: List) -> List:
+    if len(a) >= len(b):
+        a.extend(b)
+        return a
+    b.extend(a)
+    return b
+
+
+def _merge_partition_selection_accumulators(
+        acc1: PartitionSelectionAccumulator,
+        acc2: PartitionSelectionAccumulator
+) -> PartitionSelectionAccumulator:
+    probs1, moments1 = acc1
+    probs2, moments2 = acc2
+    if (probs1 is not None and probs2 is not None and
+            len(probs1) + len(probs2) <= MAX_PROBABILITIES_IN_ACCUMULATOR):
+        return (_merge_list(probs1, probs2), None)
+    if moments1 is None:
+        moments1 = _probabilities_to_moments(probs1)
+    if moments2 is None:
+        moments2 = _probabilities_to_moments(probs2)
+    return (None, moments1 + moments2)
+
+
+class PartitionSelectionCombiner(UtilityAnalysisCombiner):
+    """Tracks P(partition kept) per partition (reference :192-226)."""
+
+    def __init__(self, params: dp_combiners.CombinerParams):
+        self._params = params
+
+    def create_accumulator(self, sparse_acc):
+        count, sum_, n_partitions = sparse_acc
+        max_partitions = (
+            self._params.aggregate_params.max_partitions_contributed)
+        prob_keep = np.where(
+            n_partitions > 0,
+            np.minimum(1, max_partitions / np.maximum(n_partitions, 1)), 0)
+        acc = (list(prob_keep), None)
+        return _merge_partition_selection_accumulators(acc, ([], None))
+
+    def merge_accumulators(self, acc1, acc2):
+        return _merge_partition_selection_accumulators(acc1, acc2)
+
+    def compute_metrics(self, acc: PartitionSelectionAccumulator) -> float:
+        probs, moments = acc
+        params = self._params
+        calculator = PartitionSelectionCalculator(probs, moments)
+        return calculator.compute_probability_to_keep(
+            params.aggregate_params.partition_selection_strategy,
+            params.eps, params.delta,
+            params.aggregate_params.max_partitions_contributed)
+
+
+class SumCombiner(UtilityAnalysisCombiner):
+    """Per-partition SUM error model, vectorized over the per-user arrays
+    (reference :228-277). Accumulator = (partition_sum, error_min,
+    error_max, expected_l0_error, var_l0_error)."""
+    AccumulatorType = Tuple[float, float, float, float, float]
+
+    def __init__(self, params: dp_combiners.CombinerParams):
+        self._params = copy.copy(params)
+
+    def create_accumulator(self, data) -> AccumulatorType:
+        count, partition_sum, n_partitions = data
+        del count
+        p = self._params.aggregate_params
+        min_bound = p.min_sum_per_partition
+        max_bound = p.max_sum_per_partition
+        max_partitions = p.max_partitions_contributed
+        partition_sum = np.asarray(partition_sum, dtype=np.float64)
+        n_partitions = np.asarray(n_partitions)
+        l0_prob_keep = np.where(
+            n_partitions > 0,
+            np.minimum(1, max_partitions / np.maximum(n_partitions, 1)), 0)
+        contribution = np.clip(partition_sum, min_bound, max_bound)
+        error = contribution - partition_sum
+        error_min = np.where(partition_sum < min_bound, error, 0)
+        error_max = np.where(partition_sum > max_bound, error, 0)
+        expected_l0 = -contribution * (1 - l0_prob_keep)
+        var_l0 = contribution**2 * l0_prob_keep * (1 - l0_prob_keep)
+        return (float(partition_sum.sum()), float(error_min.sum()),
+                float(error_max.sum()), float(expected_l0.sum()),
+                float(var_l0.sum()))
+
+    def compute_metrics(self, acc: AccumulatorType) -> metrics.SumMetrics:
+        (partition_sum, error_min, error_max, expected_l0, var_l0) = acc
+        std_noise = dp_computations.compute_dp_count_noise_std(
+            self._params.scalar_noise_params)
+        return metrics.SumMetrics(
+            sum=partition_sum,
+            per_partition_error_min=error_min,
+            per_partition_error_max=error_max,
+            expected_cross_partition_error=expected_l0,
+            std_cross_partition_error=math.sqrt(var_l0),
+            std_noise=std_noise,
+            noise_kind=self._params.aggregate_params.noise_kind)
+
+
+class CountCombiner(SumCombiner):
+    """COUNT reduces to SUM over per-user counts with synthetic bounds
+    [0, max_contributions_per_partition] (reference :280-294). The bounds
+    are set once on a private params copy in __init__ — the reference
+    mutates the (possibly shared) params inside create_accumulator, which
+    corrupts a sibling SUM analysis (reference bug :291-292, not
+    replicated)."""
+
+    def __init__(self, params):
+        super().__init__(params)
+        p = copy.copy(self._params.aggregate_params)
+        p.min_sum_per_partition = 0.0
+        p.max_sum_per_partition = p.max_contributions_per_partition
+        self._params.aggregate_params = p
+
+    def create_accumulator(self, sparse_acc):
+        count, _sum, n_partitions = sparse_acc
+        data = None, np.asarray(count, dtype=np.float64), n_partitions
+        return super().create_accumulator(data)
+
+
+class PrivacyIdCountCombiner(SumCombiner):
+    """PRIVACY_ID_COUNT reduces to SUM over 0/1 indicators with bounds
+    [0, 1] (reference :296-310; same mutation fix as CountCombiner)."""
+
+    def __init__(self, params):
+        super().__init__(params)
+        p = copy.copy(self._params.aggregate_params)
+        p.min_sum_per_partition = 0.0
+        p.max_sum_per_partition = 1.0
+        self._params.aggregate_params = p
+
+    def create_accumulator(self, sparse_acc):
+        counts, _sum, n_partitions = sparse_acc
+        counts = np.where(np.asarray(counts) > 0, 1.0, 0.0)
+        data = None, counts, n_partitions
+        return super().create_accumulator(data)
+
+
+class CompoundCombiner(dp_combiners.CompoundCombiner):
+    """Sparse/dense compound accumulator (reference :313-381): raw
+    (counts, sums, n_partitions) lists while small; per-combiner dense
+    accumulators (vectorized create) once the sparse form would outgrow
+    2x the number of internal combiners."""
+
+    SparseAccumulatorType = Tuple[List[int], List[float], List[int]]
+    DenseAccumulatorType = List[Any]
+    AccumulatorType = Tuple[Optional[SparseAccumulatorType],
+                            Optional[DenseAccumulatorType]]
+
+    def create_accumulator(self, data) -> AccumulatorType:
+        if not data:
+            # Empty public partitions.
+            return (([0], [0], [0]), None)
+        return (([data[0]], [data[1]], [data[2]]), None)
+
+    def _to_dense(self, sparse_acc) -> DenseAccumulatorType:
+        sparse_acc = [np.array(a) for a in sparse_acc]
+        return (len(sparse_acc[0]),
+                tuple(c.create_accumulator(sparse_acc)
+                      for c in self._combiners))
+
+    def merge_accumulators(self, acc1, acc2):
+        sparse1, dense1 = acc1
+        sparse2, dense2 = acc2
+        if sparse1 and sparse2:
+            merged_sparse = tuple(
+                _merge_list(s, t) for s, t in zip(sparse1, sparse2))
+            if len(merged_sparse[0]) <= 2 * len(self._combiners):
+                return (merged_sparse, None)
+            return (None, self._to_dense(merged_sparse))
+        dense1 = self._to_dense(sparse1) if sparse1 else dense1
+        dense2 = self._to_dense(sparse2) if sparse2 else dense2
+        return (None, super().merge_accumulators(dense1, dense2))
+
+    def compute_metrics(self, acc):
+        sparse, dense = acc
+        if sparse:
+            dense = self._to_dense(sparse)
+        return super().compute_metrics(dense)
+
+
+@dataclass
+class AggregateErrorMetricsAccumulator:
+    """Sums across partitions (noise_std excepted) — reference :384-465."""
+    num_partitions: int
+    kept_partitions_expected: float
+    total_aggregate: float
+
+    data_dropped_l0: float
+    data_dropped_linf: float
+    data_dropped_partition_selection: float
+
+    error_l0_expected: float
+    error_linf_expected: float
+    error_linf_min_expected: float
+    error_linf_max_expected: float
+    error_l0_variance: float
+    error_variance: float
+    error_quantiles: List[float]
+    rel_error_l0_expected: float
+    rel_error_linf_expected: float
+    rel_error_linf_min_expected: float
+    rel_error_linf_max_expected: float
+    rel_error_l0_variance: float
+    rel_error_variance: float
+    rel_error_quantiles: List[float]
+
+    error_expected_w_dropped_partitions: float
+    rel_error_expected_w_dropped_partitions: float
+
+    noise_std: float
+
+    def __add__(self, other):
+        assert self.noise_std == other.noise_std, (
+            "Accumulators must share noise_std to merge")
+        return AggregateErrorMetricsAccumulator(
+            num_partitions=self.num_partitions + other.num_partitions,
+            kept_partitions_expected=(self.kept_partitions_expected +
+                                      other.kept_partitions_expected),
+            total_aggregate=self.total_aggregate + other.total_aggregate,
+            data_dropped_l0=self.data_dropped_l0 + other.data_dropped_l0,
+            data_dropped_linf=(self.data_dropped_linf +
+                               other.data_dropped_linf),
+            data_dropped_partition_selection=(
+                self.data_dropped_partition_selection +
+                other.data_dropped_partition_selection),
+            error_l0_expected=(self.error_l0_expected +
+                               other.error_l0_expected),
+            error_linf_expected=(self.error_linf_expected +
+                                 other.error_linf_expected),
+            error_linf_min_expected=(self.error_linf_min_expected +
+                                     other.error_linf_min_expected),
+            error_linf_max_expected=(self.error_linf_max_expected +
+                                     other.error_linf_max_expected),
+            error_l0_variance=(self.error_l0_variance +
+                               other.error_l0_variance),
+            error_variance=self.error_variance + other.error_variance,
+            error_quantiles=[
+                a + b for a, b in zip(self.error_quantiles,
+                                      other.error_quantiles)
+            ],
+            rel_error_l0_expected=(self.rel_error_l0_expected +
+                                   other.rel_error_l0_expected),
+            rel_error_linf_expected=(self.rel_error_linf_expected +
+                                     other.rel_error_linf_expected),
+            rel_error_linf_min_expected=(self.rel_error_linf_min_expected +
+                                         other.rel_error_linf_min_expected),
+            rel_error_linf_max_expected=(self.rel_error_linf_max_expected +
+                                         other.rel_error_linf_max_expected),
+            rel_error_l0_variance=(self.rel_error_l0_variance +
+                                   other.rel_error_l0_variance),
+            rel_error_variance=(self.rel_error_variance +
+                                other.rel_error_variance),
+            rel_error_quantiles=[
+                a + b for a, b in zip(self.rel_error_quantiles,
+                                      other.rel_error_quantiles)
+            ],
+            error_expected_w_dropped_partitions=(
+                self.error_expected_w_dropped_partitions +
+                other.error_expected_w_dropped_partitions),
+            rel_error_expected_w_dropped_partitions=(
+                self.rel_error_expected_w_dropped_partitions +
+                other.rel_error_expected_w_dropped_partitions),
+            noise_std=self.noise_std)
+
+
+class AggregateErrorMetricsCompoundCombiner(dp_combiners.CompoundCombiner):
+    """Threads each partition's P(keep) into every metric's error
+    accumulator (reference :468-485)."""
+    AccumulatorType = Tuple[int, Tuple]
+
+    def create_accumulator(self, values) -> AccumulatorType:
+        probability_to_keep = 1
+        if isinstance(values[0], float):
+            probability_to_keep = values[0]
+        accumulators = []
+        for combiner, value in zip(self._combiners, values):
+            if isinstance(
+                    combiner,
+                    PrivatePartitionSelectionAggregateErrorMetricsCombiner):
+                accumulators.append(combiner.create_accumulator(value))
+            else:
+                accumulators.append(
+                    combiner.create_accumulator(value, probability_to_keep))
+        return 1, tuple(accumulators)
+
+
+class SumAggregateErrorMetricsCombiner(dp_combiners.Combiner):
+    """Aggregates per-partition SumMetrics across partitions
+    (reference :488-679)."""
+    AccumulatorType = AggregateErrorMetricsAccumulator
+
+    def __init__(self, metric_type: metrics.AggregateMetricType,
+                 error_quantiles: List[float]):
+        self._metric_type = metric_type
+        self._error_quantiles = self._invert_error_quantiles(
+            error_quantiles)
+
+    def create_accumulator(self,
+                           partition_metrics: metrics.SumMetrics,
+                           prob_to_keep: float = 1) -> AccumulatorType:
+        total_aggregate = partition_metrics.sum
+        data_dropped_l0 = data_dropped_linf = 0
+        data_dropped_partition_selection = 0
+        if self._metric_type != metrics.AggregateMetricType.SUM:
+            data_dropped_l0 = (
+                -partition_metrics.expected_cross_partition_error)
+            data_dropped_linf = -partition_metrics.per_partition_error_max
+            data_dropped_partition_selection = (1 - prob_to_keep) * (
+                partition_metrics.sum +
+                partition_metrics.expected_cross_partition_error +
+                partition_metrics.per_partition_error_max)
+
+        error_l0_expected = (
+            prob_to_keep * partition_metrics.expected_cross_partition_error)
+        error_linf_min_expected = (
+            prob_to_keep * partition_metrics.per_partition_error_min)
+        error_linf_max_expected = (
+            prob_to_keep * partition_metrics.per_partition_error_max)
+        error_linf_expected = (error_linf_min_expected +
+                               error_linf_max_expected)
+        error_l0_variance = (
+            prob_to_keep * partition_metrics.std_cross_partition_error**2)
+        error_variance = prob_to_keep * (
+            partition_metrics.std_cross_partition_error**2 +
+            partition_metrics.std_noise**2)
+        error_quantiles = self._compute_error_quantiles(prob_to_keep,
+                                                        partition_metrics)
+        error_expected_w_dropped = prob_to_keep * (
+            partition_metrics.expected_cross_partition_error +
+            partition_metrics.per_partition_error_min +
+            partition_metrics.per_partition_error_max) + (
+                1 - prob_to_keep) * -partition_metrics.sum
+
+        if partition_metrics.sum == 0:
+            rel_error_l0_expected = 0
+            rel_error_linf_expected = 0
+            rel_error_linf_min_expected = 0
+            rel_error_linf_max_expected = 0
+            rel_error_l0_variance = 0
+            rel_error_variance = 0
+            rel_error_quantiles = [0] * len(self._error_quantiles)
+            rel_error_expected_w_dropped = 0
+        else:
+            abs_sum = abs(partition_metrics.sum)
+            rel_error_l0_expected = error_l0_expected / abs_sum
+            rel_error_linf_min_expected = error_linf_min_expected / abs_sum
+            rel_error_linf_max_expected = error_linf_max_expected / abs_sum
+            rel_error_linf_expected = (rel_error_linf_min_expected +
+                                       rel_error_linf_max_expected)
+            rel_error_l0_variance = (error_l0_variance /
+                                     partition_metrics.sum**2)
+            rel_error_variance = error_variance / partition_metrics.sum**2
+            rel_error_quantiles = [e / abs_sum for e in error_quantiles]
+            rel_error_expected_w_dropped = (error_expected_w_dropped /
+                                            abs_sum)
+
+        return AggregateErrorMetricsAccumulator(
+            num_partitions=1,
+            kept_partitions_expected=prob_to_keep,
+            total_aggregate=total_aggregate,
+            data_dropped_l0=data_dropped_l0,
+            data_dropped_linf=data_dropped_linf,
+            data_dropped_partition_selection=(
+                data_dropped_partition_selection),
+            error_l0_expected=error_l0_expected,
+            error_linf_expected=error_linf_expected,
+            error_linf_min_expected=error_linf_min_expected,
+            error_linf_max_expected=error_linf_max_expected,
+            error_l0_variance=error_l0_variance,
+            error_variance=error_variance,
+            error_quantiles=error_quantiles,
+            rel_error_l0_expected=rel_error_l0_expected,
+            rel_error_linf_expected=rel_error_linf_expected,
+            rel_error_linf_min_expected=rel_error_linf_min_expected,
+            rel_error_linf_max_expected=rel_error_linf_max_expected,
+            rel_error_l0_variance=rel_error_l0_variance,
+            rel_error_variance=rel_error_variance,
+            rel_error_quantiles=rel_error_quantiles,
+            error_expected_w_dropped_partitions=error_expected_w_dropped,
+            rel_error_expected_w_dropped_partitions=(
+                rel_error_expected_w_dropped),
+            noise_std=partition_metrics.std_noise)
+
+    def merge_accumulators(self, acc1, acc2):
+        return acc1 + acc2
+
+    def compute_metrics(self, acc) -> metrics.AggregateErrorMetrics:
+        kept = acc.kept_partitions_expected
+        error_l0_expected = acc.error_l0_expected / kept
+        error_linf_min_expected = acc.error_linf_min_expected / kept
+        error_linf_max_expected = acc.error_linf_max_expected / kept
+        error_linf_expected = (error_linf_min_expected +
+                               error_linf_max_expected)
+        rel_error_l0_expected = acc.rel_error_l0_expected / kept
+        rel_error_linf_min_expected = acc.rel_error_linf_min_expected / kept
+        rel_error_linf_max_expected = acc.rel_error_linf_max_expected / kept
+        rel_error_linf_expected = (rel_error_linf_min_expected +
+                                   rel_error_linf_max_expected)
+        total_aggregate = max(1.0, acc.total_aggregate)
+        return metrics.AggregateErrorMetrics(
+            metric_type=self._metric_type,
+            ratio_data_dropped_l0=acc.data_dropped_l0 / total_aggregate,
+            ratio_data_dropped_linf=acc.data_dropped_linf / total_aggregate,
+            ratio_data_dropped_partition_selection=(
+                acc.data_dropped_partition_selection / total_aggregate),
+            error_l0_expected=error_l0_expected,
+            error_linf_expected=error_linf_expected,
+            error_linf_min_expected=error_linf_min_expected,
+            error_linf_max_expected=error_linf_max_expected,
+            error_expected=error_l0_expected + error_linf_expected,
+            error_l0_variance=acc.error_l0_variance / kept,
+            error_variance=acc.error_variance / kept,
+            error_quantiles=[q / kept for q in acc.error_quantiles],
+            rel_error_l0_expected=rel_error_l0_expected,
+            rel_error_linf_expected=rel_error_linf_expected,
+            rel_error_linf_min_expected=rel_error_linf_min_expected,
+            rel_error_linf_max_expected=rel_error_linf_max_expected,
+            rel_error_expected=(rel_error_l0_expected +
+                                rel_error_linf_expected),
+            rel_error_l0_variance=acc.rel_error_l0_variance / kept,
+            rel_error_variance=acc.rel_error_variance / kept,
+            rel_error_quantiles=[
+                q / kept for q in acc.rel_error_quantiles
+            ],
+            error_expected_w_dropped_partitions=(
+                acc.error_expected_w_dropped_partitions /
+                acc.num_partitions),
+            rel_error_expected_w_dropped_partitions=(
+                acc.rel_error_expected_w_dropped_partitions /
+                acc.num_partitions),
+            noise_std=acc.noise_std)
+
+    def metrics_names(self) -> List[str]:
+        return []
+
+    def explain_computation(self):
+        pass
+
+    def _invert_error_quantiles(self,
+                                quantiles: List[float]) -> List[float]:
+        # Bounding error is negative, so the worst error quantiles come
+        # from the (1-q) side of the noise+bounding distribution.
+        return [(1 - q) for q in quantiles]
+
+    def _compute_error_quantiles(self, prob_to_keep: float,
+                                 metric: metrics.SumMetrics) -> List[float]:
+        error_expectation = metric.expected_cross_partition_error
+        error_std = math.sqrt(metric.std_cross_partition_error**2 +
+                              metric.std_noise**2)
+        if metric.noise_kind == NoiseKind.GAUSSIAN:
+            qs = scipy.stats.norm.ppf(q=self._error_quantiles,
+                                      loc=error_expectation,
+                                      scale=error_std)
+        else:
+            qs = probability_computations.compute_sum_laplace_gaussian_quantiles(
+                laplace_b=metric.std_noise / math.sqrt(2),
+                gaussian_sigma=metric.std_cross_partition_error,
+                quantiles=self._error_quantiles,
+                num_samples=10**3)
+            # Deliberate fix vs the reference (:669-675): its Laplace branch
+            # samples a zero-centered distribution and never shifts by the
+            # expected L0 error, while its Gaussian branch passes
+            # loc=error_expectation — we center both consistently.
+            qs = [q + error_expectation for q in qs]
+        per_partition_error = (metric.per_partition_error_min +
+                               metric.per_partition_error_max)
+        return [
+            prob_to_keep * (float(q) + per_partition_error) for q in qs
+        ]
+
+
+class PrivatePartitionSelectionAggregateErrorMetricsCombiner(
+        dp_combiners.Combiner):
+    """Aggregates keep probabilities into partition-selection metrics
+    (reference :682-723)."""
+    AccumulatorType = PartitionSelectionAccumulator
+
+    def __init__(self, error_quantiles: List[float]):
+        self._error_quantiles = error_quantiles
+
+    def create_accumulator(self, prob_to_keep: float):
+        return ([prob_to_keep], None)
+
+    def merge_accumulators(self, acc1, acc2):
+        return _merge_partition_selection_accumulators(acc1, acc2)
+
+    def compute_metrics(self, acc) -> metrics.PartitionSelectionMetrics:
+        probs, moments = acc
+        if moments is None:
+            moments = _probabilities_to_moments(probs)
+        return metrics.PartitionSelectionMetrics(
+            num_partitions=moments.count,
+            dropped_partitions_expected=(moments.count -
+                                         moments.expectation),
+            dropped_partitions_variance=moments.variance)
+
+    def metrics_names(self) -> List[str]:
+        return []
+
+    def explain_computation(self):
+        pass

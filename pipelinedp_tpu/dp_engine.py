@@ -95,8 +95,13 @@ class DPEngine:
             return self._backend.annotate(col, "annotation", params=params,
                                           budget=budget)
 
+    # Subclasses that swap graph nodes (e.g. the utility-analysis engine)
+    # must not take the fused shortcut.
+    _supports_fused_dispatch = True
+
     def _aggregate(self, col, params, data_extractors, public_partitions):
-        if getattr(self._backend, "supports_fused_aggregation", False):
+        if self._supports_fused_dispatch and getattr(
+                self._backend, "supports_fused_aggregation", False):
             from pipelinedp_tpu import jax_engine
             if jax_engine.params_are_fusable(params):
                 return jax_engine.build_fused_aggregation(
@@ -105,6 +110,24 @@ class DPEngine:
                     self._current_report_generator,
                     rng_seed=getattr(self._backend, "rng_seed", None),
                     mesh=getattr(self._backend, "mesh", None))
+        from pipelinedp_tpu import jax_engine
+        if isinstance(col, jax_engine.ArrayDataset):
+            # Columnar input on a generic backend: expand to row tuples
+            # with positional extractors.
+            if (col.privacy_ids is None and
+                    not params.contribution_bounds_already_enforced):
+                raise ValueError(
+                    "ArrayDataset.privacy_ids must be set unless "
+                    "contribution_bounds_already_enforced is True.")
+            col = col.to_rows()
+            if data_extractors.partition_extractor is None:
+                import operator
+                data_extractors = DataExtractors(
+                    privacy_id_extractor=(
+                        None if params.contribution_bounds_already_enforced
+                        else operator.itemgetter(0)),
+                    partition_extractor=operator.itemgetter(1),
+                    value_extractor=operator.itemgetter(2))
         if params.custom_combiners:
             combiner = combiners.create_compound_combiner_with_custom_combiners(
                 params, self._budget_accountant, params.custom_combiners)

@@ -81,12 +81,6 @@ def rank_within_group(group_of_seg, key, valid_seg):
     return rank
 
 
-def per_segment_field(values, seg_id, num_segments):
-    """Segment sum of a per-row field (the fused ``create_accumulator`` /
-    ``merge_accumulators``)."""
-    return jax.ops.segment_sum(values, seg_id, num_segments=num_segments)
-
-
 def per_segment_first(values, seg_id, new_seg, num_segments):
     """First row's value per segment (for constant-within-segment fields
     like pid/pk)."""

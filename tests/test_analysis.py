@@ -1,0 +1,348 @@
+"""Tests for the utility-analysis package — error models vs closed-form
+expectations, Poisson-binomial exactness, histograms, the full sweep and
+tuning E2E (mirrors the reference's ``analysis/tests/`` strategy)."""
+
+import math
+import operator
+
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import analysis
+from pipelinedp_tpu.analysis import combiners as ua_combiners
+from pipelinedp_tpu.analysis import data_structures, histograms, metrics
+from pipelinedp_tpu.analysis import poisson_binomial
+from pipelinedp_tpu.budget_accounting import MechanismSpec
+from pipelinedp_tpu.combiners import CombinerParams
+from pipelinedp_tpu.aggregate_params import MechanismType
+
+
+def extractors():
+    return pdp.DataExtractors(privacy_id_extractor=operator.itemgetter(0),
+                              partition_extractor=operator.itemgetter(1),
+                              value_extractor=operator.itemgetter(2))
+
+
+def count_params(l0=1, linf=1, **kw):
+    base = dict(metrics=[pdp.Metrics.COUNT], max_partitions_contributed=l0,
+                max_contributions_per_partition=linf)
+    base.update(kw)
+    return pdp.AggregateParams(**base)
+
+
+class TestPoissonBinomial:
+
+    def test_exact_pmf_matches_binomial(self):
+        # All p equal -> binomial distribution.
+        from scipy.stats import binom
+        p = 0.3
+        pmf = poisson_binomial.compute_pmf([p] * 10)
+        expected = binom.pmf(np.arange(11), 10, p)
+        np.testing.assert_allclose(pmf.probabilities, expected, atol=1e-12)
+
+    def test_approximation_close_to_exact(self):
+        rng = np.random.default_rng(0)
+        probs = rng.uniform(0.2, 0.8, 200).tolist()
+        exact = poisson_binomial.compute_pmf(probs)
+        exp, std, skew = poisson_binomial.compute_exp_std_skewness(probs)
+        approx = poisson_binomial.compute_pmf_approximation(
+            exp, std, skew, len(probs))
+        # Compare a central slice of the distributions.
+        for v in range(int(exp - std), int(exp + std)):
+            pe = exact.probabilities[v - exact.start]
+            pa = approx.probabilities[v - approx.start]
+            assert pe == pytest.approx(pa, abs=2e-3)
+
+    def test_zero_sigma(self):
+        pmf = poisson_binomial.compute_pmf_approximation(5.0, 0.0, 0.0, 10)
+        assert pmf.start == 5
+        assert pmf.probabilities.tolist() == [1.0]
+
+
+class TestMultiParameterConfiguration:
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="at least 1"):
+            data_structures.MultiParameterConfiguration()
+        with pytest.raises(ValueError, match="same length"):
+            data_structures.MultiParameterConfiguration(
+                max_partitions_contributed=[1, 2],
+                max_contributions_per_partition=[1])
+
+    def test_get_aggregate_params(self):
+        mpc = data_structures.MultiParameterConfiguration(
+            max_partitions_contributed=[1, 2],
+            max_contributions_per_partition=[10, 11])
+        base = count_params(l0=5, linf=5)
+        p0 = mpc.get_aggregate_params(base, 0)
+        p1 = mpc.get_aggregate_params(base, 1)
+        assert (p0.max_partitions_contributed,
+                p0.max_contributions_per_partition) == (1, 10)
+        assert (p1.max_partitions_contributed,
+                p1.max_contributions_per_partition) == (2, 11)
+
+
+class TestAnalysisCombiners:
+
+    def _params(self, agg_params, eps=1.0, delta=1e-6):
+        spec = MechanismSpec(MechanismType.LAPLACE, _eps=eps, _delta=delta)
+        return CombinerParams(spec, agg_params)
+
+    def test_count_combiner_error_model(self):
+        # One user contributes 5 rows, linf=3 -> linf error = -2;
+        # n_partitions=2, l0=1 -> keep prob 0.5 ->
+        # expected l0 error = -3*0.5, var = 9*0.25.
+        params = self._params(count_params(l0=1, linf=3))
+        c = ua_combiners.CountCombiner(params)
+        acc = c.create_accumulator(
+            (np.array([5]), np.array([0.0]), np.array([2])))
+        m = c.compute_metrics(acc)
+        assert m.sum == 5
+        assert m.per_partition_error_max == -2
+        assert m.expected_cross_partition_error == pytest.approx(-1.5)
+        assert m.std_cross_partition_error == pytest.approx(1.5)
+        assert m.std_noise > 0
+
+    def test_privacy_id_count_combiner(self):
+        params = self._params(count_params(l0=2, linf=1))
+        c = ua_combiners.PrivacyIdCountCombiner(params)
+        acc = c.create_accumulator(
+            (np.array([7, 0]), np.array([0.0, 0.0]), np.array([4, 4])))
+        m = c.compute_metrics(acc)
+        assert m.sum == 1.0  # only one user has counts > 0
+        assert m.expected_cross_partition_error == pytest.approx(-0.5)
+
+    def test_sum_combiner_clipping_errors(self):
+        agg = pdp.AggregateParams(
+            metrics=[pdp.Metrics.SUM], max_partitions_contributed=1,
+            max_contributions_per_partition=1,
+            min_sum_per_partition=0.0, max_sum_per_partition=10.0)
+        c = ua_combiners.SumCombiner(self._params(agg))
+        acc = c.create_accumulator(
+            (None, np.array([15.0, -5.0]), np.array([1, 1])))
+        m = c.compute_metrics(acc)
+        assert m.sum == 10.0
+        assert m.per_partition_error_max == -5.0  # 15 clipped to 10
+        assert m.per_partition_error_min == 5.0  # -5 clipped to 0
+
+    def test_partition_selection_combiner_probability(self):
+        params = self._params(count_params(l0=1, linf=1), eps=1.0,
+                              delta=1e-5)
+        c = ua_combiners.PartitionSelectionCombiner(params)
+        # 200 users each contributing to this partition only -> all keep
+        # probability 1 -> partition almost surely kept.
+        acc = c.create_accumulator(
+            (np.ones(200), np.zeros(200), np.ones(200)))
+        prob = c.compute_metrics(acc)
+        assert prob == pytest.approx(1.0, abs=1e-3)
+
+    def test_sparse_to_dense_switch(self):
+        params = self._params(count_params())
+        compound = ua_combiners.CompoundCombiner(
+            [ua_combiners.CountCombiner(params)], return_named_tuple=False)
+        acc = compound.create_accumulator((1, 1.0, 1))
+        # Merge many: should flip to dense (2 * 1 combiner = 2 max sparse).
+        for _ in range(5):
+            acc = compound.merge_accumulators(
+                acc, compound.create_accumulator((1, 1.0, 1)))
+        sparse, dense = acc
+        assert sparse is None
+        assert dense is not None
+
+    def test_moments_merge_beyond_cap(self):
+        probs = [0.5] * (ua_combiners.MAX_PROBABILITIES_IN_ACCUMULATOR + 1)
+        acc1 = (probs[:60], None)
+        acc2 = (probs[:60], None)
+        merged = ua_combiners._merge_partition_selection_accumulators(
+            acc1, acc2)
+        assert merged[0] is None
+        assert merged[1].count == 120
+        assert merged[1].expectation == pytest.approx(60.0)
+
+
+class TestHistograms:
+
+    def test_bin_lower(self):
+        assert histograms._to_bin_lower(123) == 123
+        assert histograms._to_bin_lower(1234) == 1230
+        assert histograms._to_bin_lower(12345) == 12300
+
+    def test_dataset_histograms(self):
+        # 3 users: u0 -> 2 partitions (1 row each); u1 -> 1 partition with
+        # 3 rows; u2 -> 1 partition 1 row.
+        data = ([(0, "a", 1.0), (0, "b", 1.0)] + [(1, "a", 1.0)] * 3 +
+                [(2, "b", 1.0)])
+        backend = pdp.LocalBackend()
+        result = analysis.compute_dataset_histograms(
+            data, extractors(), backend)
+        hist = list(result)[0]
+        assert hist.l0_contributions_histogram.total_count() == 3
+        assert hist.l0_contributions_histogram.max_value == 2
+        assert hist.linf_contributions_histogram.max_value == 3
+        assert hist.count_per_partition_histogram.total_count() == 2
+        assert hist.count_privacy_id_per_partition.max_value == 2
+
+    def test_quantiles(self):
+        bins = [
+            histograms.FrequencyBin(lower=i, count=10, sum=10 * i, max=i)
+            for i in range(1, 11)
+        ]
+        h = histograms.Histogram(histograms.HistogramType.L0_CONTRIBUTIONS,
+                                 bins)
+        q = h.quantiles([0.05, 0.5, 0.95])
+        assert q[0] == 1
+        assert q[1] in (5, 6)
+        assert q[2] == 10
+
+
+class TestPerformUtilityAnalysis:
+
+    def test_count_analysis_private_partitions(self):
+        n_users = 60
+        data = [(u, pk, 1.0) for u in range(n_users)
+                for pk in ("a", "b")]
+        backend = pdp.LocalBackend()
+        options = analysis.UtilityAnalysisOptions(
+            epsilon=2.0, delta=1e-5,
+            aggregate_params=count_params(l0=2, linf=1))
+        result = list(
+            analysis.perform_utility_analysis(data, backend, options,
+                                              extractors()))[0]
+        assert len(result) == 1
+        am = result[0]
+        assert am.count_metrics is not None
+        assert am.partition_selection_metrics is not None
+        assert am.partition_selection_metrics.num_partitions == 2
+        # No contribution bounding error (bounds are not binding).
+        assert am.count_metrics.error_expected == pytest.approx(0.0,
+                                                                abs=1e-6)
+        assert am.count_metrics.noise_std > 0
+
+    def test_multi_configuration_sweep(self):
+        data = [(u, "a", 1.0) for u in range(30) for _ in range(4)]
+        backend = pdp.LocalBackend()
+        mpc = analysis.MultiParameterConfiguration(
+            max_contributions_per_partition=[1, 2, 4])
+        options = analysis.UtilityAnalysisOptions(
+            epsilon=2.0, delta=1e-5,
+            aggregate_params=count_params(l0=1, linf=1),
+            multi_param_configuration=mpc)
+        result = list(
+            analysis.perform_utility_analysis(data, backend, options,
+                                              extractors()))[0]
+        assert len(result) == 3
+        # linf=1 truncates 3/4 of rows; linf=4 keeps all.
+        err1 = result[0].count_metrics.error_linf_expected
+        err4 = result[2].count_metrics.error_linf_expected
+        assert err1 == pytest.approx(-90.0)  # 30 users * (1 - 4)
+        assert err4 == pytest.approx(0.0)
+
+    def test_public_partitions(self):
+        data = [(u, "a", 1.0) for u in range(20)]
+        backend = pdp.LocalBackend()
+        options = analysis.UtilityAnalysisOptions(
+            epsilon=1.0, delta=1e-5, aggregate_params=count_params())
+        result = list(
+            analysis.perform_utility_analysis(
+                data, backend, options, extractors(),
+                public_partitions=["a", "b"]))[0]
+        am = result[0]
+        assert am.partition_selection_metrics is None
+        assert am.count_metrics is not None
+
+
+class TestPreAggregation:
+
+    def test_preaggregate_output(self):
+        data = [(0, "a", 2.0), (0, "a", 3.0), (0, "b", 1.0), (1, "a", 4.0)]
+        backend = pdp.LocalBackend()
+        result = sorted(
+            analysis.preaggregate(data, backend, extractors()),
+            key=repr)
+        # (pk, (count, sum, n_partitions))
+        assert ("a", (2, 5.0, 2)) in result
+        assert ("b", (1, 1.0, 2)) in result
+        assert ("a", (1, 4.0, 1)) in result
+
+    def test_analysis_on_preaggregated(self):
+        data = [(0, "a", 1.0), (1, "a", 1.0), (2, "a", 1.0)]
+        backend = pdp.LocalBackend()
+        pre = list(analysis.preaggregate(data, backend, extractors()))
+        options = analysis.UtilityAnalysisOptions(
+            epsilon=1.0, delta=1e-5, aggregate_params=count_params(),
+            pre_aggregated_data=True)
+        pre_extractors = analysis.PreAggregateExtractors(
+            partition_extractor=operator.itemgetter(0),
+            preaggregate_extractor=operator.itemgetter(1))
+        result = list(
+            analysis.perform_utility_analysis(pre, backend, options,
+                                              pre_extractors))[0]
+        assert result[0].count_metrics is not None
+
+
+class TestTune:
+
+    def test_tune_count(self):
+        rng = np.random.default_rng(0)
+        # Users with varying contribution counts across partitions.
+        data = []
+        for u in range(100):
+            n_parts = rng.integers(1, 6)
+            for pk in rng.choice(20, n_parts, replace=False):
+                for _ in range(rng.integers(1, 4)):
+                    data.append((u, int(pk), 1.0))
+        backend = pdp.LocalBackend()
+        hist = list(
+            analysis.compute_dataset_histograms(data, extractors(),
+                                                backend))[0]
+        options = analysis.TuneOptions(
+            epsilon=2.0, delta=1e-5,
+            aggregate_params=count_params(),
+            function_to_minimize=analysis.MinimizingFunction.ABSOLUTE_ERROR,
+            parameters_to_tune=analysis.ParametersToTune(
+                max_partitions_contributed=True,
+                max_contributions_per_partition=True))
+        result = list(
+            analysis.tune(data, backend, hist, options, extractors()))[0]
+        assert isinstance(result, analysis.TuneResult)
+        n_configs = result.utility_analysis_parameters.size
+        assert len(result.utility_analysis_results) == n_configs
+        assert 0 <= result.index_best < n_configs
+
+    def test_tune_rejects_unsupported(self):
+        options_kwargs = dict(
+            epsilon=1.0, delta=1e-5,
+            function_to_minimize=analysis.MinimizingFunction.ABSOLUTE_ERROR,
+            parameters_to_tune=analysis.ParametersToTune(
+                max_partitions_contributed=True))
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.SUM], max_partitions_contributed=1,
+            max_contributions_per_partition=1, min_value=0.0,
+            max_value=1.0)
+        with pytest.raises(NotImplementedError):
+            analysis.tune([1], pdp.LocalBackend(), None,
+                          analysis.TuneOptions(aggregate_params=params,
+                                               **options_kwargs),
+                          extractors())
+
+
+class TestUtilityAnalysisEngineValidation:
+
+    def test_aggregate_raises(self):
+        acc = pdp.NaiveBudgetAccountant(1.0, 1e-6)
+        engine = analysis.UtilityAnalysisEngine(acc, pdp.LocalBackend())
+        with pytest.raises(ValueError, match="can't be called"):
+            engine.aggregate([1], count_params(), extractors())
+
+    def test_unsupported_metrics_rejected(self):
+        acc = pdp.NaiveBudgetAccountant(1.0, 1e-6)
+        engine = analysis.UtilityAnalysisEngine(acc, pdp.LocalBackend())
+        options = analysis.UtilityAnalysisOptions(
+            epsilon=1.0, delta=1e-6,
+            aggregate_params=pdp.AggregateParams(
+                metrics=[pdp.Metrics.MEAN], max_partitions_contributed=1,
+                max_contributions_per_partition=1, min_value=0.0,
+                max_value=1.0))
+        with pytest.raises(NotImplementedError):
+            engine.analyze([(0, "a", 1.0)], options, extractors())
