@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""DP aggregation over movie view ratings (the reference's flagship
+example, ``examples/movie_view_ratings/`` — synthetic data generated
+in-process so no download is needed).
+
+Computes COUNT + SUM + MEAN (+ optional percentiles) of ratings per
+movie, with private partition selection.
+
+Usage:
+  python examples/movie_view_ratings.py                 # fused TPU plane
+  python examples/movie_view_ratings.py --backend local # generator plane
+  python examples/movie_view_ratings.py --public        # public partitions
+"""
+
+import argparse
+import operator
+import time
+
+import numpy as np
+
+
+def generate_data(n_rows=500_000, n_users=50_000, n_movies=2_000, seed=0):
+    rng = np.random.default_rng(seed)
+    import pipelinedp_tpu as pdp
+    movies = rng.zipf(1.3, n_rows) % n_movies
+    return pdp.ArrayDataset(
+        privacy_ids=rng.integers(0, n_users, n_rows),
+        partition_keys=movies.astype(np.int64),
+        values=rng.integers(1, 6, n_rows).astype(np.float64))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--backend", choices=["jax", "local", "multiproc"],
+                        default="jax")
+    parser.add_argument("--public", action="store_true",
+                        help="use public partitions (all movie ids)")
+    parser.add_argument("--rows", type=int, default=500_000)
+    parser.add_argument("--percentiles", action="store_true")
+    args = parser.parse_args()
+
+    import pipelinedp_tpu as pdp
+
+    if args.backend == "jax":
+        from pipelinedp_tpu.backends import JaxBackend
+        backend = JaxBackend()
+    elif args.backend == "multiproc":
+        backend = pdp.MultiProcLocalBackend()
+    else:
+        backend = pdp.LocalBackend()
+
+    data = generate_data(n_rows=args.rows)
+    metrics = [pdp.Metrics.COUNT, pdp.Metrics.SUM, pdp.Metrics.MEAN]
+    if args.percentiles:
+        metrics += [pdp.Metrics.PERCENTILE(50), pdp.Metrics.PERCENTILE(90)]
+
+    accountant = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                           total_delta=1e-6)
+    engine = pdp.DPEngine(accountant, backend)
+    params = pdp.AggregateParams(
+        metrics=metrics, noise_kind=pdp.NoiseKind.LAPLACE,
+        max_partitions_contributed=4, max_contributions_per_partition=2,
+        min_value=1.0, max_value=5.0)
+    report = pdp.ExplainComputationReport()
+    public = list(range(2_000)) if args.public else None
+    result = engine.aggregate(data, params, pdp.DataExtractors(),
+                              public_partitions=public,
+                              out_explain_computation_report=report)
+    accountant.compute_budgets()
+
+    t0 = time.perf_counter()
+    rows = list(result)
+    dt = time.perf_counter() - t0
+    print(f"{len(rows)} movies released in {dt:.2f}s "
+          f"({args.rows / dt:,.0f} rows/s) on backend={args.backend}")
+    for movie, m in sorted(rows)[:5]:
+        print(f"  movie {movie}: count={m.count:.0f} sum={m.sum:.0f} "
+              f"mean={m.mean:.2f}")
+    print()
+    print(report.text())
+
+
+if __name__ == "__main__":
+    main()

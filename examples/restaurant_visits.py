@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""DP analysis of weekly restaurant visits with utility analysis and
+parameter tuning (the reference's ``examples/restaurant_visits/``,
+synthetic data generated in-process).
+
+Usage:
+  python examples/restaurant_visits.py             # DP privacy-id count
+  python examples/restaurant_visits.py --analyze   # utility analysis
+  python examples/restaurant_visits.py --tune      # parameter tuning
+"""
+
+import argparse
+import operator
+
+import numpy as np
+
+
+def generate_visits(n_visitors=2_000, n_restaurants=40, seed=0):
+    """(visitor_id, restaurant, spend) rows: frequent diners visit several
+    restaurants several times a week."""
+    rng = np.random.default_rng(seed)
+    rows = []
+    for v in range(n_visitors):
+        n_visits = int(rng.integers(1, 8))
+        for _ in range(n_visits):
+            rows.append((v, int(rng.integers(0, n_restaurants)),
+                         float(rng.uniform(5, 50))))
+    return rows
+
+
+def extractors():
+    import pipelinedp_tpu as pdp
+    return pdp.DataExtractors(privacy_id_extractor=operator.itemgetter(0),
+                              partition_extractor=operator.itemgetter(1),
+                              value_extractor=operator.itemgetter(2))
+
+
+def run_dp_count(data):
+    import pipelinedp_tpu as pdp
+    backend = pdp.LocalBackend()
+    accountant = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                           total_delta=1e-6)
+    pcol = pdp.make_private(data, backend, accountant,
+                            operator.itemgetter(0))
+    result = pcol.privacy_id_count(
+        pdp.PrivacyIdCountParams(
+            max_partitions_contributed=3,
+            partition_extractor=operator.itemgetter(1)))
+    accountant.compute_budgets()
+    out = sorted(dict(result).items())
+    print(f"{len(out)} restaurants selected; first 5:")
+    for r, c in out[:5]:
+        print(f"  restaurant {r}: ~{c:.0f} distinct visitors")
+
+
+def run_analysis(data):
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu import analysis
+    backend = pdp.LocalBackend()
+    options = analysis.UtilityAnalysisOptions(
+        epsilon=1.0, delta=1e-6,
+        aggregate_params=pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT], max_partitions_contributed=3,
+            max_contributions_per_partition=2),
+        multi_param_configuration=analysis.MultiParameterConfiguration(
+            max_contributions_per_partition=[1, 2, 4, 8]))
+    results = list(
+        analysis.perform_utility_analysis(data, backend, options,
+                                          extractors()))[0]
+    print("linf sweep (COUNT):")
+    for am in results:
+        p = am.input_aggregate_params
+        cm = am.count_metrics
+        print(f"  linf={p.max_contributions_per_partition}: "
+              f"rmse={cm.absolute_rmse():.2f} "
+              f"dropped_linf={cm.ratio_data_dropped_linf:.1%}")
+
+
+def run_tuning(data):
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu import analysis
+    backend = pdp.LocalBackend()
+    hist = list(
+        analysis.compute_dataset_histograms(data, extractors(),
+                                            backend))[0]
+    options = analysis.TuneOptions(
+        epsilon=1.0, delta=1e-6,
+        aggregate_params=pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT], max_partitions_contributed=1,
+            max_contributions_per_partition=1),
+        function_to_minimize=analysis.MinimizingFunction.ABSOLUTE_ERROR,
+        parameters_to_tune=analysis.ParametersToTune(
+            max_partitions_contributed=True,
+            max_contributions_per_partition=True))
+    result = list(
+        analysis.tune(data, backend, hist, options, extractors()))[0]
+    best = result.utility_analysis_parameters.get_aggregate_params(
+        options.aggregate_params, result.index_best)
+    print(f"tuned over {result.utility_analysis_parameters.size} configs")
+    print(f"best: l0={best.max_partitions_contributed} "
+          f"linf={best.max_contributions_per_partition} "
+          f"(rmse={result.utility_analysis_results[result.index_best].count_metrics.absolute_rmse():.2f})")
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--analyze", action="store_true")
+    parser.add_argument("--tune", action="store_true")
+    args = parser.parse_args()
+    data = generate_visits()
+    if args.analyze:
+        run_analysis(data)
+    elif args.tune:
+        run_tuning(data)
+    else:
+        run_dp_count(data)
+
+
+if __name__ == "__main__":
+    main()

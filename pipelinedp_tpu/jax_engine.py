@@ -694,9 +694,13 @@ class LazyFusedResult:
         self._cache = None
 
     def __iter__(self):
+        # Generator function: the body (and thus execution) is deferred
+        # until the first next() — downstream generator expressions call
+        # iter() at construction time, which must not trigger the kernel
+        # before compute_budgets().
         if self._cache is None:
             self._cache = self._execute()
-        return iter(self._cache)
+        yield from self._cache
 
     def _execute(self):
         config = self._config

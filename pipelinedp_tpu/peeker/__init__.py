@@ -1,0 +1,10 @@
+"""Interactive utility-analysis helpers — the 'peeker' workflow
+(capability parity with the reference's legacy ``utility_analysis/``
+package: ``DataPeeker`` sketching/sampling and ``PeekerEngine``
+approximate DP aggregation over sketches). The reference's stale
+``pipeline_dp.accumulator`` dependency (SURVEY.md §2.8) is replaced by
+the live combiner layer."""
+
+from pipelinedp_tpu.peeker.data_peeker import DataPeeker, SampleParams
+from pipelinedp_tpu.peeker.peeker_engine import (PeekerEngine,
+                                                 aggregate_sketch_true)

@@ -1,0 +1,135 @@
+"""DataPeeker — partition-sampled sketches, raw samples and true
+aggregates for interactive utility analysis (capability parity with the
+reference's ``utility_analysis/data_peeker.py``; its stale
+``pipeline_dp.accumulator`` dependency in ``sketch`` is replaced by the
+live combiner layer, SURVEY.md §2.8)."""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import List, Optional
+
+from pipelinedp_tpu.aggregate_params import Metric, Metrics
+from pipelinedp_tpu.dp_engine import DataExtractors
+from pipelinedp_tpu.peeker import non_private_combiners
+
+
+@dataclasses.dataclass
+class SampleParams:
+    """Sampling parameters (reference :49-52)."""
+    number_of_sampled_partitions: int
+    metrics: Optional[List[Metric]] = None
+
+
+def _extract_fn(data_extractors: DataExtractors, row):
+    return (data_extractors.privacy_id_extractor(row),
+            data_extractors.partition_extractor(row),
+            data_extractors.value_extractor(row))
+
+
+class DataPeeker:
+    """Sketch/sample/aggregate-true helpers (reference :71-270)."""
+
+    def __init__(self, backend):
+        self._be = backend
+
+    def _sample_partitions(self, col, n_partitions):
+        """(pk, value) -> same, keeping only n sampled partition keys."""
+        col = self._be.group_by_key(col, "Group by pk")
+        col = self._be.map_tuple(col, lambda pk, vs: (1, (pk, vs)),
+                                 "Rekey to (1, (pk, values))")
+        col = self._be.sample_fixed_per_key(col, n_partitions,
+                                            "Sample partitions")
+        return self._be.flat_map(col, lambda one_and_list: one_and_list[1],
+                                 "Extract sampled (pk, values)")
+
+    def sketch(self, input_data, params: SampleParams,
+               data_extractors: DataExtractors):
+        """Sketches: one row (partition_key, aggregated_value,
+        partition_count) per unique (pk, privacy_id), over a sample of
+        partitions (reference :77-183)."""
+        if params.metrics is None:
+            raise ValueError("Must provide aggregation metrics for sketch.")
+        if len(params.metrics) != 1 or params.metrics[0] not in (
+                Metrics.SUM, Metrics.COUNT):
+            raise ValueError("Sketch only supports a single aggregation "
+                             "and it must be COUNT or SUM.")
+        combiner = non_private_combiners.create_compound_combiner(
+            params.metrics)
+
+        col = self._be.map(input_data,
+                           functools.partial(_extract_fn, data_extractors),
+                           "Extract (privacy_id, partition_key, value)")
+        col = self._be.map_tuple(col, lambda pid, pk, v: (pk, (pid, v)),
+                                 "Rekey to (pk, (pid, value))")
+        col = self._sample_partitions(
+            col, params.number_of_sampled_partitions)
+
+        def flatten_sampled(pk_and_pid_values):
+            pk, pid_values = pk_and_pid_values
+            return [((pk, pid), v) for pid, v in pid_values]
+
+        col = self._be.flat_map(col, flatten_sampled,
+                                "Flatten to ((pk, pid), value)")
+        col = self._be.group_by_key(col, "Group by (pk, pid)")
+        col = self._be.map_values(col, combiner.create_accumulator,
+                                  "Aggregate per (pk, pid)")
+        # ((pk, pid), compound_accumulator)
+        col = self._be.map_tuple(
+            col, lambda pk_pid, acc: (pk_pid[1], (pk_pid[0], acc)),
+            "Rekey to (pid, (pk, accumulator))")
+        col = self._be.group_by_key(col, "Group by privacy id")
+
+        def attach_partition_count(pk_acc_list):
+            partition_count = len(set(pk for pk, _ in pk_acc_list))
+            return partition_count, pk_acc_list
+
+        col = self._be.map_values(col, attach_partition_count,
+                                  "Compute partition count")
+
+        def flatten_results(pid_and_rest):
+            _, (pcount, pk_acc_list) = pid_and_rest
+            # Compound accumulator = (row_count, (child_acc,)); the single
+            # raw child accumulator IS the aggregated value.
+            return [(pk, acc[1][0], pcount) for pk, acc in pk_acc_list]
+
+        return self._be.flat_map(
+            col, flatten_results,
+            "Flatten to (pk, aggregated_value, partition_count)")
+
+    def sample(self, input_data, params: SampleParams,
+               data_extractors: DataExtractors):
+        """Raw rows of a partition sample: (pid, pk, value)
+        (reference :184-227)."""
+        col = self._be.map(input_data,
+                           functools.partial(_extract_fn, data_extractors),
+                           "Extract (privacy_id, partition_key, value)")
+        col = self._be.map_tuple(col, lambda pid, pk, v: (pk, (pid, v)),
+                                 "Rekey to (pk, (pid, value))")
+        col = self._sample_partitions(
+            col, params.number_of_sampled_partitions)
+
+        def expand(pk_and_pid_values):
+            pk, pid_values = pk_and_pid_values
+            return [(pid, pk, v) for pid, v in pid_values]
+
+        return self._be.flat_map(col, expand,
+                                 "Transform to (pid, pk, value)")
+
+    def aggregate_true(self, col, params: SampleParams,
+                       data_extractors: DataExtractors):
+        """Raw (non-DP) per-partition aggregates (reference :228-270)."""
+        combiner = non_private_combiners.create_compound_combiner(
+            params.metrics)
+        col = self._be.map(col,
+                           functools.partial(_extract_fn, data_extractors),
+                           "Extract (privacy_id, partition_key, value)")
+        col = self._be.map_tuple(col, lambda pid, pk, v: (pk, v),
+                                 "Rekey to (pk, value)")
+        col = self._be.group_by_key(col, "Group by pk")
+        col = self._be.map_values(col, combiner.create_accumulator,
+                                  "Create accumulators")
+        return self._be.map_values(
+            col, lambda acc: combiner.compute_metrics(acc),
+            "Compute raw metrics")

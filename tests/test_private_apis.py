@@ -1,0 +1,194 @@
+"""Tests for the fluent private-collection API and the peeker package
+(mirrors the reference's ``tests/private_spark_test.py`` and
+``utility_analysis/tests/`` at the capability level)."""
+
+import operator
+
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import peeker
+from pipelinedp_tpu.backends import JaxBackend
+from pipelinedp_tpu.ops import noise as noise_ops
+
+BIG_EPS = 1e5
+
+
+def movie_rows(n_users=40):
+    # (user, movie, rating)
+    return [(u, m, 4.0) for u in range(n_users) for m in ("m1", "m2")]
+
+
+def extractors():
+    return pdp.DataExtractors(privacy_id_extractor=operator.itemgetter(0),
+                              partition_extractor=operator.itemgetter(1),
+                              value_extractor=operator.itemgetter(2))
+
+
+class TestPrivateCollection:
+
+    def _private(self, backend=None, eps=BIG_EPS):
+        backend = backend or pdp.LocalBackend()
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=eps,
+                                        total_delta=1e-10)
+        pcol = pdp.make_private(movie_rows(), backend, acc,
+                                operator.itemgetter(0))
+        return pcol, acc
+
+    def test_count(self):
+        noise_ops.seed_host_rng(0)
+        pcol, acc = self._private()
+        result = pcol.count(
+            pdp.CountParams(max_partitions_contributed=2,
+                            max_contributions_per_partition=1,
+                            partition_extractor=operator.itemgetter(1)))
+        acc.compute_budgets()
+        out = dict(result)
+        assert out["m1"] == pytest.approx(40, abs=0.5)
+
+    def test_sum_and_mean(self):
+        noise_ops.seed_host_rng(0)
+        pcol, acc = self._private()
+        s = pcol.sum(
+            pdp.SumParams(max_partitions_contributed=2,
+                          max_contributions_per_partition=1,
+                          min_value=0.0, max_value=5.0,
+                          partition_extractor=operator.itemgetter(1),
+                          value_extractor=operator.itemgetter(2)))
+        m = pcol.mean(
+            pdp.MeanParams(max_partitions_contributed=2,
+                           max_contributions_per_partition=1,
+                           min_value=0.0, max_value=5.0,
+                           partition_extractor=operator.itemgetter(1),
+                           value_extractor=operator.itemgetter(2)))
+        acc.compute_budgets()
+        assert dict(s)["m1"] == pytest.approx(160.0, rel=0.01)
+        assert dict(m)["m2"] == pytest.approx(4.0, abs=0.05)
+
+    def test_privacy_id_count(self):
+        noise_ops.seed_host_rng(0)
+        pcol, acc = self._private()
+        result = pcol.privacy_id_count(
+            pdp.PrivacyIdCountParams(
+                max_partitions_contributed=2,
+                partition_extractor=operator.itemgetter(1)))
+        acc.compute_budgets()
+        assert dict(result)["m1"] == pytest.approx(40, abs=0.5)
+
+    def test_variance(self):
+        noise_ops.seed_host_rng(0)
+        backend = pdp.LocalBackend()
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=BIG_EPS,
+                                        total_delta=1e-10)
+        data = [(u, "m", 2.0) for u in range(100)] + [
+            (u, "m", 8.0) for u in range(100, 200)
+        ]
+        pcol = pdp.make_private(data, backend, acc,
+                                operator.itemgetter(0))
+        result = pcol.variance(
+            pdp.VarianceParams(max_partitions_contributed=1,
+                               max_contributions_per_partition=1,
+                               min_value=0.0, max_value=10.0,
+                               partition_extractor=operator.itemgetter(1),
+                               value_extractor=operator.itemgetter(2)))
+        acc.compute_budgets()
+        assert dict(result)["m"] == pytest.approx(9.0, abs=0.3)
+
+    def test_map_flat_map(self):
+        noise_ops.seed_host_rng(0)
+        pcol, acc = self._private()
+        doubled = pcol.map(lambda row: (row[0], row[1], row[2] * 2))
+        result = doubled.sum(
+            pdp.SumParams(max_partitions_contributed=2,
+                          max_contributions_per_partition=1,
+                          min_value=0.0, max_value=10.0,
+                          partition_extractor=operator.itemgetter(1),
+                          value_extractor=operator.itemgetter(2)))
+        acc.compute_budgets()
+        assert dict(result)["m1"] == pytest.approx(320.0, rel=0.01)
+
+    def test_select_partitions(self):
+        noise_ops.seed_host_rng(0)
+        backend = pdp.LocalBackend()
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                        total_delta=1e-6)
+        data = [(u, "big", 1.0) for u in range(1000)]
+        pcol = pdp.make_private(data, backend, acc,
+                                operator.itemgetter(0))
+        result = pcol.select_partitions(
+            pdp.SelectPartitionsParams(max_partitions_contributed=1),
+            partition_extractor=operator.itemgetter(1))
+        acc.compute_budgets()
+        assert "big" in list(result)
+
+    def test_on_jax_backend(self):
+        noise_ops.seed_host_rng(0)
+        pcol, acc = self._private(backend=JaxBackend(rng_seed=0))
+        result = pcol.count(
+            pdp.CountParams(max_partitions_contributed=2,
+                            max_contributions_per_partition=1,
+                            partition_extractor=operator.itemgetter(1)))
+        acc.compute_budgets()
+        assert dict(result)["m1"] == pytest.approx(40, abs=0.5)
+
+
+class TestDataPeeker:
+
+    def test_sample_keeps_n_partitions(self):
+        noise_ops.seed_host_rng(0)
+        data = [(u, f"p{p}", 1.0) for u in range(20) for p in range(10)]
+        pk = peeker.DataPeeker(pdp.LocalBackend())
+        params = peeker.SampleParams(number_of_sampled_partitions=3)
+        out = list(pk.sample(data, params, extractors()))
+        pks = {pk for _, pk, _ in out}
+        assert len(pks) == 3
+        assert all(len(row) == 3 for row in out)
+
+    def test_sketch_count(self):
+        noise_ops.seed_host_rng(0)
+        data = [(u, "a", 1.0) for u in range(10) for _ in range(3)]
+        pk = peeker.DataPeeker(pdp.LocalBackend())
+        params = peeker.SampleParams(number_of_sampled_partitions=5,
+                                     metrics=[pdp.Metrics.COUNT])
+        out = list(pk.sketch(data, params, extractors()))
+        # One sketch row per (pk, pid): 10 rows, each count 3, pcount 1.
+        assert len(out) == 10
+        for pk_, value, pcount in out:
+            assert pk_ == "a"
+            assert value == 3
+            assert pcount == 1
+
+    def test_aggregate_true(self):
+        data = [(u, "a", 2.0) for u in range(10)]
+        pk = peeker.DataPeeker(pdp.LocalBackend())
+        params = peeker.SampleParams(number_of_sampled_partitions=5,
+                                     metrics=[pdp.Metrics.SUM])
+        out = dict(pk.aggregate_true(data, params, extractors()))
+        assert out["a"] == (20.0,)
+
+
+class TestPeekerEngine:
+
+    def test_aggregate_sketches_count(self):
+        noise_ops.seed_host_rng(0)
+        # Sketches: (pk, per-user count, partition_count)
+        sketches = [("a", 2, 1)] * 500
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=BIG_EPS,
+                                        total_delta=1e-6)
+        engine = peeker.PeekerEngine(acc, pdp.LocalBackend())
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=2)
+        result = engine.aggregate_sketches(sketches, params)
+        acc.compute_budgets()
+        out = dict(result)
+        assert out["a"].count == pytest.approx(1000, rel=0.01)
+
+    def test_aggregate_sketch_true(self):
+        sketches = [("a", 5.0, 1), ("a", 3.0, 2), ("b", 1.0, 1)]
+        out = dict(
+            peeker.aggregate_sketch_true(pdp.LocalBackend(), sketches,
+                                         pdp.Metrics.SUM))
+        assert out["a"] == 8.0
+        assert out["b"] == 1.0
