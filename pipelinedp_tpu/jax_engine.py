@@ -282,7 +282,10 @@ def _partials(config: FusedConfig, num_partitions: int, pid, pk, values,
         seg_valid = valid
         row_keep = valid
         seg_of_row = jnp.arange(n)
-        seg_count = row_keep.astype(jnp.float32)
+        # Counts accumulate as int32: float32 addition saturates at 2^24
+        # (1.0 + 16777216.0 == 16777216.0), silently under-counting huge
+        # partitions; int32 is exact to 2^31.
+        seg_count = row_keep.astype(jnp.int32)
         clipped = _clip_values(config, values)
         seg_values = jnp.where(
             _expand(row_keep, clipped), clipped, 0.0)
@@ -300,7 +303,7 @@ def _partials(config: FusedConfig, num_partitions: int, pid, pk, values,
         row_keep = svalid & (rank < config.linf)
         clipped = _clip_values(config, svalues)
         masked = jnp.where(_expand(row_keep, clipped), clipped, 0.0)
-        seg_count = jax.ops.segment_sum(row_keep.astype(jnp.float32),
+        seg_count = jax.ops.segment_sum(row_keep.astype(jnp.int32),
                                         seg_id, num_segments=n)
         seg_sums = _segment_fields(config, masked, seg_count, seg_id, n)
         # Segment -> (pid, pk) mapping.
@@ -314,15 +317,17 @@ def _partials(config: FusedConfig, num_partitions: int, pid, pk, values,
         seg_pk_final = jnp.where(keep_seg, seg_pk_final, 0)
 
     # --- per-pk reduction (shuffle 3 fused into a segment_sum) ---
-    kf = keep_seg.astype(jnp.float32)
     part = {}
     for name, arr in seg_sums.items():
-        contrib = jnp.where(_expand(keep_seg, arr), arr, 0.0)
+        contrib = jnp.where(_expand(keep_seg, arr), arr,
+                            jnp.zeros((), arr.dtype))
         part[name] = jax.ops.segment_sum(contrib, seg_pk_final,
                                          num_segments=P)
     # Privacy-id count per pk = number of kept segments (row_count in the
-    # reference's compound accumulator, dp_engine.py:339).
-    part_nseg = jax.ops.segment_sum(kf, seg_pk_final, num_segments=P)
+    # reference's compound accumulator, dp_engine.py:339). int32 — see
+    # the count-saturation note above.
+    part_nseg = jax.ops.segment_sum(keep_seg.astype(jnp.int32),
+                                    seg_pk_final, num_segments=P)
     return part, part_nseg
 
 
@@ -352,7 +357,8 @@ def _selection_and_metrics(config: FusedConfig, num_partitions: int, part,
         # Without privacy ids one row is not one user; the conservative
         # user-count estimate is ceil(rows / max_rows_per_privacy_id)
         # (reference dp_engine.py:341-348).
-        est_users = jnp.ceil(part_nseg / sel_rows_per_uid)
+        est_users = jnp.ceil(part_nseg.astype(jnp.float32) /
+                             sel_rows_per_uid)
         counts = est_users.astype(jnp.int32)
         if config.selection == (
                 PartitionSelectionStrategy.TRUNCATED_GEOMETRIC):
@@ -415,13 +421,14 @@ def _segment_fields(config: FusedConfig, masked_values, seg_count, seg_id,
         # sum(clip(x) - middle over kept rows) = raw_sum - middle * count.
         raw_sum = jax.ops.segment_sum(masked_values, seg_id,
                                       num_segments=num_segments)
-        out["nsum"] = raw_sum - middle * seg_count
+        cf = seg_count.astype(raw_sum.dtype)
+        out["nsum"] = raw_sum - middle * cf
         if "VARIANCE" in names:
             raw_sumsq = jax.ops.segment_sum(masked_values**2, seg_id,
                                             num_segments=num_segments)
             # sum((x-mid)^2) = sum(x^2) - 2 mid sum(x) + count mid^2
             out["nsumsq"] = (raw_sumsq - 2.0 * middle * raw_sum +
-                             seg_count * middle * middle)
+                             cf * middle * middle)
     return out
 
 
@@ -442,7 +449,7 @@ def _compute_metrics(config: FusedConfig, part, part_nseg, noise_scales,
         return jax.random.normal(k, shape)
 
     if "VARIANCE" in names or "MEAN" in names:
-        count = part["count"]
+        count = part["count"].astype(jnp.float32)
         dp_count = count + draw(keys[0], (P,)) * noise_scales[si]
         si += 1
         dp_nmean = (part["nsum"] + draw(keys[1], (P,)) * noise_scales[si]
@@ -470,16 +477,16 @@ def _compute_metrics(config: FusedConfig, part, part_nseg, noise_scales,
             out.pop("mean", None)
     else:
         if "COUNT" in names:
-            out["count"] = part["count"] + draw(keys[0],
-                                                (P,)) * noise_scales[si]
+            out["count"] = part["count"].astype(jnp.float32) + draw(
+                keys[0], (P,)) * noise_scales[si]
             si += 1
         if "SUM" in names:
             out["sum"] = part["sum"] + draw(keys[1],
                                             (P,)) * noise_scales[si]
             si += 1
     if "PRIVACY_ID_COUNT" in names:
-        out["privacy_id_count"] = part_nseg + draw(keys[3],
-                                                   (P,)) * noise_scales[si]
+        out["privacy_id_count"] = part_nseg.astype(jnp.float32) + draw(
+            keys[3], (P,)) * noise_scales[si]
         si += 1
     if "VECTOR_SUM" in names:
         vec = part["vector_sum"]
@@ -620,13 +627,16 @@ def _metric_field_order(config: FusedConfig) -> List[str]:
     names = set(config.metrics)
     fields = []
     if "VARIANCE" in names:
+        # Matches VarianceCombiner.compute_metrics dict-insertion order
+        # (variance, count, sum, mean) so positional consumers see the
+        # same layout on every backend.
         fields.append("variance")
-        if "MEAN" in names:
-            fields.append("mean")
         if "COUNT" in names:
             fields.append("count")
         if "SUM" in names:
             fields.append("sum")
+        if "MEAN" in names:
+            fields.append("mean")
     elif "MEAN" in names:
         fields.append("mean")
         if "COUNT" in names:

@@ -160,6 +160,19 @@ def seed_host_rng(seed: int) -> None:
     _host_rng = np.random.default_rng(seed)
 
 
+def reseed_host_rng_from_entropy() -> None:
+    """Reseeds the process-global host RNG from fresh OS entropy.
+
+    Forked worker processes inherit the parent's ``_host_rng`` *state*: two
+    workers that draw noise from it would produce identical noise streams,
+    and identical noise across partitions cancels in pairwise differences —
+    voiding the DP guarantee. Every process-pool worker must call this (via
+    the pool initializer) before touching the DP path.
+    """
+    global _host_rng
+    _host_rng = np.random.default_rng(np.random.SeedSequence())
+
+
 # ---------------------------------------------------------------------------
 # Device (JAX) sampling — one batched draw over all partitions
 # ---------------------------------------------------------------------------

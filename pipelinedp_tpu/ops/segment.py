@@ -27,7 +27,11 @@ def sort_rows(key, pid, pk, valid):
     Returns (sort_idx, spid, spk): permutation and sorted ids.
     """
     n = pid.shape[0]
-    tiebreak = jax.random.uniform(key, (n,))
+    # Full 32-bit tiebreak: float32 uniform has only ~2^24 distinct values,
+    # so at tens of millions of rows ties are common and the stable lexsort
+    # falls back to input order, biasing the "first k" sample toward
+    # earlier rows.
+    tiebreak = jax.random.bits(key, (n,), dtype=jnp.uint32)
     big_pid = jnp.where(valid, pid, PAD_ID)
     big_pk = jnp.where(valid, pk, PAD_ID)
     sort_idx = jnp.lexsort((tiebreak, big_pk, big_pid))
@@ -63,7 +67,7 @@ def rank_within_group(group_of_seg, key, valid_seg):
     ``group_of_seg``: int32[S] group id per segment (PAD_ID for padding).
     Returns rank[S]."""
     s = group_of_seg.shape[0]
-    tiebreak = jax.random.uniform(key, (s,))
+    tiebreak = jax.random.bits(key, (s,), dtype=jnp.uint32)
     group = jnp.where(valid_seg, group_of_seg, PAD_ID)
     order = jnp.lexsort((tiebreak, group))
     sorted_group = group[order]

@@ -288,6 +288,15 @@ class LocalBackend(PipelineBackend):
 # Top-level helpers so closures survive pickling into worker processes.
 
 
+def _mp_worker_init():
+    """Pool-worker initializer: forked workers inherit the parent's
+    ``noise_ops._host_rng`` *state*, so without reseeding every worker
+    would draw identical noise/selection randomness — identical noise
+    across partitions cancels in pairwise differences and voids DP."""
+    noise_ops.reseed_host_rng_from_entropy()
+    random.seed()
+
+
 def _mp_apply_chunk(fn_and_mode, chunk):
     fn, mode = fn_and_mode
     if mode == "map":
@@ -358,7 +367,8 @@ class MultiProcLocalBackend(PipelineBackend):
         # times per aggregation and fork startup costs ~100ms each.
         if self._pool_instance is None:
             import multiprocessing
-            self._pool_instance = multiprocessing.Pool(self._n_jobs)
+            self._pool_instance = multiprocessing.Pool(
+                self._n_jobs, initializer=_mp_worker_init)
         return self._pool_instance
 
     def close(self):

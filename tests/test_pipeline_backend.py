@@ -191,3 +191,39 @@ class TestAnnotators:
             assert rec.calls == [("p", "b")]
         finally:
             pipeline_backend._annotators.remove(rec)
+
+
+def _draw_worker_noise(_):
+    """Module-level (picklable) helper: draws from the worker's host RNG.
+    The sleep keeps each worker busy long enough that no single worker can
+    drain the whole task queue — every worker must participate, otherwise
+    the test could pass trivially (8 sequential draws from ONE shared RNG
+    state are also distinct)."""
+    import os
+    import time
+    from pipelinedp_tpu.ops import noise as noise_ops
+    draw = tuple(noise_ops.np_laplace(1.0, shape=4).tolist())
+    time.sleep(0.2)
+    return os.getpid(), draw
+
+
+class TestMultiProcWorkerSeeding:
+
+    def test_workers_draw_distinct_noise(self):
+        """Forked pool workers must NOT inherit identical RNG state:
+        identical noise streams across workers cancel in pairwise partition
+        differences, voiding DP (advisor finding, round 1)."""
+        backend = pipeline_backend.MultiProcLocalBackend(n_jobs=4)
+        try:
+            results = backend._pool().map(_draw_worker_noise, range(8),
+                                          chunksize=1)
+        finally:
+            backend.close()
+        first_draw_per_pid = {}
+        for pid, draw in results:
+            first_draw_per_pid.setdefault(pid, draw)
+        assert len(first_draw_per_pid) >= 2, (
+            "need at least two workers to exercise the regression")
+        draws = list(first_draw_per_pid.values())
+        assert len(set(draws)) == len(draws), (
+            "two pool workers produced identical noise streams")
