@@ -23,9 +23,17 @@ are padded to powers of two so repeated runs with similar sizes reuse the
 compile cache.
 
 Supported in the fused plane: COUNT, PRIVACY_ID_COUNT, SUM (both clipping
-modes), MEAN, VARIANCE, VECTOR_SUM, public and private partitions,
-``contribution_bounds_already_enforced``. PERCENTILE falls back to the
-generic backend graph (dense-tree batching lands with the analysis work).
+modes), MEAN, VARIANCE, VECTOR_SUM, PERCENTILE, public and private
+partitions, ``contribution_bounds_already_enforced``.
+
+PERCENTILE never materializes dense per-partition trees (height-4 ×
+branching-16 = 69,904 nodes per partition would be O(P·nodes) HBM): the
+quantile walk runs level-by-level over ALL partitions at once, counting
+each level's child buckets with one segment_sum over the rows, and node
+noise is a pure function of (partition, node index) via ``fold_in`` — the
+stateless equivalent of the host tree's noisy-count memoization
+(reference ``pipeline_dp/combiners.py:402-476``; host twin
+``ops/quantile_tree.py``).
 """
 
 from __future__ import annotations
@@ -45,6 +53,7 @@ from pipelinedp_tpu.aggregate_params import (AggregateParams, NoiseKind,
                                              PartitionSelectionStrategy)
 from pipelinedp_tpu.combiners import _create_named_tuple_instance
 from pipelinedp_tpu.ops import partition_selection as ps_ops
+from pipelinedp_tpu.ops import quantile_tree as quantile_tree_ops
 from pipelinedp_tpu.ops import segment as seg_ops
 
 
@@ -69,15 +78,23 @@ class FusedConfig:
     vector_max_norm: Optional[float]
     selection: Optional[PartitionSelectionStrategy]  # None = public
     bounds_already_enforced: bool
+    percentiles: Tuple[float, ...] = ()  # PERCENTILE(p) parameters, in order
 
     @staticmethod
     def from_params(params: AggregateParams,
                     public: bool) -> "FusedConfig":
         names = []
+        percentiles = []
         for m in params.metrics:
-            names.append(m.name)
+            if m.is_percentile:
+                percentiles.append(float(m.parameter))
+                if "PERCENTILE" not in names:
+                    names.append("PERCENTILE")
+            else:
+                names.append(m.name)
         return FusedConfig(
             metrics=tuple(names),
+            percentiles=tuple(percentiles),
             noise_kind=params.noise_kind,
             linf=params.max_contributions_per_partition,
             l0=params.max_partitions_contributed,
@@ -97,13 +114,23 @@ class FusedConfig:
 
 
 FUSABLE_METRICS = {"COUNT", "PRIVACY_ID_COUNT", "SUM", "MEAN", "VARIANCE",
-                   "VECTOR_SUM"}
+                   "VECTOR_SUM", "PERCENTILE"}
 
 
 def params_are_fusable(params: AggregateParams) -> bool:
     if params.custom_combiners:
         return False
-    return all(m.name in FUSABLE_METRICS for m in params.metrics)
+    for m in params.metrics:
+        if m.is_percentile:
+            # The quantile walk needs real tree bounds; a degenerate
+            # interval falls through to the generic path, which raises the
+            # same error the host tree would.
+            if (params.min_value is None or
+                    not params.min_value < params.max_value):
+                return False
+        elif m.name not in FUSABLE_METRICS:
+            return False
+    return True
 
 
 # ---------------------------------------------------------------------------
@@ -258,12 +285,13 @@ def fused_aggregate_kernel(config: FusedConfig, num_partitions: int, pid,
       key: PRNG key.
     """
     k_bound, k_sel, k_noise = jax.random.split(key, 3)
-    part, part_nseg = _partials(config, num_partitions, pid, pk, values,
-                                valid, k_bound)
+    part, part_nseg, qrows = _partials(config, num_partitions, pid, pk,
+                                       values, valid, k_bound)
     return _selection_and_metrics(config, num_partitions, part, part_nseg,
                                   noise_scales, keep_table, sel_threshold,
                                   sel_scale, sel_min_count,
-                                  sel_rows_per_uid, k_sel, k_noise)
+                                  sel_rows_per_uid, k_sel, k_noise,
+                                  qrows=qrows)
 
 
 def _partials(config: FusedConfig, num_partitions: int, pid, pk, values,
@@ -293,6 +321,8 @@ def _partials(config: FusedConfig, num_partitions: int, pid, pk, values,
                                    seg_of_row, n)
         keep_seg = seg_valid
         seg_pk_final = seg_pk
+        qrows = (_qrows(config, seg_pk, values, row_keep)
+                 if config.percentiles else None)
     else:
         sort_idx, spid, spk = seg_ops.sort_rows(k_sort, pid, pk, valid)
         svalid = valid[sort_idx]
@@ -315,6 +345,9 @@ def _partials(config: FusedConfig, num_partitions: int, pid, pk, values,
         l0_rank = seg_ops.rank_within_group(seg_pid, k_l0, seg_valid)
         keep_seg = seg_valid & (l0_rank < config.l0)
         seg_pk_final = jnp.where(keep_seg, seg_pk_final, 0)
+        qrows = (_qrows(config, spk, svalues,
+                        row_keep & keep_seg[seg_id])
+                 if config.percentiles else None)
 
     # --- per-pk reduction (shuffle 3 fused into a segment_sum) ---
     part = {}
@@ -328,13 +361,28 @@ def _partials(config: FusedConfig, num_partitions: int, pid, pk, values,
     # the count-saturation note above.
     part_nseg = jax.ops.segment_sum(keep_seg.astype(jnp.int32),
                                     seg_pk_final, num_segments=P)
-    return part, part_nseg
+    return part, part_nseg, qrows
+
+
+def _qrows(config: FusedConfig, pk, values, kept):
+    """Percentile row view: (pk, leaf index, kept mask) per row, in
+    whatever row order the caller is in. The leaf mapping mirrors the host
+    tree (``ops/quantile_tree.py:_leaf_index``)."""
+    b = quantile_tree_ops.DEFAULT_BRANCHING_FACTOR
+    height = quantile_tree_ops.DEFAULT_TREE_HEIGHT
+    n_leaves = b**height
+    lower, upper = config.min_value, config.max_value
+    v = jnp.clip(values, lower, upper)
+    frac = (v - lower) / (upper - lower)
+    leaf = jnp.minimum((frac * n_leaves).astype(jnp.int32), n_leaves - 1)
+    return (jnp.where(kept, pk, 0), leaf, kept)
 
 
 def _selection_and_metrics(config: FusedConfig, num_partitions: int, part,
                            part_nseg, noise_scales, keep_table,
                            sel_threshold, sel_scale, sel_min_count,
-                           sel_rows_per_uid, k_sel, k_noise):
+                           sel_rows_per_uid, k_sel, k_noise, qrows=None,
+                           psum_axis=None):
     """Batched partition selection + metric noising over the full pk axis.
     Runs replicated in the multi-chip path (identical keys on every
     device)."""
@@ -378,7 +426,120 @@ def _selection_and_metrics(config: FusedConfig, num_partitions: int, part,
     # --- metrics + one batched noise draw per mechanism ---
     metrics = _compute_metrics(config, part, part_nseg, noise_scales,
                                k_noise, P)
+    if config.percentiles:
+        # Percentile noise scale is the last _noise_scales entry; the tree
+        # key is independent of the metric-noise key stream.
+        k_tree = jax.random.fold_in(k_noise, 0x7ee)
+        vals = _percentile_values(config, P, qrows, noise_scales[-1],
+                                  k_tree, psum_axis)
+        for qi, name in enumerate(_percentile_field_names(
+                config.percentiles)):
+            metrics[name] = vals[:, qi]
     return keep_pk, metrics
+
+
+def _percentile_field_names(percentiles) -> List[str]:
+    """Same formatting as ``QuantileCombiner.metrics_names`` (reference
+    ``combiners.py:434-445``)."""
+    names = []
+    for p in percentiles:
+        int_p = int(round(p))
+        text = str(int_p) if int_p == p else str(p).replace(".", "_")
+        names.append(f"percentile_{text}")
+    return names
+
+
+def _node_noise(noise_kind: NoiseKind, key, node_ids):
+    """One noise draw per (partition, tree node), as a pure function of
+    the indices: every quantile walk that visits a node sees the same
+    noisy count — the stateless form of the host tree's memoization
+    (``ops/quantile_tree.py:176-183``). ``node_ids`` is int32 [P, Q, b]."""
+    P = node_ids.shape[0]
+    pkeys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+        jnp.arange(P, dtype=jnp.uint32))
+    flat = node_ids.reshape(P, -1).astype(jnp.uint32)
+
+    def per_pk(k, ids):
+        ks = jax.vmap(lambda i: jax.random.fold_in(k, i))(ids)
+        if noise_kind == NoiseKind.LAPLACE:
+            return jax.vmap(lambda kk: jax.random.laplace(kk, ()))(ks)
+        return jax.vmap(lambda kk: jax.random.normal(kk, ()))(ks)
+
+    return jax.vmap(per_pk)(pkeys, flat).reshape(node_ids.shape)
+
+
+def _percentile_values(config: FusedConfig, P, qrows, scale, key,
+                       psum_axis=None):
+    """Batched DP quantile-tree descent over every partition at once.
+
+    Level l needs, per (partition, quantile), the noisy counts of the
+    ``b`` children of the walk's current node. Rather than materializing
+    per-partition trees, each level counts its children with one
+    segment_sum over the rows (a row lands in child ``leaf//w - base``
+    of its partition's walk, or nowhere). In the sharded path the counts
+    are per-shard partials combined by psum — the only collective the
+    descent needs. The arithmetic (rank targeting, child pick,
+    interpolation, early stop when no noisy signal remains, monotone
+    post-processing) mirrors ``QuantileTree.compute_quantiles``.
+    """
+    qpk, leaf, kept = qrows
+    b = quantile_tree_ops.DEFAULT_BRANCHING_FACTOR
+    height = quantile_tree_ops.DEFAULT_TREE_HEIGHT
+    quantiles = np.asarray([p / 100.0 for p in config.percentiles],
+                           np.float32)
+    Q = quantiles.shape[0]
+    lower = float(config.min_value)
+    upper = float(config.max_value)
+
+    lo = jnp.full((P, Q), lower, jnp.float32)
+    hi = jnp.full((P, Q), upper, jnp.float32)
+    target = jnp.broadcast_to(quantiles[None, :], (P, Q))
+    leaf_lo = jnp.zeros((P, Q), jnp.int32)
+    done = jnp.zeros((P, Q), bool)
+    level_offset = 0
+    for level in range(height):
+        w = b**(height - 1 - level)
+        base = leaf_lo // w  # [P, Q] first-child index at this level
+        counts = []
+        for q in range(Q):
+            slot = leaf // w - base[:, q][qpk]
+            ok = kept & (slot >= 0) & (slot < b)
+            seg = qpk * b + jnp.clip(slot, 0, b - 1)
+            counts.append(
+                jax.ops.segment_sum(ok.astype(jnp.int32), seg,
+                                    num_segments=P * b).reshape(P, b))
+        raw = jnp.stack(counts, axis=1).astype(jnp.float32)  # [P, Q, b]
+        if psum_axis is not None:
+            raw = jax.lax.psum(raw, psum_axis)
+        node_ids = (level_offset + base)[..., None] + jnp.arange(
+            b, dtype=jnp.int32)
+        noisy = jnp.maximum(
+            raw + _node_noise(config.noise_kind, key, node_ids) * scale,
+            0.0)
+        total = noisy.sum(-1)
+        incl = jnp.cumsum(noisy, axis=-1)
+        rank = target * total
+        ge = incl >= rank[..., None]
+        child = jnp.where(ge.any(-1), jnp.argmax(ge, -1), b - 1)
+        c = jnp.take_along_axis(noisy, child[..., None], -1)[..., 0]
+        cum = jnp.take_along_axis(incl, child[..., None], -1)[..., 0] - c
+        width = (hi - lo) / b
+        new_lo = lo + child * width
+        new_target = jnp.where(
+            c <= 0, 0.0,
+            jnp.clip((rank - cum) / jnp.maximum(c, 1e-30), 0.0, 1.0))
+        stop = done | (total <= 0)
+        lo = jnp.where(stop, lo, new_lo)
+        hi = jnp.where(stop, hi, new_lo + width)
+        target = jnp.where(stop, target, new_target)
+        leaf_lo = jnp.where(stop, leaf_lo, leaf_lo + child * w)
+        done = stop
+        level_offset += b**(level + 1)
+    vals = lo + (hi - lo) * target  # [P, Q]
+    # Monotone in q, like the host post-processing step.
+    order = np.argsort(quantiles, kind="stable")
+    mono = jax.lax.cummax(vals[:, order], axis=1)
+    return mono[:, np.argsort(order)]
 
 
 def _expand(mask, like):
@@ -585,6 +746,19 @@ def _noise_scales(config: FusedConfig,
         eps_c = spec.eps / config.vector_size
         delta_c = spec.delta / config.vector_size
         scales.append(scale(eps_c, delta_c, linf))
+    if config.percentiles:
+        # Budget split evenly across tree levels, like the host tree
+        # (ops/quantile_tree.py:159-171): one scale serves every level.
+        spec = specs["percentile"]
+        height = quantile_tree_ops.DEFAULT_TREE_HEIGHT
+        eps_l = spec.eps / height
+        if config.noise_kind == NoiseKind.LAPLACE:
+            scales.append(noise_ops.laplace_scale(
+                eps_l, dp_computations.compute_l1_sensitivity(l0, linf)))
+        else:
+            scales.append(noise_ops.gaussian_sigma(
+                eps_l, spec.delta / height,
+                dp_computations.compute_l2_sensitivity(l0, linf)))
     return np.asarray(scales, dtype=np.float32)
 
 
@@ -652,6 +826,7 @@ def _metric_field_order(config: FusedConfig) -> List[str]:
         fields.append("privacy_id_count")
     if "VECTOR_SUM" in names:
         fields.append("vector_sum")
+    fields.extend(_percentile_field_names(config.percentiles))
     return fields
 
 
@@ -680,6 +855,10 @@ def request_budgets(config: FusedConfig, params: AggregateParams,
         specs["privacy_id_count"] = request()
     if "VECTOR_SUM" in names:
         specs["vector_sum"] = request()
+    if config.percentiles:
+        # One budget for all percentiles, requested last — same order as
+        # the generic factory (combiners.py:552-558).
+        specs["percentile"] = request()
     return specs
 
 

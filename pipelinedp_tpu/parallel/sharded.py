@@ -70,15 +70,17 @@ def _sharded_kernel(config, num_partitions, mesh, pid, pk, values, valid,
         # noise randomness everywhere (replicated outputs).
         k_bound = jax.random.fold_in(key, jax.lax.axis_index(axis))
         k_sel, k_noise = jax.random.split(jax.random.fold_in(key, 1 << 20))
-        part, part_nseg = jax_engine._partials(
+        part, part_nseg, qrows = jax_engine._partials(
             config, num_partitions, pid, pk, values, valid, k_bound)
-        # The only cross-chip exchange: per-pk partial accumulators.
+        # Cross-chip exchange: per-pk partial accumulators (the percentile
+        # walk additionally psums its per-level child counts internally).
         part = jax.tree.map(lambda x: jax.lax.psum(x, axis), part)
         part_nseg = jax.lax.psum(part_nseg, axis)
         return jax_engine._selection_and_metrics(
             config, num_partitions, part, part_nseg, noise_scales,
             keep_table, sel_threshold, sel_scale, sel_min_count,
-            sel_rows_per_uid, k_sel, k_noise)
+            sel_rows_per_uid, k_sel, k_noise, qrows=qrows,
+            psum_axis=axis)
 
     shard = PSpec(axis)
     repl = PSpec()
