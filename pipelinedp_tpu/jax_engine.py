@@ -176,6 +176,43 @@ class EncodedData:
     n_rows: int
 
 
+def _int_factorize(arr: np.ndarray):
+    """Sort-free factorization for integer keys with a manageable range:
+    O(n + range) via a presence table instead of np.unique's O(n log n)
+    sort. Returns (uniq values ascending, int32 inverse) or None when the
+    range is too wide to be worth a table."""
+    if arr.dtype.kind not in "iu" or arr.size == 0:
+        return None
+    mn = int(arr.min())
+    mx = int(arr.max())
+    span = mx - mn + 1
+    if span > max(4 * arr.size, 1 << 22):
+        return None
+    # int64 offsets: `arr - mn` in the input's own (possibly narrow) dtype
+    # overflows, silently merging distinct keys.
+    offs = arr.astype(np.int64) - mn
+    present = np.zeros(span, dtype=bool)
+    present[offs] = True
+    uniq_off = np.flatnonzero(present)
+    lookup = np.empty(span, dtype=np.int32)
+    lookup[uniq_off] = np.arange(len(uniq_off), dtype=np.int32)
+    return uniq_off + mn, lookup[offs]
+
+
+def _pid_ids(pid_arr: np.ndarray) -> np.ndarray:
+    """int32 ids for privacy units: any injective mapping works (the kernel
+    only groups by equality), so in-range integer ids pass through without
+    the np.unique sort. PAD_ID (int32 max) is reserved for padding rows."""
+    if (pid_arr.dtype.kind in "iu" and pid_arr.size and
+            pid_arr.min() >= 0 and pid_arr.max() < np.iinfo(np.int32).max):
+        return pid_arr.astype(np.int32)
+    fac = _int_factorize(pid_arr)
+    if fac is not None:
+        return fac[1]
+    _, pid_idx = np.unique(pid_arr, return_inverse=True)
+    return pid_idx.astype(np.int32)
+
+
 def _encode_arrays(ds: ArrayDataset, vector_size: Optional[int],
                    public_partitions: Optional[Sequence],
                    require_pid: bool = True) -> EncodedData:
@@ -204,13 +241,17 @@ def _encode_arrays(ds: ArrayDataset, vector_size: Optional[int],
         values = values[mask]
         pk_vocab = list(vocab.tolist())
     else:
-        uniq, pk_idx = np.unique(pk_arr, return_inverse=True)
-        pk_idx = pk_idx.astype(np.int32)
+        fac = _int_factorize(pk_arr)
+        if fac is not None:
+            uniq, pk_idx = fac
+        else:
+            uniq, pk_idx = np.unique(pk_arr, return_inverse=True)
+            pk_idx = pk_idx.astype(np.int32)
         pk_vocab = list(uniq.tolist())
-    _, pid_idx = np.unique(pid_arr, return_inverse=True)
+    pid_idx = _pid_ids(pid_arr)
     if vector_size:
         values = values.reshape(len(values), vector_size)
-    return EncodedData(pid=pid_idx.astype(np.int32), pk=pk_idx,
+    return EncodedData(pid=pid_idx, pk=pk_idx,
                        values=values, pk_vocab=pk_vocab,
                        n_rows=len(pk_idx))
 
@@ -299,69 +340,134 @@ def _partials(config: FusedConfig, num_partitions: int, pid, pk, values,
     """Contribution bounding + per-pk accumulator partials. Shardable by
     privacy id: every pid's rows must live in one shard, pks may be
     spread — partials then combine across shards by plain addition
-    (psum)."""
+    (psum).
+
+    Scatter-minimal design: on TPU a segment_sum/scatter over the row axis
+    costs ~10x an elementwise op, so the kernel sorts ONCE by
+    (pid, hash(pid, pk, salt), pk, random) and then derives every
+    per-segment quantity in row space with cumsum/cummax (runs are
+    contiguous after the sort). The hash key makes the within-pid segment
+    order a fresh uniform permutation per run and per pid, so "ordinal
+    within pid < l0" IS the L0 cross-partition sample — no second sort, no
+    per-segment scatter. The only scatters left are the final per-pk
+    reductions (and, for per-partition-bound sums, one per-segment total)."""
     n = pid.shape[0]
     P = num_partitions
-    k_sort, k_l0 = jax.random.split(key, 2)
 
     if config.bounds_already_enforced:
         # No privacy ids: every row is its own "segment"; no sampling.
-        seg_pk = jnp.where(valid, pk, 0)
-        seg_valid = valid
         row_keep = valid
-        seg_of_row = jnp.arange(n)
-        # Counts accumulate as int32: float32 addition saturates at 2^24
-        # (1.0 + 16777216.0 == 16777216.0), silently under-counting huge
-        # partitions; int32 is exact to 2^31.
-        seg_count = row_keep.astype(jnp.int32)
+        pk_safe = jnp.where(valid, pk, 0)
         clipped = _clip_values(config, values)
-        seg_values = jnp.where(
-            _expand(row_keep, clipped), clipped, 0.0)
-        seg_sums = _segment_fields(config, seg_values, seg_count,
-                                   seg_of_row, n)
-        keep_seg = seg_valid
-        seg_pk_final = seg_pk
-        qrows = (_qrows(config, seg_pk, values, row_keep)
-                 if config.percentiles else None)
-    else:
-        sort_idx, spid, spk = seg_ops.sort_rows(k_sort, pid, pk, valid)
-        svalid = valid[sort_idx]
-        svalues = values[sort_idx]
-        seg_id, new_seg = seg_ops.segment_ids(spid, spk)
-        rank = seg_ops.rank_in_segment(seg_id, new_seg)
-        # Linf bound: keep the first linf (randomly ordered) rows.
-        row_keep = svalid & (rank < config.linf)
-        clipped = _clip_values(config, svalues)
         masked = jnp.where(_expand(row_keep, clipped), clipped, 0.0)
-        seg_count = jax.ops.segment_sum(row_keep.astype(jnp.int32),
-                                        seg_id, num_segments=n)
-        seg_sums = _segment_fields(config, masked, seg_count, seg_id, n)
-        # Segment -> (pid, pk) mapping.
-        seg_pid = seg_ops.per_segment_first(spid, seg_id, new_seg, n)
-        seg_pk_final = seg_ops.per_segment_first(spk, seg_id, new_seg, n)
-        seg_valid = jax.ops.segment_sum(svalid.astype(jnp.int32), seg_id,
-                                        num_segments=n) > 0
-        # L0 bound: keep at most l0 segments per pid, randomly.
-        l0_rank = seg_ops.rank_within_group(seg_pid, k_l0, seg_valid)
-        keep_seg = seg_valid & (l0_rank < config.l0)
-        seg_pk_final = jnp.where(keep_seg, seg_pk_final, 0)
-        qrows = (_qrows(config, spk, svalues,
-                        row_keep & keep_seg[seg_id])
+        if config.per_partition_bounds:
+            # One row = one segment: the per-segment sum clip is a row clip.
+            masked = jnp.where(
+                row_keep,
+                jnp.clip(masked, config.min_sum_per_partition,
+                         config.max_sum_per_partition), 0.0)
+        qrows = (_qrows(config, pk_safe, values, row_keep)
                  if config.percentiles else None)
+        part = _reduce_per_pk(config, pk_safe, masked, row_keep, masked, P)
+        # Without pids every row counts as its own privacy unit
+        # (reference dp_engine.py:341-348 works off row counts).
+        part_nseg = part["count"]
+        return part, part_nseg, qrows
 
-    # --- per-pk reduction (shuffle 3 fused into a segment_sum) ---
-    part = {}
-    for name, arr in seg_sums.items():
-        contrib = jnp.where(_expand(keep_seg, arr), arr,
-                            jnp.zeros((), arr.dtype))
-        part[name] = jax.ops.segment_sum(contrib, seg_pk_final,
-                                         num_segments=P)
-    # Privacy-id count per pk = number of kept segments (row_count in the
-    # reference's compound accumulator, dp_engine.py:339). int32 — see
-    # the count-saturation note above.
-    part_nseg = jax.ops.segment_sum(keep_seg.astype(jnp.int32),
-                                    seg_pk_final, num_segments=P)
+    k_tie, k_salt = jax.random.split(key, 2)
+    salt = jax.random.bits(k_salt, (), dtype=jnp.uint32)
+    tiebreak = jax.random.bits(k_tie, (n,), dtype=jnp.uint32)
+    big_pid = jnp.where(valid, pid, seg_ops.PAD_ID)
+    big_pk = jnp.where(valid, pk, seg_ops.PAD_ID)
+    # Sampling priority of segment (pid, pk): an independent uniform
+    # permutation of each pid's partitions (salted per run).
+    hpk = seg_ops.fmix32(
+        seg_ops.fmix32(big_pid.astype(jnp.uint32) ^ salt) ^
+        big_pk.astype(jnp.uint32))
+    sort_idx = jnp.lexsort((tiebreak, big_pk, hpk, big_pid))
+    spid = big_pid[sort_idx]
+    spk = big_pk[sort_idx]
+    svalues = values[sort_idx]
+    idx = jnp.arange(n)
+    # Valid rows sort before padding (PAD_ID keys): no gather needed.
+    svalid = idx < jnp.sum(valid.astype(jnp.int32))
+
+    new_pid = (idx == 0) | (spid != jnp.roll(spid, 1))
+    new_seg = new_pid | (spk != jnp.roll(spk, 1))
+    # Linf bound: keep the first linf (randomly ordered) rows per segment.
+    linf_cap = config.linf if config.linf is not None else n
+    row_keep = svalid & (seg_ops.rank_in_run(new_seg) < linf_cap)
+    # L0 bound: the segment's ordinal within its pid — uniform by the hpk
+    # sort key — must be < l0.
+    keep_l0 = seg_ops.run_ordinal_in_group(new_seg, new_pid) < config.l0
+    keep_row = row_keep & keep_l0
+
+    clipped = _clip_values(config, svalues)
+    masked = jnp.where(_expand(keep_row, clipped), clipped, 0.0)
+    pk_safe = jnp.where(svalid, spk, 0)
+    # Kept-segment indicator on the segment's first row: the per-pk sum of
+    # these is the privacy-id count (row_count in the reference's compound
+    # accumulator, dp_engine.py:339).
+    seg_marker = new_seg & svalid & keep_l0
+
+    if config.per_partition_bounds:
+        # Clip each (pid, pk) segment's SUM, contributed once per segment.
+        # seg_ord is monotone, so this segment_sum is the one per-segment
+        # scatter this mode still needs; precision-safe (no cumsum diff).
+        seg_ord = jnp.cumsum(new_seg.astype(jnp.int32)) - 1
+        seg_total = jax.ops.segment_sum(masked, seg_ord, num_segments=n)
+        tot_row = seg_total[seg_ord]
+        contrib = jnp.where(
+            seg_marker,
+            jnp.clip(tot_row, config.min_sum_per_partition,
+                     config.max_sum_per_partition), 0.0)
+        part = _reduce_per_pk(config, pk_safe, masked, keep_row, contrib, P)
+    else:
+        part = _reduce_per_pk(config, pk_safe, masked, keep_row, None, P)
+
+    part_nseg = jax.ops.segment_sum(seg_marker.astype(jnp.int32), pk_safe,
+                                    num_segments=P)
+    qrows = (_qrows(config, spk, svalues, keep_row)
+             if config.percentiles else None)
     return part, part_nseg, qrows
+
+
+def _reduce_per_pk(config: FusedConfig, pk_safe, masked, keep_row,
+                   per_partition_sum_contrib, P) -> Dict[str, jnp.ndarray]:
+    """The fused shuffle 3: per-pk accumulator columns straight from row
+    space. Counts accumulate as int32 — float32 addition saturates at 2^24
+    (1.0 + 16777216.0 == 16777216.0), silently under-counting huge
+    partitions; int32 is exact to 2^31."""
+    part = {"count": jax.ops.segment_sum(keep_row.astype(jnp.int32),
+                                         pk_safe, num_segments=P)}
+    names = set(config.metrics)
+    if "VECTOR_SUM" in names:
+        part["vector_sum"] = jax.ops.segment_sum(masked, pk_safe,
+                                                 num_segments=P)
+        return part
+    if "SUM" in names and config.per_partition_bounds:
+        part["sum"] = jax.ops.segment_sum(per_partition_sum_contrib,
+                                          pk_safe, num_segments=P)
+        return part
+    need_sum = "SUM" in names
+    need_norm = "MEAN" in names or "VARIANCE" in names
+    if need_sum or need_norm:
+        raw_sum = jax.ops.segment_sum(masked, pk_safe, num_segments=P)
+        if need_sum:
+            part["sum"] = raw_sum
+    if need_norm:
+        # Normalized-sum trick in pk space: sum(x - mid) and sum((x-mid)^2)
+        # are linear in {sum x, sum x^2, count} — no per-segment pass.
+        middle = dp_computations.compute_middle(config.min_value,
+                                                config.max_value)
+        cf = part["count"].astype(raw_sum.dtype)
+        part["nsum"] = raw_sum - middle * cf
+        if "VARIANCE" in names:
+            raw_sumsq = jax.ops.segment_sum(masked * masked, pk_safe,
+                                            num_segments=P)
+            part["nsumsq"] = (raw_sumsq - 2.0 * middle * raw_sum +
+                              cf * middle * middle)
+    return part
 
 
 def _qrows(config: FusedConfig, pk, values, kept):
@@ -557,41 +663,6 @@ def _clip_values(config: FusedConfig, values):
             config.min_value is None):
         return values
     return jnp.clip(values, config.min_value, config.max_value)
-
-
-def _segment_fields(config: FusedConfig, masked_values, seg_count, seg_id,
-                    num_segments) -> Dict[str, jnp.ndarray]:
-    """Per-(pid,pk) accumulator columns — the fused create_accumulator."""
-    out = {"count": seg_count}
-    names = set(config.metrics)
-    if "VECTOR_SUM" in names:
-        out["vector_sum"] = jax.ops.segment_sum(
-            masked_values, seg_id, num_segments=num_segments)
-        return out
-    if "SUM" in names:
-        ssum = jax.ops.segment_sum(masked_values, seg_id,
-                                   num_segments=num_segments)
-        if config.per_partition_bounds:
-            ssum = jnp.clip(ssum, config.min_sum_per_partition,
-                            config.max_sum_per_partition)
-        out["sum"] = ssum
-    if "MEAN" in names or "VARIANCE" in names:
-        middle = dp_computations.compute_middle(config.min_value,
-                                                config.max_value)
-        # Masked-out rows are zeroed, so they must not contribute -middle:
-        # sum(clip(x) - middle over kept rows) = raw_sum - middle * count.
-        raw_sum = jax.ops.segment_sum(masked_values, seg_id,
-                                      num_segments=num_segments)
-        cf = seg_count.astype(raw_sum.dtype)
-        out["nsum"] = raw_sum - middle * cf
-        if "VARIANCE" in names:
-            raw_sumsq = jax.ops.segment_sum(masked_values**2, seg_id,
-                                            num_segments=num_segments)
-            # sum((x-mid)^2) = sum(x^2) - 2 mid sum(x) + count mid^2
-            out["nsumsq"] = (raw_sumsq - 2.0 * middle * raw_sum +
-                             cf * middle * middle)
-    return out
-
 
 
 
@@ -881,6 +952,8 @@ class LazyFusedResult:
         self._rng_seed = rng_seed
         self._mesh = mesh
         self._cache = None
+        #: host/device timing split of the last _execute, for bench.py.
+        self.timings: Optional[Dict[str, float]] = None
 
     def __iter__(self):
         # Generator function: the body (and thus execution) is deferred
@@ -892,11 +965,17 @@ class LazyFusedResult:
         yield from self._cache
 
     def _execute(self):
+        import time as _time
+
         config = self._config
         params = self._params
+        t0 = _time.perf_counter()
         encoded = encode(self._rows, self._extractors, config.vector_size,
                          self._public,
                          require_pid=not config.bounds_already_enforced)
+        t_encode = _time.perf_counter() - t0
+        self.timings = {"host_encode_s": t_encode, "device_s": 0.0,
+                        "host_decode_s": 0.0}
         P = len(encoded.pk_vocab)
         if P == 0:
             return []
@@ -922,6 +1001,7 @@ class LazyFusedResult:
         key = jax.random.PRNGKey(seed)
         P_pad = _pad_pow2(P)
 
+        t1 = _time.perf_counter()
         if self._mesh is not None:
             from pipelinedp_tpu.parallel import sharded_fused_aggregate
             keep_pk, metrics = sharded_fused_aggregate(
@@ -929,42 +1009,66 @@ class LazyFusedResult:
                 encoded.values, np.ones(encoded.n_rows, bool), scales,
                 keep_table, thr, s_scale, min_count, rows_per_uid, key)
         else:
-            n_pad = _pad_pow2(max(encoded.n_rows, 1))
-            pid = np.zeros(n_pad, np.int32)
-            pk = np.zeros(n_pad, np.int32)
-            valid = np.zeros(n_pad, bool)
-            pid[:encoded.n_rows] = encoded.pid
-            pk[:encoded.n_rows] = encoded.pk
-            valid[:encoded.n_rows] = True
+            n = encoded.n_rows
+            n_pad = _pad_pow2(max(n, 1))
+            # One batched transfer of the exact-size columns; padding
+            # happens on device and the padding mask is derived from a
+            # scalar — the (slow, high-latency) host link moves only real
+            # rows in a single round trip.
+            dpid, dpk, dval = jax.device_put(
+                (encoded.pid, encoded.pk, encoded.values))
+            pid = jnp.zeros(n_pad, jnp.int32).at[:n].set(dpid)
+            pk = jnp.zeros(n_pad, jnp.int32).at[:n].set(dpk)
             if config.vector_size:
-                values = np.zeros((n_pad, config.vector_size), np.float32)
-                values[:encoded.n_rows] = encoded.values
+                values = jnp.zeros((n_pad, config.vector_size),
+                                   jnp.float32).at[:n].set(dval)
             else:
-                values = np.zeros(n_pad, np.float32)
-                values[:encoded.n_rows] = encoded.values
+                values = jnp.zeros(n_pad, jnp.float32).at[:n].set(dval)
+            valid = jnp.arange(n_pad) < n
             keep_pk, metrics = fused_aggregate_kernel(
-                config, P_pad, jnp.asarray(pid), jnp.asarray(pk),
-                jnp.asarray(values), jnp.asarray(valid),
+                config, P_pad, pid, pk, values, valid,
                 jnp.asarray(scales), jnp.asarray(keep_table),
                 jnp.float32(thr), jnp.float32(s_scale),
                 jnp.float32(min_count), jnp.float32(rows_per_uid), key)
 
-        keep_np = np.asarray(keep_pk)[:P]
+        # Fetching the outputs forces device execution; the fetch is
+        # attributed to device_s, pure-Python row assembly to decode_s.
+        # All rank-1 outputs ride ONE stacked transfer — the tunneled
+        # host<->device link pays per round trip, not per byte here.
         fields = _metric_field_order(config)
-        metric_arrays = {f: np.asarray(metrics[f]) for f in fields}
-        out = []
-        for i in range(P):
-            if self._public is None and not keep_np[i]:
-                continue
-            vals = tuple(
-                metric_arrays[f][i] if metric_arrays[f].ndim == 1 else
-                metric_arrays[f][i, :] for f in fields)
-            vals = tuple(
-                float(v) if np.ndim(v) == 0 else np.asarray(v)
-                for v in vals)
-            out.append((encoded.pk_vocab[i],
-                        _create_named_tuple_instance(
-                            "MetricsTuple", tuple(fields), vals)))
+        flat = [f for f in fields if metrics[f].ndim == 1]
+        stacked = np.asarray(jnp.stack(
+            [keep_pk.astype(jnp.float32)] +
+            [metrics[f].astype(jnp.float32) for f in flat]))
+        keep_np = stacked[0, :P] > 0.5
+        metric_arrays = {f: stacked[1 + i, :] for i, f in enumerate(flat)}
+        for f in fields:
+            if f not in metric_arrays:  # rank-2 (vector) outputs
+                metric_arrays[f] = np.asarray(metrics[f])
+        self.timings["device_s"] = _time.perf_counter() - t1
+
+        t2 = _time.perf_counter()
+        # Only materialize kept partitions (with private selection the kept
+        # fraction can be tiny — never walk the full pk axis in Python).
+        kept_idx = (np.arange(P) if self._public is not None else
+                    np.flatnonzero(keep_np))
+        vocab = encoded.pk_vocab
+        # Column-wise conversion: one C-level tolist() per metric instead
+        # of a Python float() call per (partition, metric).
+        columns = []
+        for f in fields:
+            arr = metric_arrays[f]
+            if arr.ndim == 1:
+                columns.append(arr[kept_idx].tolist())
+            else:
+                columns.append(list(arr[kept_idx, :]))
+        tuple_fields = tuple(fields)
+        out = [
+            (vocab[i], _create_named_tuple_instance(
+                "MetricsTuple", tuple_fields, vals))
+            for i, vals in zip(kept_idx.tolist(), zip(*columns))
+        ]
+        self.timings["host_decode_s"] = _time.perf_counter() - t2
         return out
 
 

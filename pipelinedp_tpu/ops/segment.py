@@ -1,15 +1,24 @@
-"""Segment kernels — the array-plane building blocks of the fused DP
-aggregation (SURVEY.md §7: ``group_by_key`` = sort + segment boundaries,
-``sample_fixed_per_key`` = random-tiebreak sort + rank-in-segment,
+"""Row-space segment primitives — the array-plane building blocks of the
+fused DP aggregation (SURVEY.md §7: ``group_by_key`` = sort + contiguous
+runs, ``sample_fixed_per_key`` = random-priority sort + rank-in-run,
 ``combine_accumulators_per_key`` = ``segment_sum``).
 
-Everything here is jit-compatible: static shapes, no data-dependent Python
-control flow. Padding rows carry a sentinel key that sorts last and a
-``valid=False`` mask. All functions operate on the *sorted* row order
-produced by ``sort_rows``.
+Design note: on TPU a scatter (``segment_sum``/``segment_max`` over the
+row axis) costs roughly an order of magnitude more than an elementwise op,
+so after the single lexsort every per-segment quantity is derived *in row
+space* from cumulative ops over the contiguous runs — ``run_start`` is a
+cummax, ranks are index differences, group ordinals are cumsum
+differences. The only scatters in the fused kernel are the final per-pk
+reductions.
+
+Everything here is jit-compatible: static shapes, no data-dependent
+Python control flow. Padding rows carry ``PAD_ID`` keys so they sort
+after all real rows.
 """
 
 from __future__ import annotations
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -18,76 +27,44 @@ import jax.numpy as jnp
 PAD_ID = jnp.iinfo(jnp.int32).max
 
 
-def sort_rows(key, pid, pk, valid):
-    """Sorts rows by (pid, pk, random tiebreak); padding (valid=False) rows
-    sort last. The random tiebreak makes 'first k rows of each segment' a
-    uniform without-replacement sample — this is what turns the reference's
-    ``sample_fixed_per_key`` into a sort.
+def fmix32(x):
+    """murmur3 finalizer: a cheap elementwise bijection on uint32 with
+    full avalanche. Works on jax and numpy arrays alike; used to derive
+    per-(pid, pk) sampling priorities and shard assignments."""
+    x = x ^ (x >> 16)
+    x = x * np.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * np.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x
 
-    Returns (sort_idx, spid, spk): permutation and sorted ids.
+
+def run_starts(new_run):
+    """Per-row index of the first row of its run, scatter-free.
+
+    ``new_run`` is a bool[N] marking run boundaries over rows that are
+    sorted so equal keys are contiguous; row 0 must be marked. The first
+    index of each run is then a running maximum of the marked indices.
     """
-    n = pid.shape[0]
-    # Full 32-bit tiebreak: float32 uniform has only ~2^24 distinct values,
-    # so at tens of millions of rows ties are common and the stable lexsort
-    # falls back to input order, biasing the "first k" sample toward
-    # earlier rows.
-    tiebreak = jax.random.bits(key, (n,), dtype=jnp.uint32)
-    big_pid = jnp.where(valid, pid, PAD_ID)
-    big_pk = jnp.where(valid, pk, PAD_ID)
-    sort_idx = jnp.lexsort((tiebreak, big_pk, big_pid))
-    return sort_idx, big_pid[sort_idx], big_pk[sort_idx]
+    idx = jnp.arange(new_run.shape[0])
+    return jax.lax.cummax(jnp.where(new_run, idx, 0))
 
 
-def segment_ids(spid, spk):
-    """Segment index per sorted row: a new segment starts whenever (pid, pk)
-    changes. Returns (seg_id[N] in [0, N), new_seg[N] bool)."""
-    n = spid.shape[0]
-    idx = jnp.arange(n)
-    new_seg = jnp.where(
-        idx == 0, True,
-        (spid != jnp.roll(spid, 1)) | (spk != jnp.roll(spk, 1)))
-    seg_id = jnp.cumsum(new_seg.astype(jnp.int32)) - 1
-    return seg_id, new_seg
+def rank_in_run(new_run):
+    """0-based rank of each row inside its contiguous run."""
+    idx = jnp.arange(new_run.shape[0])
+    return idx - run_starts(new_run)
 
 
-def rank_in_segment(seg_id, new_seg):
-    """0-based rank of each sorted row inside its segment."""
-    n = seg_id.shape[0]
-    idx = jnp.arange(n)
-    starts = jnp.where(new_seg, idx, 0)
-    # Rows are sorted, so the max recorded start per segment IS the start.
-    seg_start = jax.ops.segment_max(starts, seg_id, num_segments=n)
-    return idx - seg_start[seg_id]
+def run_ordinal_in_group(new_run, new_group):
+    """Per row: the ordinal (0-based) of the row's run within its group.
 
-
-def rank_within_group(group_of_seg, key, valid_seg):
-    """Random 0-based rank of each segment within its group (= pid), used
-    for L0 cross-partition sampling: keep segments with rank < l0.
-
-    ``group_of_seg``: int32[S] group id per segment (PAD_ID for padding).
-    Returns rank[S]."""
-    s = group_of_seg.shape[0]
-    tiebreak = jax.random.bits(key, (s,), dtype=jnp.uint32)
-    group = jnp.where(valid_seg, group_of_seg, PAD_ID)
-    order = jnp.lexsort((tiebreak, group))
-    sorted_group = group[order]
-    idx = jnp.arange(s)
-    new_group = jnp.where(
-        idx == 0, True, sorted_group != jnp.roll(sorted_group, 1))
-    group_seg_id = jnp.cumsum(new_group.astype(jnp.int32)) - 1
-    starts = jnp.where(new_group, idx, 0)
-    group_start = jax.ops.segment_max(starts, group_seg_id,
-                                      num_segments=s)
-    rank_sorted = idx - group_start[group_seg_id]
-    # Scatter ranks back to original segment order.
-    rank = jnp.zeros(s, dtype=jnp.int32).at[order].set(
-        rank_sorted.astype(jnp.int32))
-    return rank
-
-
-def per_segment_first(values, seg_id, new_seg, num_segments):
-    """First row's value per segment (for constant-within-segment fields
-    like pid/pk)."""
-    return jax.ops.segment_max(
-        jnp.where(new_seg, values, jnp.iinfo(jnp.int32).min), seg_id,
-        num_segments=num_segments)
+    Runs and groups are both contiguous after the sort and every group
+    boundary is also a run boundary (``new_group`` implies ``new_run``).
+    With the run order inside each group randomized by a hashed sort key,
+    ``ordinal < k`` IS a uniform without-replacement sample of k runs per
+    group — the L0 contribution bound.
+    """
+    run_ord = jnp.cumsum(new_run.astype(jnp.int32)) - 1
+    group_first_run = jax.lax.cummax(jnp.where(new_group, run_ord, 0))
+    return run_ord - group_first_run

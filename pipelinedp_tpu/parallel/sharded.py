@@ -106,7 +106,13 @@ def sharded_fused_aggregate(mesh: Mesh, config, num_partitions: int,
     kernel. Returns (keep_pk[P], metrics dict) — replicated, so values
     are addressable from the host."""
     n_dev = mesh.devices.size
-    shard_of_row = (pid.astype(np.int64) % n_dev).astype(np.int32)
+    # Hash before the modulo: raw ids pass through the encode step
+    # unchanged, and id families sharing a residue class (all-even user
+    # ids, snowflake ids with fixed low bits) would otherwise pile every
+    # row onto one device.
+    from pipelinedp_tpu.ops.segment import fmix32
+    shard_of_row = (fmix32(pid.astype(np.uint32)) % np.uint32(n_dev)
+                    ).astype(np.int32)
     order = np.argsort(shard_of_row, kind="stable")
     counts = np.bincount(shard_of_row, minlength=n_dev)
     per_shard = jax_engine._pad_pow2(int(counts.max()) if len(pid) else 1)
