@@ -376,18 +376,25 @@ class AggregateErrorMetricsAccumulator:
 
 class AggregateErrorMetricsCompoundCombiner(dp_combiners.CompoundCombiner):
     """Threads each partition's P(keep) into every metric's error
-    accumulator (reference :468-485)."""
+    accumulator (reference :468-485).
+
+    Deliberate fix vs the reference (:470-483): the reference reads
+    ``values[0]`` — the FIRST configuration's keep probability — into
+    every configuration's error metrics, so a multi-parameter sweep
+    scores all configurations with config 0's partition-selection
+    behavior. Here each configuration's own selection combiner value
+    (which precedes its metric combiners in the compound order) sets the
+    probability for that configuration's metrics."""
     AccumulatorType = Tuple[int, Tuple]
 
     def create_accumulator(self, values) -> AccumulatorType:
         probability_to_keep = 1
-        if isinstance(values[0], float):
-            probability_to_keep = values[0]
         accumulators = []
         for combiner, value in zip(self._combiners, values):
             if isinstance(
                     combiner,
                     PrivatePartitionSelectionAggregateErrorMetricsCombiner):
+                probability_to_keep = value
                 accumulators.append(combiner.create_accumulator(value))
             else:
                 accumulators.append(

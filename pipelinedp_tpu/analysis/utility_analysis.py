@@ -20,7 +20,27 @@ def perform_utility_analysis(col, backend,
                              return_per_partition: bool = False):
     """Runs utility analysis; returns a 1-element collection with
     ``List[AggregateMetrics]`` — one entry per parameter configuration
-    (reference :27-110)."""
+    (reference :27-110).
+
+    On a fused backend (JaxBackend) the whole sweep runs on device with a
+    configuration axis (``analysis/jax_sweep.py``); the host graph below
+    remains the oracle and the fallback."""
+    if (getattr(backend, "supports_fused_aggregation", False) and
+            not return_per_partition):
+        from pipelinedp_tpu.analysis import jax_sweep
+        if jax_sweep.sweep_is_supported(options, data_extractors,
+                                        return_per_partition):
+            utility_analysis_engine._check_utility_analysis_params(
+                options, data_extractors)
+            accountant = budget_accounting.NaiveBudgetAccountant(
+                total_epsilon=options.epsilon, total_delta=options.delta)
+            result = jax_sweep.build_fused_sweep(col, options,
+                                                 data_extractors,
+                                                 public_partitions,
+                                                 accountant)
+            accountant.compute_budgets()
+            return result
+
     budget_accountant = budget_accounting.NaiveBudgetAccountant(
         total_epsilon=options.epsilon, total_delta=options.delta)
     engine = utility_analysis_engine.UtilityAnalysisEngine(

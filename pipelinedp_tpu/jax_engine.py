@@ -188,15 +188,21 @@ def _int_factorize(arr: np.ndarray):
     span = mx - mn + 1
     if span > max(4 * arr.size, 1 << 22):
         return None
-    # int64 offsets: `arr - mn` in the input's own (possibly narrow) dtype
-    # overflows, silently merging distinct keys.
-    offs = arr.astype(np.int64) - mn
+    # Offsets must not be computed in a dtype that can overflow: narrow
+    # signed dtypes wrap on `arr - mn`, and uint64 values above int64 max
+    # wrap on the cast. Unsigned subtraction is exact (arr >= mn), signed
+    # fits int64 by construction.
+    if arr.dtype.kind == "u":
+        offs = (arr - np.asarray(mn, arr.dtype)).astype(np.int64)
+    else:
+        offs = arr.astype(np.int64) - mn
     present = np.zeros(span, dtype=bool)
     present[offs] = True
     uniq_off = np.flatnonzero(present)
     lookup = np.empty(span, dtype=np.int32)
     lookup[uniq_off] = np.arange(len(uniq_off), dtype=np.int32)
-    return uniq_off + mn, lookup[offs]
+    uniq = uniq_off.astype(arr.dtype) + np.asarray(mn, arr.dtype)
+    return uniq, lookup[offs]
 
 
 def _pid_ids(pid_arr: np.ndarray) -> np.ndarray:
@@ -211,6 +217,26 @@ def _pid_ids(pid_arr: np.ndarray) -> np.ndarray:
         return fac[1]
     _, pid_idx = np.unique(pid_arr, return_inverse=True)
     return pid_idx.astype(np.int32)
+
+
+def pad_and_put(encoded: EncodedData, vector_size: Optional[int]):
+    """One batched h2d transfer of the exact-size encoded columns; padding
+    happens on device and the padding mask is derived from a scalar — the
+    (slow, high-latency) host link moves only real rows in a single round
+    trip. Returns (pid, pk, values, valid) padded to a power of two."""
+    n = encoded.n_rows
+    n_pad = _pad_pow2(max(n, 1))
+    dpid, dpk, dval = jax.device_put(
+        (encoded.pid, encoded.pk, encoded.values))
+    pid = jnp.zeros(n_pad, jnp.int32).at[:n].set(dpid)
+    pk = jnp.zeros(n_pad, jnp.int32).at[:n].set(dpk)
+    if vector_size:
+        values = jnp.zeros((n_pad, vector_size), jnp.float32).at[:n].set(
+            dval)
+    else:
+        values = jnp.zeros(n_pad, jnp.float32).at[:n].set(dval)
+    valid = jnp.arange(n_pad) < n
+    return pid, pk, values, valid
 
 
 def _encode_arrays(ds: ArrayDataset, vector_size: Optional[int],
@@ -1009,22 +1035,8 @@ class LazyFusedResult:
                 encoded.values, np.ones(encoded.n_rows, bool), scales,
                 keep_table, thr, s_scale, min_count, rows_per_uid, key)
         else:
-            n = encoded.n_rows
-            n_pad = _pad_pow2(max(n, 1))
-            # One batched transfer of the exact-size columns; padding
-            # happens on device and the padding mask is derived from a
-            # scalar — the (slow, high-latency) host link moves only real
-            # rows in a single round trip.
-            dpid, dpk, dval = jax.device_put(
-                (encoded.pid, encoded.pk, encoded.values))
-            pid = jnp.zeros(n_pad, jnp.int32).at[:n].set(dpid)
-            pk = jnp.zeros(n_pad, jnp.int32).at[:n].set(dpk)
-            if config.vector_size:
-                values = jnp.zeros((n_pad, config.vector_size),
-                                   jnp.float32).at[:n].set(dval)
-            else:
-                values = jnp.zeros(n_pad, jnp.float32).at[:n].set(dval)
-            valid = jnp.arange(n_pad) < n
+            pid, pk, values, valid = pad_and_put(encoded,
+                                                 config.vector_size)
             keep_pk, metrics = fused_aggregate_kernel(
                 config, P_pad, pid, pk, values, valid,
                 jnp.asarray(scales), jnp.asarray(keep_table),

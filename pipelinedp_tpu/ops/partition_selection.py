@@ -135,25 +135,62 @@ class TruncatedGeometricPartitionStrategy(PartitionSelectionStrategyBase):
         return self._keep_table[idx]
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=65536)
 def _truncated_geometric_table(eps: float, delta: float) -> np.ndarray:
-    """Precomputes pi_n until saturation (pi_n == 1)."""
+    """Precomputes pi_n until saturation (pi_n == 1), in closed form.
+
+    The recurrence pi_n = min(e^eps pi_{n-1} + delta,
+    1 - e^-eps (1 - pi_{n-1} - delta), 1) switches branches exactly once:
+    the first branch wins while pi <= p* = (1-delta)(1-e^-eps)/(e^eps-e^-eps),
+    giving the geometric series pi_n = delta (e^{n eps} - 1)/(e^eps - 1);
+    after the crossover q_n = 1 - pi_n decays as q -> e^-eps (q - delta)
+    toward a negative fixed point q^ = -delta/(e^eps - 1), so
+    q_{c+k} = e^{-k eps} (q_c - q^) + q^. Both phases vectorize — the
+    per-step Python loop this replaces dominated multi-config utility
+    sweeps. Cached: utility analysis builds one table per swept l0.
+    """
     if delta <= 0:
         raise ValueError("truncated geometric selection requires delta > 0")
-    # exp(eps) only ever multiplies probabilities >= delta before a min(.., 1)
-    # — clamping the exponent avoids OverflowError at huge eps without
-    # changing the saturated result.
-    e_pos = math.exp(min(eps, 700.0))
-    e_neg = math.exp(-eps)
-    probs = [0.0]
-    pi = 0.0
-    while pi < 1.0 and len(probs) < _MAX_TABLE_SIZE:
-        pi = min(e_pos * pi + delta, 1.0 - e_neg * (1.0 - pi - delta), 1.0)
-        pi = min(pi, 1.0)
-        probs.append(pi)
-        if 1.0 - pi < 1e-15:
-            probs[-1] = 1.0
-            break
-    return np.asarray(probs, dtype=np.float64)
+    eps = min(eps, 700.0)  # avoids overflow; saturated result unchanged
+    em1 = math.expm1(eps)  # e^eps - 1
+    p_star = ((1.0 - delta) * -math.expm1(-eps) /
+              (math.exp(eps) - math.exp(-eps)))
+
+    # Phase A: indices 0..n_c, where n_c is the first n with pi_n > p*.
+    with np.errstate(over="ignore"):
+        n_c = int(math.log1p(min(p_star * em1 / delta, 1e300)) // eps) + 1
+    n_c = min(n_c, _MAX_TABLE_SIZE - 1)
+    nA = np.arange(n_c + 1, dtype=np.float64)
+    piA = np.minimum(delta * np.expm1(np.minimum(nA * eps, 700.0)) / em1,
+                     1.0)
+
+    # Phase B: q_{c+k} = e^{-k eps} (q_c - q^) + q^ until q <= ~0. When
+    # the table hits _MAX_TABLE_SIZE before true saturation, keep the last
+    # (conservative, unsaturated) value — counts beyond the table clamp to
+    # it, and forcing 1.0 early would overstate the keep probability.
+    q_c = 1.0 - piA[-1]
+    q_bar = -delta / em1
+    if q_c <= 1e-15:
+        piA[-1] = 1.0
+        table = piA
+    else:
+        k_needed = max(1, int(math.ceil(
+            math.log((q_c - q_bar) / (1e-15 - q_bar)) / eps)))
+        k_fit = min(k_needed, _MAX_TABLE_SIZE - len(piA))
+        if k_fit <= 0:
+            table = piA
+        else:
+            kB = np.arange(1.0, k_fit + 1.0)
+            piB = 1.0 - (np.exp(-kB * eps) * (q_c - q_bar) + q_bar)
+            piB = np.minimum(piB, 1.0)
+            if k_fit >= k_needed:
+                piB[-1] = 1.0
+            table = np.concatenate([piA, piB])
+    table.setflags(write=False)
+    return table
 
 
 class LaplaceThresholdingPartitionStrategy(PartitionSelectionStrategyBase):

@@ -346,3 +346,161 @@ class TestUtilityAnalysisEngineValidation:
                 max_value=1.0))
         with pytest.raises(NotImplementedError):
             engine.analyze([(0, "a", 1.0)], options, extractors())
+
+
+class TestFusedSweep:
+    """Differential tests: the on-device configuration-axis sweep
+    (``analysis/jax_sweep.py``) against the host oracle graph.
+
+    Tolerances reflect the documented approximation contract: the device
+    path always uses the moment approximation for P(keep) where the host
+    uses exact PMF convolution below 100 users, and Laplace error
+    quantiles come from a quantile table instead of per-partition
+    Monte-Carlo."""
+
+    @staticmethod
+    def _dataset(n=4000, users=300, parts=25, seed=0):
+        rng = np.random.default_rng(seed)
+        return pdp.ArrayDataset(
+            privacy_ids=rng.integers(0, users, n),
+            partition_keys=rng.integers(0, parts, n),
+            values=rng.uniform(0, 5, n).astype(np.float64))
+
+    @staticmethod
+    def _run_both(ds, options, public=None):
+        from pipelinedp_tpu.backends import JaxBackend
+        ex = pdp.DataExtractors()
+        host = list(analysis.perform_utility_analysis(
+            ds, pdp.LocalBackend(), options, ex, public_partitions=public))
+        fused_result = analysis.perform_utility_analysis(
+            ds, JaxBackend(), options, ex, public_partitions=public)
+        from pipelinedp_tpu.analysis import jax_sweep
+        assert isinstance(fused_result, jax_sweep.LazySweepResult), (
+            "fused backend must dispatch to the device sweep")
+        return host[0], list(fused_result)[0]
+
+    @staticmethod
+    def _assert_metrics_close(h, f, rtol=0.05, atol=0.5):
+        for field in ("error_l0_expected", "error_linf_expected",
+                      "error_expected", "error_variance",
+                      "ratio_data_dropped_l0", "ratio_data_dropped_linf",
+                      "error_expected_w_dropped_partitions", "noise_std"):
+            hv, fv = getattr(h, field), getattr(f, field)
+            assert fv == pytest.approx(hv, rel=rtol, abs=atol), (
+                field, hv, fv)
+        # Quantiles: the host path Monte-Carlos Laplace quantiles with only
+        # 1k samples, so compare at the scale of the whole error
+        # distribution, not of each (possibly near-zero) quantile.
+        spread = max(abs(q) for q in h.error_quantiles) or 1.0
+        for hq, fq in zip(h.error_quantiles, f.error_quantiles):
+            scale = max(1.0, abs(hq), 0.1 * spread)
+            assert abs(hq - fq) / scale < 0.15, (h.error_quantiles,
+                                                 f.error_quantiles)
+
+    def test_count_multi_config_truncated_geometric(self):
+        ds = self._dataset()
+        multi = data_structures.MultiParameterConfiguration(
+            max_partitions_contributed=[1, 3, 9, 27],
+            max_contributions_per_partition=[1, 2, 4, 8])
+        options = analysis.UtilityAnalysisOptions(
+            epsilon=2.0, delta=1e-6,
+            aggregate_params=count_params(l0=4, linf=2),
+            multi_param_configuration=multi)
+        host, fused = self._run_both(ds, options)
+        assert len(host) == len(fused) == 4
+        for h, f in zip(host, fused):
+            self._assert_metrics_close(h.count_metrics, f.count_metrics)
+            hp = h.partition_selection_metrics
+            fp = f.partition_selection_metrics
+            assert fp.num_partitions == hp.num_partitions
+            assert fp.dropped_partitions_expected == pytest.approx(
+                hp.dropped_partitions_expected, rel=0.05, abs=0.3)
+
+    def test_all_metrics_gaussian(self):
+        ds = self._dataset(seed=1)
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM,
+                     pdp.Metrics.PRIVACY_ID_COUNT],
+            noise_kind=pdp.NoiseKind.GAUSSIAN,
+            max_partitions_contributed=3,
+            max_contributions_per_partition=2,
+            min_value=0.0, max_value=5.0,
+            min_sum_per_partition=None, max_sum_per_partition=None)
+        # SUM analysis uses per-partition sum bounds.
+        params.min_sum_per_partition = 0.0
+        params.max_sum_per_partition = 20.0
+        params.min_value = params.max_value = None
+        options = analysis.UtilityAnalysisOptions(
+            epsilon=1.0, delta=1e-6, aggregate_params=params)
+        host, fused = self._run_both(ds, options)
+        h, f = host[0], fused[0]
+        self._assert_metrics_close(h.count_metrics, f.count_metrics)
+        self._assert_metrics_close(h.sum_metrics, f.sum_metrics)
+        self._assert_metrics_close(h.privacy_id_count_metrics,
+                                   f.privacy_id_count_metrics)
+
+    def test_public_partitions_with_empty(self):
+        ds = self._dataset(parts=10, seed=2)
+        public = list(range(14))  # 4 empty public partitions
+        options = analysis.UtilityAnalysisOptions(
+            epsilon=1.0, delta=1e-6,
+            aggregate_params=count_params(l0=2, linf=2))
+        host, fused = self._run_both(ds, options, public=public)
+        assert fused[0].partition_selection_metrics is None
+        self._assert_metrics_close(host[0].count_metrics,
+                                   fused[0].count_metrics)
+
+    @pytest.mark.parametrize("strategy", [
+        pdp.PartitionSelectionStrategy.LAPLACE_THRESHOLDING,
+        pdp.PartitionSelectionStrategy.GAUSSIAN_THRESHOLDING,
+    ])
+    def test_thresholding_strategies(self, strategy):
+        ds = self._dataset(seed=3)
+        options = analysis.UtilityAnalysisOptions(
+            epsilon=1.0, delta=1e-6,
+            aggregate_params=count_params(
+                l0=3, linf=2, partition_selection_strategy=strategy))
+        host, fused = self._run_both(ds, options)
+        hp = host[0].partition_selection_metrics
+        fp = fused[0].partition_selection_metrics
+        assert fp.dropped_partitions_expected == pytest.approx(
+            hp.dropped_partitions_expected, rel=0.05, abs=0.3)
+        self._assert_metrics_close(host[0].count_metrics,
+                                   fused[0].count_metrics)
+
+    def test_chunked_configs_match_single_chunk(self, monkeypatch):
+        from pipelinedp_tpu.analysis import jax_sweep
+        from pipelinedp_tpu.backends import JaxBackend
+        ds = self._dataset(n=1000, users=100, parts=8)
+        multi = data_structures.MultiParameterConfiguration(
+            max_partitions_contributed=[1, 2, 3, 4, 5],
+            max_contributions_per_partition=[1, 1, 2, 2, 3])
+        options = analysis.UtilityAnalysisOptions(
+            epsilon=1.0, delta=1e-6,
+            aggregate_params=count_params(l0=2, linf=1),
+            multi_param_configuration=multi)
+        ex = pdp.DataExtractors()
+        one = list(analysis.perform_utility_analysis(
+            ds, JaxBackend(), options, ex))[0]
+        monkeypatch.setattr(jax_sweep, "_CHUNK_CAP", 2)
+        chunked = list(analysis.perform_utility_analysis(
+            ds, JaxBackend(), options, ex))[0]
+        for a, b in zip(one, chunked):
+            assert b.count_metrics.error_expected == pytest.approx(
+                a.count_metrics.error_expected, rel=1e-5)
+            assert b.count_metrics.error_variance == pytest.approx(
+                a.count_metrics.error_variance, rel=1e-5)
+
+    def test_host_fallback_paths(self):
+        # Pre-aggregated data and per-partition results use the host graph.
+        from pipelinedp_tpu.analysis import jax_sweep
+        options = analysis.UtilityAnalysisOptions(
+            epsilon=1.0, delta=1e-6,
+            aggregate_params=count_params(l0=2, linf=1),
+            partitions_sampling_prob=0.5)
+        assert not jax_sweep.sweep_is_supported(options, None, False)
+        options2 = analysis.UtilityAnalysisOptions(
+            epsilon=1.0, delta=1e-6,
+            aggregate_params=count_params(l0=2, linf=1))
+        assert not jax_sweep.sweep_is_supported(options2, None, True)
+        assert jax_sweep.sweep_is_supported(options2, None, False)
