@@ -88,6 +88,17 @@ def sweep_is_supported(options: data_structures.UtilityAnalysisOptions,
         # NotImplementedError eagerly instead of failing in the kernel.
         return False
     multi = options.multi_param_configuration
+    if Metrics.SUM in params.metrics:
+        # SUM analysis clips per-partition sums: both bounds must come
+        # from the params or the per-config vectors; anything else (the
+        # host's one-sided clip, or its ValueError on missing bounds)
+        # stays on the host path rather than silently diverging.
+        has_base = (params.min_sum_per_partition is not None and
+                    params.max_sum_per_partition is not None)
+        has_multi = (multi is not None and
+                     multi.min_sum_per_partition is not None)
+        if not (has_base or has_multi):
+            return False
     if multi is not None and (multi.noise_kind is not None or
                               multi.partition_selection_strategy is not None):
         return False  # per-config mechanism changes: host path
@@ -99,8 +110,11 @@ def sweep_is_supported(options: data_structures.UtilityAnalysisOptions,
 # ---------------------------------------------------------------------------
 
 
-def _config_vectors(options) -> Dict[str, np.ndarray]:
-    """[C] vectors of the swept parameters."""
+def _config_vectors(
+        options) -> Tuple[Dict[str, np.ndarray], List[AggregateParams]]:
+    """[C] vectors of the swept parameters. The sum bounds are guaranteed
+    set when SUM is analyzed (``sweep_is_supported``); the 0.0 fallback
+    only feeds configs whose metrics never read them."""
     all_params = list(data_structures.get_aggregate_params(options))
     return {
         "l0": np.asarray([p.max_partitions_contributed for p in all_params],
@@ -110,11 +124,11 @@ def _config_vectors(options) -> Dict[str, np.ndarray]:
             np.float32),
         "min_sum": np.asarray(
             [p.min_sum_per_partition
-             if p.min_sum_per_partition is not None else p.min_value or 0.0
+             if p.min_sum_per_partition is not None else 0.0
              for p in all_params], np.float32),
         "max_sum": np.asarray(
             [p.max_sum_per_partition
-             if p.max_sum_per_partition is not None else p.max_value or 0.0
+             if p.max_sum_per_partition is not None else 0.0
              for p in all_params], np.float32),
     }, all_params
 

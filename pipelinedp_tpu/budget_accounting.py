@@ -227,14 +227,24 @@ class BudgetAccountant(abc.ABC):
                         f"Aggregation {i} has weight {w}, but "
                         f"'aggregation_weights' declared {e}.")
 
-    def _compute_budget_for_aggregation(self, weight: float) -> Budget:
+    def _compute_budget_for_aggregation(self,
+                                        weight: float) -> Optional[Budget]:
         """The (eps, delta) share a whole aggregation with ``weight`` will
-        consume — used for annotations (reference :177-201)."""
-        total_weight = sum(self._actual_aggregation_weights)
-        if total_weight == 0:
-            return Budget(0.0, 0.0)
-        share = weight / total_weight
-        return Budget(self._total_epsilon * share, self._total_delta * share)
+        consume — used for annotations (reference :177-201).
+
+        A per-aggregation budget is only knowable at aggregation time when
+        the pipeline shape was declared up front (``num_aggregations`` or
+        ``aggregation_weights``); otherwise returns None, like the
+        reference."""
+        if self._expected_num_aggregations:
+            return Budget(
+                self._total_epsilon / self._expected_num_aggregations,
+                self._total_delta / self._expected_num_aggregations)
+        if self._expected_aggregation_weights:
+            share = weight / sum(self._expected_aggregation_weights)
+            return Budget(self._total_epsilon * share,
+                          self._total_delta * share)
+        return None
 
     # --- abstract API ---
 

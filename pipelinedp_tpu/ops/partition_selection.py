@@ -44,6 +44,7 @@ survival probability delta_threshold'.
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Optional, Union
 
@@ -135,10 +136,7 @@ class TruncatedGeometricPartitionStrategy(PartitionSelectionStrategyBase):
         return self._keep_table[idx]
 
 
-import functools
-
-
-@functools.lru_cache(maxsize=65536)
+@functools.lru_cache(maxsize=64)
 def _truncated_geometric_table(eps: float, delta: float) -> np.ndarray:
     """Precomputes pi_n until saturation (pi_n == 1), in closed form.
 

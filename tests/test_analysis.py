@@ -504,3 +504,160 @@ class TestFusedSweep:
             aggregate_params=count_params(l0=2, linf=1))
         assert not jax_sweep.sweep_is_supported(options2, None, True)
         assert jax_sweep.sweep_is_supported(options2, None, False)
+
+
+class TestAnalysisErrorModelClosedForm:
+    """Closed-form checks of the per-partition error model and the
+    cross-partition aggregation — a representative subset of the
+    reference's ``analysis/tests/combiners_test.py`` matrix."""
+
+    def _params(self, agg_params, eps=1.0, delta=1e-6):
+        spec = MechanismSpec(MechanismType.LAPLACE, _eps=eps, _delta=delta)
+        return CombinerParams(spec, agg_params)
+
+    @pytest.mark.parametrize(
+        "counts,n_parts,l0,linf,exp_sum,exp_min,exp_max,exp_l0,exp_var",
+        [
+            # Single user under all caps: no errors at all.
+            ([2], [1], 4, 4, 2.0, 0.0, 0.0, 0.0, 0.0),
+            # linf clip only: 7 -> 3, keep prob 1 (n_parts <= l0).
+            ([7], [1], 4, 3, 7.0, 0.0, -4.0, 0.0, 0.0),
+            # l0 drop only: contribution 2 kept w.p. 1/2.
+            ([2], [2], 1, 4, 2.0, 0.0, 0.0, -1.0, 1.0),
+            # Both: clip 9->2, keep prob 1/4 -> E=-2*(3/4), Var=4*3/16.
+            ([9], [4], 1, 2, 9.0, 0.0, -7.0, -1.5, 0.75),
+            # Two users sum their independent errors.
+            ([9, 1], [4, 1], 1, 2, 10.0, 0.0, -7.0, -1.5, 0.75),
+        ])
+    def test_count_error_decomposition(self, counts, n_parts, l0, linf,
+                                       exp_sum, exp_min, exp_max, exp_l0,
+                                       exp_var):
+        c = ua_combiners.CountCombiner(
+            self._params(count_params(l0=l0, linf=linf)))
+        m = c.compute_metrics(
+            c.create_accumulator((np.array(counts), np.zeros(len(counts)),
+                                  np.array(n_parts))))
+        assert m.sum == exp_sum
+        assert m.per_partition_error_min == pytest.approx(exp_min)
+        assert m.per_partition_error_max == pytest.approx(exp_max)
+        assert m.expected_cross_partition_error == pytest.approx(exp_l0)
+        assert m.std_cross_partition_error**2 == pytest.approx(exp_var)
+        # Documented invariant (metrics.py): E[bounded sum] decomposition.
+        e_bounded = (m.sum + m.per_partition_error_min +
+                     m.per_partition_error_max +
+                     m.expected_cross_partition_error)
+        clipped = np.clip(counts, 0, linf)
+        probs = np.minimum(1, l0 / np.array(n_parts))
+        assert e_bounded == pytest.approx(float((clipped * probs).sum()))
+
+    @pytest.mark.parametrize("sums,bounds,exp_min,exp_max", [
+        ([15.0], (0.0, 10.0), 0.0, -5.0),
+        ([-5.0], (0.0, 10.0), 5.0, 0.0),
+        ([-5.0, 15.0, 3.0], (0.0, 10.0), 5.0, -5.0),
+        ([2.0], (-1.0, 1.0), 0.0, -1.0),
+    ])
+    def test_sum_clip_errors(self, sums, bounds, exp_min, exp_max):
+        agg = pdp.AggregateParams(
+            metrics=[pdp.Metrics.SUM], max_partitions_contributed=4,
+            max_contributions_per_partition=4,
+            min_sum_per_partition=bounds[0], max_sum_per_partition=bounds[1])
+        c = ua_combiners.SumCombiner(self._params(agg))
+        m = c.compute_metrics(c.create_accumulator(
+            (None, np.array(sums), np.ones(len(sums), int))))
+        assert m.per_partition_error_min == pytest.approx(exp_min)
+        assert m.per_partition_error_max == pytest.approx(exp_max)
+
+    def test_merge_is_elementwise_addition(self):
+        c = ua_combiners.CountCombiner(
+            self._params(count_params(l0=1, linf=2)))
+        a1 = c.create_accumulator((np.array([5]), np.zeros(1), np.array([2])))
+        a2 = c.create_accumulator((np.array([1]), np.zeros(1), np.array([1])))
+        merged = c.merge_accumulators(a1, a2)
+        assert merged == tuple(x + y for x, y in zip(a1, a2))
+
+    def test_partition_selection_exact_pmf_vs_moments(self):
+        """Below MAX_PROBABILITIES the calculator uses the exact PMF; the
+        moment approximation must agree closely for homogeneous probs."""
+        from pipelinedp_tpu.aggregate_params import (
+            PartitionSelectionStrategy)
+        probs = [0.7] * 80
+        exact = ua_combiners.PartitionSelectionCalculator(
+            probabilities=list(probs))
+        approx = ua_combiners.PartitionSelectionCalculator(
+            moments=ua_combiners._probabilities_to_moments(probs))
+        for strat in (PartitionSelectionStrategy.TRUNCATED_GEOMETRIC,
+                      PartitionSelectionStrategy.LAPLACE_THRESHOLDING):
+            pe = exact.compute_probability_to_keep(strat, 1.0, 1e-6, 2)
+            pa = approx.compute_probability_to_keep(strat, 1.0, 1e-6, 2)
+            assert pa == pytest.approx(pe, abs=2e-3)
+
+    def test_aggregate_error_combiner_weights_by_keep_probability(self):
+        comb = ua_combiners.SumAggregateErrorMetricsCombiner(
+            metrics.AggregateMetricType.COUNT, [0.5])
+        sm = metrics.SumMetrics(
+            sum=10.0, per_partition_error_min=0.0,
+            per_partition_error_max=-2.0,
+            expected_cross_partition_error=-3.0,
+            std_cross_partition_error=2.0, std_noise=1.0,
+            noise_kind=pdp.NoiseKind.GAUSSIAN)
+        acc = comb.create_accumulator(sm, prob_to_keep=0.5)
+        assert acc.kept_partitions_expected == 0.5
+        assert acc.error_l0_expected == pytest.approx(0.5 * -3.0)
+        assert acc.error_linf_max_expected == pytest.approx(0.5 * -2.0)
+        assert acc.error_l0_variance == pytest.approx(0.5 * 4.0)
+        assert acc.error_variance == pytest.approx(0.5 * (4.0 + 1.0))
+        # Data dropped by selection: (1-p) * surviving contribution.
+        assert acc.data_dropped_partition_selection == pytest.approx(
+            0.5 * (10.0 - 3.0 - 2.0))
+        assert acc.error_expected_w_dropped_partitions == pytest.approx(
+            0.5 * (-3.0 - 2.0) + 0.5 * -10.0)
+        # Gaussian quantile: closed-form normal ppf at inverted levels.
+        import scipy.stats
+        want = scipy.stats.norm.ppf(0.5, loc=-3.0,
+                                    scale=math.sqrt(4.0 + 1.0))
+        assert acc.error_quantiles[0] == pytest.approx(
+            0.5 * (want + (-2.0)))
+
+    def test_aggregate_error_metrics_normalization(self):
+        comb = ua_combiners.SumAggregateErrorMetricsCombiner(
+            metrics.AggregateMetricType.COUNT, [0.5])
+        sm = metrics.SumMetrics(
+            sum=10.0, per_partition_error_min=0.0,
+            per_partition_error_max=0.0,
+            expected_cross_partition_error=-4.0,
+            std_cross_partition_error=0.0, std_noise=1.0,
+            noise_kind=pdp.NoiseKind.GAUSSIAN)
+        acc = comb.merge_accumulators(
+            comb.create_accumulator(sm, prob_to_keep=1.0),
+            comb.create_accumulator(sm, prob_to_keep=0.5))
+        m = comb.compute_metrics(acc)
+        # Averages over EXPECTED kept partitions (1.5), except the
+        # dropped-partition-aware error which averages over all (2).
+        assert m.error_l0_expected == pytest.approx(
+            (1.0 * -4.0 + 0.5 * -4.0) / 1.5)
+        assert m.error_expected_w_dropped_partitions == pytest.approx(
+            ((1.0 * -4.0 + 0.0) + (0.5 * -4.0 + 0.5 * -10.0)) / 2.0)
+        # Global drop ratios divide by the total true aggregate.
+        assert m.ratio_data_dropped_l0 == pytest.approx((4.0 + 4.0) / 20.0)
+
+    def test_compound_uses_each_configs_own_keep_probability(self):
+        """Regression: the reference scored every configuration with the
+        FIRST configuration's keep probability (reference
+        ``analysis/combiners.py:470-483``); each configuration must use
+        its own."""
+        sel = ua_combiners.PrivatePartitionSelectionAggregateErrorMetricsCombiner(
+            [0.5])
+        mk = ua_combiners.SumAggregateErrorMetricsCombiner(
+            metrics.AggregateMetricType.COUNT, [0.5])
+        compound = ua_combiners.AggregateErrorMetricsCompoundCombiner(
+            [sel, mk, sel, mk], return_named_tuple=False)
+        sm = metrics.SumMetrics(
+            sum=10.0, per_partition_error_min=0.0,
+            per_partition_error_max=0.0,
+            expected_cross_partition_error=-4.0,
+            std_cross_partition_error=0.0, std_noise=1.0,
+            noise_kind=pdp.NoiseKind.GAUSSIAN)
+        _, accs = compound.create_accumulator((1.0, sm, 0.25, sm))
+        assert accs[1].kept_partitions_expected == 1.0
+        assert accs[3].kept_partitions_expected == 0.25
+        assert accs[3].error_l0_expected == pytest.approx(0.25 * -4.0)

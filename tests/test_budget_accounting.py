@@ -124,11 +124,16 @@ class TestNaiveBudgetAccountant:
                                   aggregation_weights=[1])
 
     def test_budget_for_aggregation_annotation(self):
-        acc = NaiveBudgetAccountant(total_epsilon=2.0, total_delta=2e-6)
-        with acc.scope(weight=1):
-            acc.request_budget(MechanismType.GAUSSIAN)
-        with acc.scope(weight=3):
-            acc.request_budget(MechanismType.GAUSSIAN)
+        # Knowable only when the pipeline shape was declared up front
+        # (reference budget_accounting.py:177-201).
+        acc = NaiveBudgetAccountant(total_epsilon=2.0, total_delta=2e-6,
+                                    aggregation_weights=[1, 3])
         budget = acc._compute_budget_for_aggregation(1)
         assert budget.epsilon == pytest.approx(0.5)
         assert budget.delta == pytest.approx(5e-7)
+        acc2 = NaiveBudgetAccountant(total_epsilon=2.0, total_delta=2e-6,
+                                     num_aggregations=4)
+        budget2 = acc2._compute_budget_for_aggregation(1)
+        assert budget2.epsilon == pytest.approx(0.5)
+        acc3 = NaiveBudgetAccountant(total_epsilon=2.0, total_delta=2e-6)
+        assert acc3._compute_budget_for_aggregation(1) is None

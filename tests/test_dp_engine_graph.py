@@ -1,0 +1,228 @@
+"""Graph-shape tests: assert WHICH nodes the engine builds and with what
+arguments, using mocked bounders/combiners/selection — the reference's
+``tests/dp_engine_test.py:209-389`` pattern (mock.patch over node
+factories, deterministic mock selection strategies, annotator budgets)
+without depending on DP randomness."""
+
+import operator
+from unittest import mock
+
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import dp_engine as dp_engine_mod
+from pipelinedp_tpu import pipeline_backend
+
+
+def extractors():
+    return pdp.DataExtractors(privacy_id_extractor=operator.itemgetter(0),
+                              partition_extractor=operator.itemgetter(1),
+                              value_extractor=operator.itemgetter(2))
+
+
+def data(n_users=10, n_parts=4, rows_per=3):
+    return [(u, p, 1.0) for u in range(n_users) for p in range(n_parts)
+            for _ in range(rows_per)]
+
+
+def count_params(**kw):
+    base = dict(metrics=[pdp.Metrics.COUNT], max_partitions_contributed=4,
+                max_contributions_per_partition=4)
+    base.update(kw)
+    return pdp.AggregateParams(**base)
+
+
+def make_engine(eps=1e5, delta=1e-2):
+    acc = pdp.NaiveBudgetAccountant(total_epsilon=eps, total_delta=delta)
+    return pdp.DPEngine(acc, pdp.LocalBackend()), acc
+
+
+class TestGraphShape:
+
+    def test_bounder_receives_graph_arguments(self):
+        """The engine hands the bounder (col, params, backend, report,
+        create_accumulator) — reference dp_engine_test.py:209-241."""
+        engine, acc = make_engine()
+        params = count_params()
+        bounder = mock.MagicMock()
+        bounder.bound_contributions.return_value = []
+        with mock.patch.object(pdp.DPEngine, "_create_contribution_bounder",
+                               return_value=bounder):
+            engine.aggregate(data(), params, extractors())
+        acc.compute_budgets()
+        assert bounder.bound_contributions.call_count == 1
+        args = bounder.bound_contributions.call_args[0]
+        assert list(args[0]) == [(u, p, 1.0) for (u, p, _) in data()]
+        assert args[1] is params
+        assert isinstance(args[2], pdp.LocalBackend)
+        assert callable(args[4])  # combiner.create_accumulator
+
+    def test_bounder_choice_depends_on_params(self):
+        engine, _ = make_engine()
+        from pipelinedp_tpu import contribution_bounders as cb
+        assert isinstance(
+            engine._create_contribution_bounder(count_params()),
+            cb.SamplingCrossAndPerPartitionContributionBounder)
+        assert isinstance(
+            engine._create_contribution_bounder(
+                count_params(max_contributions=4,
+                             max_partitions_contributed=None,
+                             max_contributions_per_partition=None)),
+            cb.SamplingPerPrivacyIdContributionBounder)
+
+    def test_public_partitions_drop_node_built(self):
+        """Public partitions insert the drop node before extraction —
+        reference dp_engine_test.py:243-266."""
+        engine, acc = make_engine()
+        original = pdp.DPEngine._drop_not_public_partitions
+        with mock.patch.object(pdp.DPEngine, "_drop_not_public_partitions",
+                               side_effect=original,
+                               autospec=True) as drop:
+            out = engine.aggregate(data(), count_params(),
+                                   extractors(),
+                                   public_partitions=[0, 1, 99])
+            acc.compute_budgets()
+            result = dict(out)
+        assert drop.call_count == 1
+        assert drop.call_args[0][2] == [0, 1, 99]
+        # Non-public partitions 2, 3 dropped; missing public 99 injected.
+        assert sorted(result) == [0, 1, 99]
+
+    def test_public_partitions_already_filtered_skips_drop(self):
+        engine, acc = make_engine()
+        with mock.patch.object(pdp.DPEngine,
+                               "_drop_not_public_partitions") as drop:
+            out = engine.aggregate(
+                data(), count_params(public_partitions_already_filtered=True),
+                extractors(), public_partitions=[0, 1, 2, 3])
+            acc.compute_budgets()
+            list(out)
+        drop.assert_not_called()
+
+    def test_mock_selection_strategy_controls_kept_partitions(self):
+        """Deterministic partition selection via a mocked strategy object —
+        reference dp_engine_test.py:290-315."""
+
+        class MockStrategy:
+            def should_keep(self, num_users):
+                return num_users >= 8
+
+        # 10 users hit partitions 0..3; partition 3 additionally loses
+        # users (only 5 contribute).
+        rows = [(u, p, 1.0) for u in range(10) for p in range(3)]
+        rows += [(u, 3, 1.0) for u in range(5)]
+        engine, acc = make_engine()
+        with mock.patch.object(dp_engine_mod,
+                               "_cached_partition_selection_strategy",
+                               return_value=MockStrategy()):
+            out = engine.aggregate(rows, count_params(), extractors())
+            acc.compute_budgets()
+            result = dict(out)
+        assert sorted(result) == [0, 1, 2]  # partition 3: 5 users < 8
+
+    def test_custom_combiner_factory_node(self):
+        """custom_combiners route through the dedicated factory —
+        reference dp_engine_test.py:757-780."""
+        from pipelinedp_tpu import combiners as combiners_mod
+
+        class Custom(combiners_mod.CustomCombiner):
+            def create_accumulator(self, values):
+                return len(list(values))
+
+            def merge_accumulators(self, a, b):
+                return a + b
+
+            def compute_metrics(self, acc):
+                return {"n": acc}
+
+            def metrics_names(self):
+                return ["n"]
+
+            def request_budget(self, budget_accountant):
+                self._budget = budget_accountant.request_budget(
+                    pdp.MechanismType.LAPLACE)
+
+            def explain_computation(self):
+                return lambda: "custom"
+
+        engine, acc = make_engine()
+        custom = Custom()
+        params = pdp.AggregateParams(max_partitions_contributed=2,
+                                     max_contributions_per_partition=2,
+                                     custom_combiners=[custom])
+        with mock.patch.object(
+                combiners_mod, "create_compound_combiner_with_custom_combiners",
+                side_effect=combiners_mod.
+                create_compound_combiner_with_custom_combiners) as factory:
+            out = engine.aggregate(data(), params, extractors())
+            acc.compute_budgets()
+            list(out)
+        assert factory.call_count == 1
+        assert factory.call_args[0][2] == [custom]
+
+    def test_annotators_receive_per_aggregation_budget(self):
+        """Annotators get (params, per-aggregation Budget) at each
+        aggregation — reference dp_engine_test.py:782-808."""
+        seen = []
+
+        class Recorder(pipeline_backend.Annotator):
+            def annotate(self, col, params=None, budget=None):
+                seen.append((params, budget))
+                return col
+
+        rec = Recorder()
+        pipeline_backend.register_annotator(rec)
+        try:
+            # Declared pipeline shape makes per-aggregation budgets
+            # knowable at aggregation time (reference semantics).
+            acc = pdp.NaiveBudgetAccountant(total_epsilon=3.0,
+                                            total_delta=3e-6,
+                                            aggregation_weights=[1, 2])
+            engine = pdp.DPEngine(acc, pdp.LocalBackend())
+            p1 = count_params(budget_weight=1)
+            p2 = count_params(budget_weight=2)
+            r1 = engine.aggregate(data(), p1, extractors())
+            r2 = engine.aggregate(data(), p2, extractors())
+            acc.compute_budgets()
+            list(r1), list(r2)
+        finally:
+            pipeline_backend._annotators.remove(rec)
+        assert len(seen) == 2
+        (params1, b1), (params2, b2) = seen
+        assert params1 is p1 and params2 is p2
+        # Weighted split of the total (ε, δ): 1:2.
+        assert b1.epsilon == pytest.approx(1.0)
+        assert b2.epsilon == pytest.approx(2.0)
+        assert b1.delta == pytest.approx(1e-6)
+        assert b2.delta == pytest.approx(2e-6)
+
+    def test_budget_annotation_none_without_declared_shape(self):
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=1.0, total_delta=1e-6)
+        assert acc._compute_budget_for_aggregation(1.0) is None
+
+    def test_selection_budget_requested_only_for_private(self):
+        engine, acc = make_engine()
+        out = engine.aggregate(data(), count_params(), extractors(),
+                               public_partitions=[0, 1])
+        n_public = len(acc._mechanisms)
+        engine2, acc2 = make_engine()
+        out2 = engine2.aggregate(data(), count_params(), extractors())
+        n_private = len(acc2._mechanisms)
+        # Private selection adds exactly one GENERIC mechanism request.
+        assert n_private == n_public + 1
+
+    def test_bounds_already_enforced_skips_bounder(self):
+        engine, acc = make_engine()
+        rows = [(0, 1.0), (0, 2.0), (1, 1.0)]
+        ex = pdp.DataExtractors(partition_extractor=operator.itemgetter(0),
+                                value_extractor=operator.itemgetter(1))
+        with mock.patch.object(pdp.DPEngine,
+                               "_create_contribution_bounder") as bound:
+            out = engine.aggregate(
+                rows,
+                count_params(contribution_bounds_already_enforced=True),
+                ex)
+            acc.compute_budgets()
+            dict(out)
+        bound.assert_not_called()

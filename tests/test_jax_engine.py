@@ -418,3 +418,97 @@ class TestEnforcedBoundsSelectionEstimate:
             kept += "solo" in fused
         # P(keep | 1 user) <= delta = 1e-4: 40 trials should keep ~0.
         assert kept == 0
+
+
+class TestShardedMultiChipBroad:
+    """VERDICT r1 #8: VARIANCE, VECTOR_SUM, per-partition-bound SUM and
+    public partitions on the 8-device mesh, each pinned to the
+    single-device output."""
+
+    def _mesh(self):
+        import jax
+        from pipelinedp_tpu.parallel import make_mesh
+        assert len(jax.devices()) >= 8
+        return make_mesh(8)
+
+    def _both(self, data, params, seed, public=None):
+        noise_ops.seed_host_rng(0)
+        single = run(JaxBackend(rng_seed=seed), data, params,
+                     public_partitions=public)
+        noise_ops.seed_host_rng(0)
+        sharded = run(JaxBackend(mesh=self._mesh(), rng_seed=seed), data,
+                      params, public_partitions=public)
+        assert set(single) == set(sharded)
+        return single, sharded
+
+    def test_variance_on_mesh(self):
+        rng = np.random.default_rng(7)
+        data = [(u, f"p{u % 4}", float(v))
+                for u, v in enumerate(rng.uniform(0, 10, 2000))]
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.VARIANCE, pdp.Metrics.MEAN,
+                     pdp.Metrics.COUNT],
+            max_partitions_contributed=2,
+            max_contributions_per_partition=2,
+            min_value=0.0, max_value=10.0)
+        single, sharded = self._both(data, params, seed=41)
+        for k in single:
+            assert sharded[k].count == pytest.approx(single[k].count,
+                                                     rel=0.02)
+            assert sharded[k].mean == pytest.approx(single[k].mean,
+                                                    abs=0.3)
+            assert sharded[k].variance == pytest.approx(
+                single[k].variance, rel=0.2, abs=0.5)
+
+    def test_vector_sum_on_mesh(self):
+        rng = np.random.default_rng(8)
+        data = [(u, f"p{u % 3}", rng.uniform(-1, 1, 4))
+                for u in range(600)]
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.VECTOR_SUM],
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1,
+            vector_size=4, vector_max_norm=5.0,
+            vector_norm_kind=pdp.NormKind.L2)
+        single, sharded = self._both(data, params, seed=42)
+        for k in single:
+            np.testing.assert_allclose(sharded[k].vector_sum,
+                                       single[k].vector_sum, atol=1.0)
+
+    def test_per_partition_bound_sum_on_mesh(self):
+        # Each user's per-partition sum is 30, clipped to 10: the clip
+        # happens per (pid, pk) segment and must survive sharding.
+        data = [(u, f"p{u % 2}", 10.0) for u in range(200) for _ in range(3)]
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.SUM], max_partitions_contributed=1,
+            max_contributions_per_partition=5,
+            min_sum_per_partition=0.0, max_sum_per_partition=10.0)
+        single, sharded = self._both(data, params, seed=43)
+        for k in single:
+            assert single[k].sum == pytest.approx(1000.0, rel=0.02)
+            assert sharded[k].sum == pytest.approx(single[k].sum,
+                                                   rel=0.02)
+
+    def test_public_partitions_on_mesh(self):
+        data = [(u, f"p{u % 3}", 1.0) for u in range(300)]
+        public = ["p0", "p1", "p2", "p_empty"]
+        params = count_params(max_partitions_contributed=1,
+                              max_contributions_per_partition=1)
+        single, sharded = self._both(data, params, seed=44, public=public)
+        assert sorted(sharded) == sorted(public)
+        for k in public:
+            assert sharded[k].count == pytest.approx(single[k].count,
+                                                     abs=0.5)
+        assert sharded["p_empty"].count == pytest.approx(0.0, abs=0.5)
+
+    def test_uneven_shard_load(self):
+        # One privacy id owns half the rows: hashing must still place all
+        # its rows on one shard and results must match single-device.
+        data = ([(0, "hot", 1.0)] * 500 +
+                [(u, f"p{u % 4}", 1.0) for u in range(1, 401)])
+        params = count_params(max_partitions_contributed=2,
+                              max_contributions_per_partition=600)
+        single, sharded = self._both(data, params, seed=45)
+        for k in single:
+            assert sharded[k].count == pytest.approx(single[k].count,
+                                                     rel=0.05)
