@@ -100,6 +100,20 @@ def _add_random_noise(value: ArrayLike, eps: float, delta: float,
     if noise_kind == NoiseKind.LAPLACE:
         scale = noise_ops.laplace_scale(
             eps, compute_l1_sensitivity(l0_sensitivity, linf_sensitivity))
+        if noise_ops.secure_host_noise_enabled() and rng is None:
+            # Hardened release from the native library: exact two-sided
+            # geometric noise for integer queries (counts — no float
+            # noise bits at all), the snapping mechanism (value + noise,
+            # snapped) otherwise.
+            from pipelinedp_tpu import native
+            varr = np.asarray(value)
+            if varr.dtype.kind in "iu":
+                result = native.discrete_laplace(varr, scale).astype(
+                    np.float64)
+            else:
+                result = native.snapping_laplace(
+                    varr.astype(np.float64), scale)
+            return result if shape else float(result)
         noise = noise_ops.np_laplace(scale, shape=shape, rng=rng)
     elif noise_kind == NoiseKind.GAUSSIAN:
         sigma = noise_ops.gaussian_sigma(

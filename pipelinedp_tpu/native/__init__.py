@@ -1,0 +1,182 @@
+"""Native host library: hardened noise for DP releases.
+
+The on-device (TPU) path draws noise with ``jax.random`` — statistically
+correct, and safe for the aggregate pipelines this framework targets, but
+a textbook floating-point Laplace leaks information through the noise
+sample's low-order bits (Mironov, CCS 2012). The reference delegates its
+host noise to the C++ google/differential-privacy library, which hardens
+against this; this package is the TPU framework's native twin:
+
+* ``snapping_laplace(values, scale, bound)`` — Mironov's snapping
+  mechanism over a ChaCha20 CSPRNG,
+* ``discrete_laplace(counts, scale)`` — exact two-sided geometric noise
+  for integer releases (no float noise bits at all),
+* ``seed(n)`` / ``seed_from_os()`` — deterministic seeding for tests,
+  OS entropy otherwise.
+
+The C++ source (``secure_noise.cc``) is compiled on first use with the
+toolchain's ``g++`` into a cached shared library next to this file (or
+``$PIPELINEDP_TPU_NATIVE_CACHE``). Environments without a compiler get
+``NativeUnavailableError`` and callers fall back to the NumPy path —
+``ops/noise.py`` documents the resulting security posture.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "secure_noise.cc")
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_LOAD_ERROR: Optional[str] = None
+
+
+class NativeUnavailableError(RuntimeError):
+    """The native library could not be built/loaded on this host."""
+
+
+def _cache_dir() -> str:
+    override = os.environ.get("PIPELINEDP_TPU_NATIVE_CACHE")
+    if override:
+        os.makedirs(override, exist_ok=True)
+        return override
+    d = os.path.dirname(__file__)
+    return d if os.access(d, os.W_OK) else tempfile.gettempdir()
+
+
+def _build() -> str:
+    out = os.path.join(_cache_dir(), "_secure_noise.so")
+    if (os.path.exists(out) and
+            os.path.getmtime(out) >= os.path.getmtime(_SRC)):
+        return out
+    tmp = out + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise NativeUnavailableError(
+            f"g++ failed building secure_noise: {proc.stderr[-500:]}")
+    os.replace(tmp, out)  # atomic: concurrent builders race harmlessly
+    return out
+
+
+def _lib() -> ctypes.CDLL:
+    global _LIB, _LOAD_ERROR
+    if _LIB is not None:
+        return _LIB
+    if _LOAD_ERROR is not None:
+        raise NativeUnavailableError(_LOAD_ERROR)
+    with _LOCK:
+        if _LIB is not None:
+            return _LIB
+        try:
+            lib = ctypes.CDLL(_build())
+        except (OSError, NativeUnavailableError) as e:
+            _LOAD_ERROR = str(e)
+            raise NativeUnavailableError(_LOAD_ERROR) from e
+        lib.sn_seed.argtypes = [ctypes.c_uint64]
+        lib.sn_seed_from_os.argtypes = []
+        lib.sn_snapping_laplace.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64, ctypes.c_double, ctypes.c_double]
+        lib.sn_snapping_laplace.restype = ctypes.c_double
+        lib.sn_uniform.argtypes = [ctypes.POINTER(ctypes.c_double),
+                                   ctypes.c_int64]
+        lib.sn_discrete_laplace.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_double]
+        _LIB = lib
+        return _LIB
+
+
+def available() -> bool:
+    """True when the native library can be (or was) built and loaded.
+    May spawn a g++ build on first call — use :func:`is_loaded` for a
+    side-effect-free check."""
+    try:
+        _lib()
+        return True
+    except NativeUnavailableError:
+        return False
+
+
+def is_loaded() -> bool:
+    """True iff the library is already loaded in this process. Never
+    triggers a build."""
+    return _LIB is not None
+
+
+def seed(n: int) -> None:
+    """Deterministic CSPRNG seeding — tests only."""
+    _lib().sn_seed(ctypes.c_uint64(n & (2**64 - 1)))
+
+
+def seed_from_os() -> None:
+    """Re-key from OS entropy (e.g. after fork)."""
+    _lib().sn_seed_from_os()
+
+
+def snapping_laplace(values, scale: float,
+                     bound: Optional[float] = None) -> np.ndarray:
+    """Snapping-Laplace release of ``values`` with noise scale ``scale``.
+
+    Returns values + Laplace(scale) noise, rounded to the snapping
+    resolution Lambda (smallest power of two >= scale) and clamped to
+    [-bound, bound]. The default bound is 2^46 * max(Lambda, 1): Mironov's
+    analysis wants B/Lambda bounded (the clamp is part of the mechanism),
+    and the max(..., 1) floor keeps small noise scales from shrinking the
+    representable release range below realistic aggregates. Callers whose
+    releases can legitimately exceed ~7e13 must pass an explicit bound;
+    inputs that the clamp actually truncates raise a UserWarning.
+    """
+    if scale <= 0:
+        raise ValueError("scale must be positive")
+    vals = np.asarray(values, dtype=np.float64)
+    # ascontiguousarray promotes 0-d to 1-d: keep the true shape.
+    shape = vals.shape
+    flat = np.ascontiguousarray(vals).ravel()
+    out = np.empty_like(flat)
+    if bound is None:
+        lam = 2.0**np.ceil(np.log2(scale))
+        bound = float(max(lam, 1.0) * 2.0**46)
+    if flat.size and float(np.max(np.abs(flat))) > bound:
+        import warnings
+        warnings.warn(
+            "snapping_laplace: input magnitude exceeds the clamp bound "
+            f"({bound:.3g}); the release is clamped. Pass an explicit "
+            "bound sized to the query range.", UserWarning)
+    _lib().sn_snapping_laplace(
+        flat.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        flat.size, float(scale), float(bound))
+    return out.reshape(shape)
+
+
+def discrete_laplace(counts, scale: float) -> np.ndarray:
+    """Integer release: counts + two-sided-geometric noise of scale
+    ``scale`` (decay exp(-1/scale)) — no floating-point noise bits."""
+    if scale <= 0:
+        raise ValueError("scale must be positive")
+    vals = np.asarray(counts, dtype=np.int64)
+    shape = vals.shape
+    flat = np.ascontiguousarray(vals).ravel()
+    out = np.empty_like(flat)
+    _lib().sn_discrete_laplace(
+        flat.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        flat.size, float(scale))
+    return out.reshape(shape)
+
+
+def uniform(n: int) -> np.ndarray:
+    """Raw uniforms in (0, 1] from the CSPRNG — for statistical tests."""
+    out = np.empty(n, dtype=np.float64)
+    _lib().sn_uniform(out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                      n)
+    return out

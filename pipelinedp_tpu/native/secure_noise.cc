@@ -1,0 +1,202 @@
+// Secure host-side noise for DP releases — the native twin of the
+// reference's C++ noise hardening (the PyDP/google differential-privacy
+// library uses snapping/geometric constructions; see reference
+// pipeline_dp/dp_computations.py:111-143 delegating to
+// pydp.algorithms.numerical_mechanisms).
+//
+// Two pieces:
+//  * a ChaCha20-based CSPRNG (raw 64-bit blocks -> uniform doubles),
+//    seeded from OS entropy by default, explicitly for tests;
+//  * the snapping Laplace mechanism (Mironov, "On significance of the
+//    least significant bits for differential privacy", CCS 2012):
+//        F(x) = clamp_B( round_to_Lambda( clamp_B(x) + b*S*ln(U) ) )
+//    with U uniform in (0,1], S a random sign, Lambda the smallest power
+//    of two >= b, and round-to-nearest (ties to even) in multiples of
+//    Lambda. The rounding destroys the low-order floating-point bits
+//    that leak information under a textbook Laplace implementation.
+//
+// Built as a plain shared library; bound from Python with ctypes
+// (pipelinedp_tpu/native/__init__.py). No Python.h dependency.
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+
+namespace {
+
+// ---------------------------------------------------------------------
+// ChaCha20 block function (RFC 8439) as a counter-based random stream.
+// ---------------------------------------------------------------------
+
+inline uint32_t rotl(uint32_t x, int n) {
+  return (x << n) | (x >> (32 - n));
+}
+
+#define QR(a, b, c, d)                          \
+  a += b; d ^= a; d = rotl(d, 16);              \
+  c += d; b ^= c; b = rotl(b, 12);              \
+  a += b; d ^= a; d = rotl(d, 8);               \
+  c += d; b ^= c; b = rotl(b, 7);
+
+struct ChaCha {
+  uint32_t state[16];
+  uint32_t block[16];
+  int used;  // words consumed from the current block
+
+  void init(const uint8_t key[32], uint64_t stream) {
+    static const char sigma[17] = "expand 32-byte k";
+    std::memcpy(&state[0], sigma, 16);
+    std::memcpy(&state[4], key, 32);
+    state[12] = 0;  // block counter
+    state[13] = 0;
+    state[14] = static_cast<uint32_t>(stream);
+    state[15] = static_cast<uint32_t>(stream >> 32);
+    used = 16;
+  }
+
+  void refill() {
+    uint32_t x[16];
+    std::memcpy(x, state, sizeof(x));
+    for (int i = 0; i < 10; i++) {  // 20 rounds
+      QR(x[0], x[4], x[8], x[12]);
+      QR(x[1], x[5], x[9], x[13]);
+      QR(x[2], x[6], x[10], x[14]);
+      QR(x[3], x[7], x[11], x[15]);
+      QR(x[0], x[5], x[10], x[15]);
+      QR(x[1], x[6], x[11], x[12]);
+      QR(x[2], x[7], x[8], x[13]);
+      QR(x[3], x[4], x[9], x[14]);
+    }
+    for (int i = 0; i < 16; i++) block[i] = x[i] + state[i];
+    if (++state[12] == 0) ++state[13];
+    used = 0;
+  }
+
+  uint64_t next64() {
+    if (used > 14) refill();
+    uint64_t lo = block[used++];
+    uint64_t hi = block[used++];
+    return (hi << 32) | lo;
+  }
+
+  // Uniform double in (0, 1]: 53 random mantissa bits, never 0 so ln(U)
+  // is finite.
+  double uniform01() {
+    uint64_t r = next64() >> 11;           // 53 bits
+    return (static_cast<double>(r) + 1.0) * 0x1p-53;
+  }
+};
+
+ChaCha g_rng;
+bool g_seeded = false;
+
+void seed_from_os() {
+  uint8_t key[32];
+  FILE* f = std::fopen("/dev/urandom", "rb");
+  if (f != nullptr) {
+    size_t got = std::fread(key, 1, sizeof(key), f);
+    std::fclose(f);
+    if (got == sizeof(key)) {
+      g_rng.init(key, /*stream=*/0);
+      g_seeded = true;
+      return;
+    }
+  }
+  // Last resort (no /dev/urandom): time-derived key. Still ChaCha-mixed.
+  uint64_t t = static_cast<uint64_t>(std::clock());
+  std::memset(key, 0, sizeof(key));
+  std::memcpy(key, &t, sizeof(t));
+  g_rng.init(key, 0);
+  g_seeded = true;
+}
+
+inline void ensure_seeded() {
+  if (!g_seeded) seed_from_os();
+}
+
+// Smallest power of two >= b (b > 0), as a double.
+inline double lambda_for(double b) {
+  int exp;
+  double frac = std::frexp(b, &exp);  // b = frac * 2^exp, frac in [0.5, 1)
+  return (frac == 0.5) ? std::ldexp(1.0, exp - 1) : std::ldexp(1.0, exp);
+}
+
+// Round y to the nearest multiple of lambda, ties to even — uses the
+// FPU's round-to-nearest-even on y/lambda (exact: lambda is a power of
+// two, so the division only shifts the exponent).
+inline double round_to(double y, double lambda) {
+  return std::nearbyint(y / lambda) * lambda;
+}
+
+inline double clamp(double x, double bound) {
+  if (x > bound) return bound;
+  if (x < -bound) return -bound;
+  return x;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Deterministic seeding for tests; any 64-bit seed expands into the key.
+void sn_seed(uint64_t seed) {
+  uint8_t key[32];
+  for (int i = 0; i < 4; i++) {
+    uint64_t w = seed ^ (0x9E3779B97F4A7C15ull * (i + 1));
+    // splitmix64 finalizer per word.
+    w ^= w >> 30; w *= 0xBF58476D1CE4E5B9ull;
+    w ^= w >> 27; w *= 0x94D049BB133111EBull;
+    w ^= w >> 31;
+    std::memcpy(key + 8 * i, &w, 8);
+  }
+  g_rng.init(key, 0);
+  g_seeded = true;
+}
+
+void sn_seed_from_os() { seed_from_os(); }
+
+// Snapping Laplace: adds noise of scale b to each value in-place-style
+// (reads values[i], writes out[i]), clamping to [-bound, bound].
+// Returns the snapping resolution Lambda (callers may report it).
+double sn_snapping_laplace(const double* values, double* out, int64_t n,
+                           double b, double bound) {
+  ensure_seeded();
+  const double lambda = lambda_for(b);
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t bits = g_rng.next64();
+    double sign = (bits & 1) ? 1.0 : -1.0;
+    double u = g_rng.uniform01();
+    double y = clamp(values[i], bound) + b * sign * std::log(u);
+    out[i] = clamp(round_to(y, lambda), bound);
+  }
+  return lambda;
+}
+
+// Raw uniform doubles in (0, 1] — exposed for statistical tests of the
+// underlying stream.
+void sn_uniform(double* out, int64_t n) {
+  ensure_seeded();
+  for (int64_t i = 0; i < n; i++) out[i] = g_rng.uniform01();
+}
+
+// Two-sided geometric ("discrete Laplace") noise with decay
+// q = exp(-1/b): integer-valued noise for count releases — the release
+// has no floating-point noise bits at all. Sampled exactly as the
+// difference of two iid geometrics: if G1, G2 ~ Geom(1-q) on {0,1,...}
+// then P(G1 - G2 = k) = (1-q)/(1+q) * q^|k|.
+void sn_discrete_laplace(const int64_t* values, int64_t* out, int64_t n,
+                         double b) {
+  ensure_seeded();
+  const double log_q = -1.0 / b;
+  for (int64_t i = 0; i < n; i++) {
+    int64_t g1 = static_cast<int64_t>(
+        std::floor(std::log(g_rng.uniform01()) / log_q));
+    int64_t g2 = static_cast<int64_t>(
+        std::floor(std::log(g_rng.uniform01()) / log_q));
+    out[i] = values[i] + (g1 - g2);
+  }
+}
+
+}  // extern "C"

@@ -15,11 +15,15 @@ Design for TPU:
 
 Noise-generation caveat, documented as required by the build plan: the
 reference's C++ library uses snapping/discrete-geometric constructions that
-protect against floating-point attacks on the noise sample itself.  The
+protect against floating-point attacks on the noise sample itself. The
 on-device path uses ``jax.random`` (threefry counter-based PRNG), matching
-the reference's *statistical* behavior; the optional native host library
-(``pipelinedp_tpu.native``) provides a snapping Laplace mechanism for
-host-side release paths where that hardening matters.
+the reference's *statistical* behavior but NOT hardened against
+least-significant-bit attacks on individual released floats. For host-side
+releases where that hardening matters, ``set_secure_host_noise(True)``
+routes Laplace releases through the native library
+(``pipelinedp_tpu/native``: ChaCha20 CSPRNG + Mironov-2012 snapping
+mechanism, with an exact discrete-Laplace sampler for integer counts);
+it is compiled on demand with the host toolchain.
 """
 
 from __future__ import annotations
@@ -155,9 +159,17 @@ def np_gaussian(stddev: Union[float, np.ndarray],
 
 
 def seed_host_rng(seed: int) -> None:
-    """Reseeds the process-global host RNG (tests / reproducible runs)."""
+    """Reseeds the process-global host RNG (tests / reproducible runs).
+    Also re-keys the native CSPRNG if it is loaded, so secure-noise runs
+    are reproducible under the same call."""
     global _host_rng
     _host_rng = np.random.default_rng(seed)
+    try:
+        from pipelinedp_tpu import native
+        if native.is_loaded():
+            native.seed(seed)
+    except Exception:
+        pass
 
 
 def reseed_host_rng_from_entropy() -> None:
@@ -171,6 +183,37 @@ def reseed_host_rng_from_entropy() -> None:
     """
     global _host_rng
     _host_rng = np.random.default_rng(np.random.SeedSequence())
+    try:
+        from pipelinedp_tpu import native
+        # Only re-key when already loaded: available() would BUILD the
+        # library (a g++ subprocess) in every forked pool worker even
+        # with secure noise disabled.
+        if native.is_loaded():
+            native.seed_from_os()
+    except Exception:  # native library optional; NumPy path re-keyed above
+        pass
+
+
+_secure_host_noise = False
+
+
+def set_secure_host_noise(enabled: bool) -> None:
+    """Opt into the hardened host Laplace release path: the snapping
+    mechanism (Mironov 2012) from ``pipelinedp_tpu.native`` replaces
+    value + raw float noise in the host combiners. Raises if the native
+    library cannot be built on this host."""
+    global _secure_host_noise
+    if enabled:
+        from pipelinedp_tpu import native
+        if not native.available():
+            raise native.NativeUnavailableError(
+                "secure host noise requires the native library "
+                "(g++ toolchain)")
+    _secure_host_noise = enabled
+
+
+def secure_host_noise_enabled() -> bool:
+    return _secure_host_noise
 
 
 # ---------------------------------------------------------------------------

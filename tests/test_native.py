@@ -1,0 +1,144 @@
+"""Tests of the native secure-noise library (C++/ctypes): build, CSPRNG
+stream quality, snapping mechanism invariants (Mironov 2012), discrete
+Laplace exactness, and the opt-in wiring through the host noise path."""
+
+import math
+
+import numpy as np
+import pytest
+
+native = pytest.importorskip("pipelinedp_tpu.native")
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable")
+
+
+class TestCSPRNG:
+
+    def test_deterministic_under_seed(self):
+        native.seed(42)
+        a = native.uniform(1000)
+        native.seed(42)
+        b = native.uniform(1000)
+        np.testing.assert_array_equal(a, b)
+        native.seed(43)
+        c = native.uniform(1000)
+        assert not np.array_equal(a, c)
+
+    def test_uniform_range_and_moments(self):
+        native.seed(0)
+        u = native.uniform(200_000)
+        assert u.min() > 0.0 and u.max() <= 1.0
+        assert u.mean() == pytest.approx(0.5, abs=0.005)
+        assert u.var() == pytest.approx(1 / 12, rel=0.02)
+
+    def test_os_seeding_differs(self):
+        native.seed_from_os()
+        a = native.uniform(64)
+        native.seed_from_os()
+        b = native.uniform(64)
+        assert not np.array_equal(a, b)
+
+
+class TestSnappingLaplace:
+
+    def test_outputs_are_multiples_of_lambda(self):
+        native.seed(1)
+        scale = 3.0  # Lambda = 4
+        out = native.snapping_laplace(np.zeros(5000), scale)
+        lam = 4.0
+        np.testing.assert_allclose(out / lam, np.round(out / lam),
+                                   atol=1e-12)
+
+    def test_statistics_match_laplace(self):
+        native.seed(2)
+        scale = 2.0
+        out = native.snapping_laplace(np.full(200_000, 10.0), scale)
+        noise = out - 10.0
+        # Snapping adds <= Lambda/2 rounding, preserving the moments.
+        assert noise.mean() == pytest.approx(0.0, abs=0.05)
+        assert noise.std() == pytest.approx(scale * math.sqrt(2),
+                                            rel=0.02)
+
+    def test_clamping(self):
+        native.seed(3)
+        with pytest.warns(UserWarning, match="clamp bound"):
+            out = native.snapping_laplace(np.array([1e9, -1e9]), 1.0,
+                                          bound=100.0)
+        assert out[0] == 100.0 and out[1] == -100.0
+
+    def test_value_plus_noise_not_raw_float(self):
+        # The release must NOT equal value + ieee-laplace noise bit
+        # pattern: its mantissa below Lambda is zero.
+        native.seed(4)
+        out = native.snapping_laplace(np.full(100, math.pi), 1.0)
+        lam = 1.0
+        assert np.all(out == np.round(out / lam) * lam)
+
+
+class TestDiscreteLaplace:
+
+    def test_integer_noise_distribution(self):
+        native.seed(5)
+        b = 2.0
+        out = native.discrete_laplace(np.zeros(200_000, np.int64), b)
+        assert out.dtype == np.int64
+        q = math.exp(-1.0 / b)
+        # Two-sided geometric: Var = 2q/(1-q)^2.
+        assert out.mean() == pytest.approx(0.0, abs=0.05)
+        assert out.var() == pytest.approx(2 * q / (1 - q)**2, rel=0.03)
+        # P(0) = (1-q)/(1+q).
+        p0 = (out == 0).mean()
+        assert p0 == pytest.approx((1 - q) / (1 + q), abs=0.01)
+
+
+class TestHostPathWiring:
+
+    def test_secure_laplace_release_is_snapped(self):
+        import pipelinedp_tpu as pdp
+        from pipelinedp_tpu import dp_computations
+        from pipelinedp_tpu.ops import noise as noise_ops
+
+        params = dp_computations.ScalarNoiseParams(
+            eps=1.0, delta=0.0, min_value=0.0, max_value=1.0,
+            min_sum_per_partition=None, max_sum_per_partition=None,
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1,
+            noise_kind=pdp.NoiseKind.LAPLACE)
+        noise_ops.set_secure_host_noise(True)
+        try:
+            native.seed(6)
+            # Integer query (count): exact discrete Laplace — the release
+            # is an integer, not a float with noise bits.
+            out = dp_computations.compute_dp_count(1000, params)
+            assert out == int(out)
+            assert out == pytest.approx(1000, abs=30)
+            # Float query (sum): snapping mechanism — multiples of Lambda.
+            native.seed(7)
+            sums = dp_computations.compute_dp_sum(
+                np.full(50, 123.456), dp_computations.ScalarNoiseParams(
+                    eps=1.0, delta=0.0, min_value=0.0, max_value=200.0,
+                    min_sum_per_partition=None, max_sum_per_partition=None,
+                    max_partitions_contributed=1,
+                    max_contributions_per_partition=1,
+                    noise_kind=pdp.NoiseKind.LAPLACE))
+            lam = 256.0  # scale = 200 -> Lambda = 256
+            np.testing.assert_allclose(np.asarray(sums) / lam,
+                                       np.round(np.asarray(sums) / lam),
+                                       atol=1e-9)
+        finally:
+            noise_ops.set_secure_host_noise(False)
+
+    def test_clamp_warning_on_oversized_release(self):
+        with pytest.warns(UserWarning, match="clamp bound"):
+            native.snapping_laplace(np.array([1e20]), 1e-6)
+
+    def test_small_scale_keeps_large_release_range(self):
+        # scale 1e-6 must not shrink the clamp below realistic values.
+        native.seed(8)
+        out = native.snapping_laplace(np.array([2.0e8]), 1e-6)
+        assert out[0] == pytest.approx(2.0e8, rel=1e-6)
+
+    def test_disabled_by_default(self):
+        from pipelinedp_tpu.ops import noise as noise_ops
+        assert not noise_ops.secure_host_noise_enabled()
