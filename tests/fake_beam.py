@@ -1,0 +1,325 @@
+"""A lazy, list-backed structural stand-in for the slice of the
+apache_beam API that ``pipelinedp_tpu.beam_backend`` and
+``pipelinedp_tpu.private_beam`` consume.
+
+Purpose: apache_beam is not installable in every environment, but the
+adapter code paths (stage-label uniqueness, closure semantics, the
+CoGroupByKey join regime, the fluent transforms) deserve execution, not
+just parsing. Registering this module as ``sys.modules['apache_beam']``
+before importing the adapters runs them for real against deferred
+collections. Like Beam, execution is deferred: transforms compose thunks
+and nothing runs until a collection is materialized — which is what lets
+the two-phase budget protocol (compute_budgets after graph construction)
+work unchanged.
+
+This is a test double, not a Beam reimplementation: only the operations
+the adapters use exist, and scheduling/windowing/distribution are out of
+scope.
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+import random as _random
+import sys
+import types
+
+
+class Pipeline:
+
+    def __init__(self):
+        self._labels = set()
+
+    def check_label(self, label):
+        if label is None:
+            return
+        if label in self._labels:
+            raise RuntimeError(
+                f"A transform with label {label!r} already exists in the "
+                "pipeline (beam requires unique stage names)")
+        self._labels.add(label)
+
+    def apply(self, transform, pvalue):
+        return transform.expand(pvalue)
+
+    def __or__(self, rhs):
+        return _apply(self, rhs)
+
+
+class PCollection:
+
+    def __init__(self, pipeline, thunk):
+        self.pipeline = pipeline
+        self._thunk = thunk
+        self._cache = None
+
+    def materialize(self):
+        if self._cache is None:
+            self._cache = list(self._thunk())
+        return self._cache
+
+    def __iter__(self):
+        return iter(self.materialize())
+
+    def __or__(self, rhs):
+        return _apply(self, rhs)
+
+
+def _pipeline_of(pvalue):
+    if isinstance(pvalue, Pipeline):
+        return pvalue
+    if isinstance(pvalue, PCollection):
+        return pvalue.pipeline
+    if isinstance(pvalue, (tuple, list)):
+        return _pipeline_of(pvalue[0])
+    if isinstance(pvalue, dict):
+        return _pipeline_of(next(iter(pvalue.values())))
+    raise TypeError(f"no pipeline on {pvalue!r}")
+
+
+def _apply(pvalue, transform):
+    if not isinstance(transform, PTransform):
+        raise TypeError(f"cannot apply {transform!r}")
+    _pipeline_of(pvalue).check_label(transform.label)
+    return transform.expand(pvalue)
+
+
+class PTransform:
+    label = None
+
+    def __init__(self, label=None):
+        # Real beam's PTransform accepts an optional label.
+        if label is not None:
+            self.label = label
+
+    def __rrshift__(self, label):
+        # "stage name" >> transform
+        self.label = label
+        return self
+
+    def __ror__(self, pvalue):
+        # tuple-of-pcollections | Flatten(), dict | CoGroupByKey()
+        return _apply(pvalue, self)
+
+    def expand(self, pvalue):
+        raise NotImplementedError
+
+    # -- helpers for subclasses --
+    @staticmethod
+    def _derive(pvalue, fn):
+        return PCollection(_pipeline_of(pvalue), fn)
+
+
+class Create(PTransform):
+
+    def __init__(self, iterable):
+        self._data = iterable
+
+    def expand(self, pipeline):
+        data = self._data
+        return PCollection(pipeline, lambda: list(data))
+
+
+class Map(PTransform):
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def expand(self, col):
+        fn = self._fn
+        return self._derive(col, lambda: [fn(x) for x in col.materialize()])
+
+
+class MapTuple(PTransform):
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def expand(self, col):
+        fn = self._fn
+        return self._derive(col,
+                            lambda: [fn(*x) for x in col.materialize()])
+
+
+class FlatMap(PTransform):
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def expand(self, col):
+        fn = self._fn
+        return self._derive(
+            col,
+            lambda: list(itertools.chain.from_iterable(
+                fn(x) for x in col.materialize())))
+
+
+class Filter(PTransform):
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def expand(self, col):
+        fn = self._fn
+        return self._derive(col,
+                            lambda: [x for x in col.materialize() if fn(x)])
+
+
+def _group(pairs):
+    out = {}
+    for k, v in pairs:
+        out.setdefault(k, []).append(v)
+    return out
+
+
+class GroupByKey(PTransform):
+
+    def expand(self, col):
+        return self._derive(
+            col, lambda: list(_group(col.materialize()).items()))
+
+
+class CombinePerKey(PTransform):
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def expand(self, col):
+        fn = self._fn
+        return self._derive(
+            col, lambda: [(k, fn(vs))
+                          for k, vs in _group(col.materialize()).items()])
+
+
+class Keys(PTransform):
+
+    def expand(self, col):
+        return self._derive(col,
+                            lambda: [k for k, _ in col.materialize()])
+
+
+class Values(PTransform):
+
+    def expand(self, col):
+        return self._derive(col,
+                            lambda: [v for _, v in col.materialize()])
+
+
+class Distinct(PTransform):
+
+    def expand(self, col):
+        def thunk():
+            seen, out = set(), []
+            for x in col.materialize():
+                if x not in seen:
+                    seen.add(x)
+                    out.append(x)
+            return out
+        return self._derive(col, thunk)
+
+
+class Flatten(PTransform):
+
+    def expand(self, cols):
+        return PCollection(
+            _pipeline_of(cols),
+            lambda: list(itertools.chain.from_iterable(
+                c.materialize() for c in cols)))
+
+
+class DoFn:
+
+    def process(self, element):
+        raise NotImplementedError
+
+
+class ParDo(PTransform):
+
+    def __init__(self, dofn):
+        self._dofn = dofn
+
+    def expand(self, col):
+        dofn = self._dofn
+        return self._derive(
+            col,
+            lambda: list(itertools.chain.from_iterable(
+                dofn.process(x) for x in col.materialize())))
+
+
+class CoGroupByKey(PTransform):
+
+    def expand(self, tagged):
+        def thunk():
+            grouped = {}
+            for tag, col in tagged.items():
+                for k, v in col.materialize():
+                    grouped.setdefault(k, {t: [] for t in tagged})[
+                        tag].append(v)
+            return list(grouped.items())
+        return PCollection(_pipeline_of(tagged), thunk)
+
+
+class _SampleFixedSizePerKey(PTransform):
+
+    def __init__(self, n):
+        self._n = n
+
+    def expand(self, col):
+        n = self._n
+        return self._derive(
+            col, lambda: [(k, _random.sample(vs, min(n, len(vs))))
+                          for k, vs in _group(col.materialize()).items()])
+
+
+class _CountPerElement(PTransform):
+
+    def expand(self, col):
+        def thunk():
+            out = {}
+            for x in col.materialize():
+                out[x] = out.get(x, 0) + 1
+            return list(out.items())
+        return self._derive(col, thunk)
+
+
+class _ToList(PTransform):
+
+    def expand(self, col):
+        return self._derive(col, lambda: [col.materialize()])
+
+
+def build_fake_beam_module() -> types.ModuleType:
+    """An ``apache_beam``-shaped module object for sys.modules."""
+    mod = types.ModuleType("apache_beam")
+    for name, obj in (("Pipeline", Pipeline), ("PCollection", PCollection),
+                      ("PTransform", PTransform), ("Create", Create),
+                      ("Map", Map), ("MapTuple", MapTuple),
+                      ("FlatMap", FlatMap), ("Filter", Filter),
+                      ("GroupByKey", GroupByKey),
+                      ("CombinePerKey", CombinePerKey), ("Keys", Keys),
+                      ("Values", Values), ("Distinct", Distinct),
+                      ("Flatten", Flatten), ("DoFn", DoFn),
+                      ("ParDo", ParDo), ("CoGroupByKey", CoGroupByKey)):
+        setattr(mod, name, obj)
+
+    combiners = types.ModuleType("apache_beam.combiners")
+    sample = types.SimpleNamespace(FixedSizePerKey=_SampleFixedSizePerKey)
+    combiners.Sample = sample
+    combiners.Count = types.SimpleNamespace(
+        PerElement=_CountPerElement)
+    combiners.ToList = _ToList
+    mod.combiners = combiners
+
+    transforms = types.ModuleType("apache_beam.transforms")
+    ptransform = types.ModuleType("apache_beam.transforms.ptransform")
+    ptransform.PTransform = PTransform
+    transforms.ptransform = ptransform
+    mod.transforms = transforms
+
+    # Submodule registration so "from apache_beam.transforms import
+    # ptransform" resolves.
+    sys.modules.setdefault("apache_beam.combiners", combiners)
+    sys.modules.setdefault("apache_beam.transforms", transforms)
+    sys.modules.setdefault("apache_beam.transforms.ptransform", ptransform)
+    return mod

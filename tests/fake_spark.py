@@ -1,0 +1,109 @@
+"""A lazy structural stand-in for the RDD API slice that
+``SparkRDDBackend`` and ``private_spark`` consume (map, flatMap,
+mapValues, flatMapValues, groupByKey, reduceByKey, filter, join, union,
+keys, values, distinct, collect). Same rationale as ``fake_beam``:
+execute the adapter code where pyspark is not installable. Laziness via
+composed thunks preserves the two-phase budget protocol."""
+
+from __future__ import annotations
+
+import itertools
+
+
+class FakeRDD:
+
+    def __init__(self, thunk, context=None):
+        self._thunk = thunk
+        self._cache = None
+        #: mirrors pyspark's RDD.context (used by private_spark)
+        self.context = context
+
+    # -- materialization --
+    def collect(self):
+        if self._cache is None:
+            self._cache = list(self._thunk())
+        return self._cache
+
+    def __iter__(self):
+        return iter(self.collect())
+
+    # -- transformations (all lazy) --
+    def map(self, fn):
+        return FakeRDD(lambda: [fn(x) for x in self.collect()],
+                       self.context)
+
+    def flatMap(self, fn):
+        return FakeRDD(lambda: list(
+            itertools.chain.from_iterable(fn(x) for x in self.collect())),
+                       self.context)
+
+    def mapValues(self, fn):
+        return FakeRDD(lambda: [(k, fn(v)) for k, v in self.collect()],
+                       self.context)
+
+    def flatMapValues(self, fn):
+        return FakeRDD(lambda: [(k, v2) for k, v in self.collect()
+                                for v2 in fn(v)], self.context)
+
+    def filter(self, fn):
+        return FakeRDD(lambda: [x for x in self.collect() if fn(x)],
+                       self.context)
+
+    def _grouped(self):
+        out = {}
+        for k, v in self.collect():
+            out.setdefault(k, []).append(v)
+        return out
+
+    def groupByKey(self):
+        return FakeRDD(lambda: list(self._grouped().items()),
+                       self.context)
+
+    def reduceByKey(self, fn):
+        def thunk():
+            out = {}
+            for k, v in self.collect():
+                out[k] = fn(out[k], v) if k in out else v
+            return list(out.items())
+        return FakeRDD(thunk, self.context)
+
+    def join(self, other):
+        def thunk():
+            right = other._grouped()
+            return [(k, (v, w)) for k, v in self.collect()
+                    for w in right.get(k, [])]
+        return FakeRDD(thunk, self.context)
+
+    def union(self, other):
+        return FakeRDD(lambda: self.collect() + other.collect(),
+                       self.context)
+
+    def keys(self):
+        return FakeRDD(lambda: [k for k, _ in self.collect()],
+                       self.context)
+
+    def values(self):
+        return FakeRDD(lambda: [v for _, v in self.collect()],
+                       self.context)
+
+    def distinct(self):
+        def thunk():
+            seen, out = set(), []
+            for x in self.collect():
+                if x not in seen:
+                    seen.add(x)
+                    out.append(x)
+            return out
+        return FakeRDD(thunk, self.context)
+
+
+class FakeSparkContext:
+
+    def parallelize(self, data):
+        data = list(data)
+        return FakeRDD(lambda: list(data), self)
+
+    def union(self, rdds):
+        return FakeRDD(lambda: list(
+            itertools.chain.from_iterable(r.collect() for r in rdds)),
+                       self)

@@ -1,0 +1,438 @@
+"""Exercises the Beam and Spark adapters (``beam_backend.py``,
+``SparkRDDBackend``, ``private_beam``, ``private_spark``).
+
+apache_beam / pyspark are not installable in every environment, so the
+adapters run against lazy structural fakes (``fake_beam`` /
+``fake_spark``) — the adapter code, its closures, stage-label
+bookkeeping and the engine graph over it all execute for real. When the
+real libraries ARE importable, ``TestRealBeam`` / ``TestRealSpark``
+additionally run an op-conformance subset and an E2E flow on the
+genuine runners (they skip here).
+
+The fake beam module is registered in ``sys.modules`` only for the
+duration of the adapter imports below, then removed: the rest of the
+test session sees the unmodified beam-optional behavior (``import
+apache_beam`` raising, ``pipeline_backend`` without a ``BeamBackend``
+attribute). The already-imported adapter modules keep their references.
+"""
+
+import operator
+import sys
+
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu.ops import noise as noise_ops
+from pipelinedp_tpu import pipeline_backend as _pb
+
+try:
+    import apache_beam as beam
+    HAVE_BEAM = True
+except ImportError:
+    HAVE_BEAM = False
+
+try:
+    import pyspark as _real_pyspark  # noqa: F401
+    HAVE_SPARK = True
+except ImportError:
+    HAVE_SPARK = False
+
+if not HAVE_BEAM:
+    from tests import fake_beam as _fake_beam_mod
+    beam = _fake_beam_mod.build_fake_beam_module()
+    _added = {"apache_beam": beam}
+    for name in ("apache_beam.combiners", "apache_beam.transforms",
+                 "apache_beam.transforms.ptransform"):
+        _added[name] = sys.modules[name]  # registered by the builder
+    _had_bb = hasattr(_pb, "BeamBackend")
+    try:
+        sys.modules["apache_beam"] = beam
+        from pipelinedp_tpu.beam_backend import BeamBackend
+        _pb.BeamBackend = BeamBackend  # as if beam existed at start
+        from pipelinedp_tpu import private_beam
+    finally:
+        for name in _added:
+            sys.modules.pop(name, None)
+        if not _had_bb:
+            del _pb.BeamBackend
+else:
+    from pipelinedp_tpu.beam_backend import BeamBackend
+    from pipelinedp_tpu import private_beam
+
+from pipelinedp_tpu.pipeline_backend import SparkRDDBackend
+from pipelinedp_tpu import private_spark
+from tests.fake_spark import FakeSparkContext
+
+BIG_EPS = 1e5
+
+
+# ---------------------------------------------------------------------------
+# Harnesses: wrap list -> native collection, collect -> list
+# ---------------------------------------------------------------------------
+
+
+class BeamHarness:
+    name = "beam"
+
+    def __init__(self):
+        self.backend = BeamBackend()
+        self.pipeline = beam.Pipeline()
+
+    def col(self, data):
+        return self.pipeline | f"create{id(data)}" >> beam.Create(data)
+
+    def collect(self, col):
+        return list(col)
+
+
+class SparkHarness:
+    name = "spark"
+
+    def __init__(self):
+        self.sc = FakeSparkContext()
+        self.backend = SparkRDDBackend(self.sc)
+
+    def col(self, data):
+        return self.sc.parallelize(data)
+
+    def collect(self, col):
+        return list(col.collect())
+
+
+@pytest.fixture(params=["beam", "spark"])
+def h(request):
+    if request.param == "beam" and HAVE_BEAM:
+        pytest.skip("real beam installed: fake-backed harness not used")
+    if request.param == "spark" and HAVE_SPARK:
+        pytest.skip("real pyspark installed: fake harness not used")
+    return BeamHarness() if request.param == "beam" else SparkHarness()
+
+
+class _SumCombiner:
+
+    def merge_accumulators(self, a, b):
+        return a + b
+
+
+class TestClusterBackendConformance:
+    """The op matrix of tests/test_pipeline_backend.py, on the adapters."""
+
+    def test_map(self, h):
+        got = h.collect(h.backend.map(h.col([1, 2, 3]), lambda x: 2 * x,
+                                      "map"))
+        assert sorted(got) == [2, 4, 6]
+
+    def test_flat_map(self, h):
+        got = h.collect(h.backend.flat_map(h.col([1, 2]),
+                                           lambda x: [x, x], "fm"))
+        assert sorted(got) == [1, 1, 2, 2]
+
+    def test_map_tuple(self, h):
+        got = h.collect(h.backend.map_tuple(h.col([(1, "a"), (2, "b")]),
+                                            lambda k, v: (v, k), "mt"))
+        assert sorted(got) == [("a", 1), ("b", 2)]
+
+    def test_map_values(self, h):
+        got = h.collect(h.backend.map_values(h.col([(1, 2), (2, 3)]),
+                                             lambda v: 2 * v, "mv"))
+        assert sorted(got) == [(1, 4), (2, 6)]
+
+    def test_group_by_key(self, h):
+        got = dict(h.collect(h.backend.group_by_key(
+            h.col([(1, "a"), (2, "b"), (1, "c")]), "gbk")))
+        assert sorted(got[1]) == ["a", "c"]
+        assert list(got[2]) == ["b"]
+
+    def test_filter(self, h):
+        got = h.collect(h.backend.filter(h.col([1, 2, 3, 4]),
+                                         lambda x: x % 2 == 0, "f"))
+        assert sorted(got) == [2, 4]
+
+    def test_filter_by_key_in_memory(self, h):
+        got = h.collect(h.backend.filter_by_key(
+            h.col([(1, "a"), (2, "b"), (3, "c")]), [1, 3], "fbk"))
+        assert sorted(got) == [(1, "a"), (3, "c")]
+
+    def test_filter_by_key_distributed(self, h):
+        keys = h.col([1, 3])
+        got = h.collect(h.backend.filter_by_key(
+            h.col([(1, "a"), (2, "b"), (3, "c")]), keys, "fbk2"))
+        assert sorted(got) == [(1, "a"), (3, "c")]
+
+    def test_keys_values(self, h):
+        col = h.col([(1, "a"), (2, "b")])
+        assert sorted(h.collect(h.backend.keys(col, "k"))) == [1, 2]
+        col2 = h.col([(1, "a"), (2, "b")])
+        assert sorted(h.collect(h.backend.values(col2, "v"))) == ["a", "b"]
+
+    def test_sample_fixed_per_key(self, h):
+        data = [(1, i) for i in range(10)] + [(2, 99)]
+        got = dict(h.collect(h.backend.sample_fixed_per_key(
+            h.col(data), 3, "sample")))
+        assert len(got[1]) == 3
+        assert set(got[1]) <= set(range(10))
+        assert list(got[2]) == [99]
+
+    def test_count_per_element(self, h):
+        got = dict(h.collect(h.backend.count_per_element(
+            h.col(["a", "b", "a"]), "cpe")))
+        assert got == {"a": 2, "b": 1}
+
+    def test_sum_per_key(self, h):
+        got = dict(h.collect(h.backend.sum_per_key(
+            h.col([(1, 2), (1, 3), (2, 5)]), "spk")))
+        assert got == {1: 5, 2: 5}
+
+    def test_combine_accumulators_per_key(self, h):
+        got = dict(h.collect(h.backend.combine_accumulators_per_key(
+            h.col([(1, 2), (1, 3), (2, 5)]), _SumCombiner(), "capk")))
+        assert got == {1: 5, 2: 5}
+
+    def test_reduce_per_key(self, h):
+        got = dict(h.collect(h.backend.reduce_per_key(
+            h.col([(1, 2), (1, 3)]), operator.add, "rpk")))
+        assert got == {1: 5}
+
+    def test_flatten(self, h):
+        got = h.collect(h.backend.flatten(
+            (h.col([1, 2]), h.col([3])), "flat"))
+        assert sorted(got) == [1, 2, 3]
+
+    def test_distinct(self, h):
+        got = h.collect(h.backend.distinct(h.col([1, 2, 2, 3, 1]), "d"))
+        assert sorted(got) == [1, 2, 3]
+
+    def test_to_list(self, h):
+        if h.name == "spark":
+            # Reference parity: Spark leaves to_list unimplemented
+            # (reference pipeline_backend.py:454-455).
+            with pytest.raises(NotImplementedError):
+                h.backend.to_list(h.col([1, 2, 3]), "tl")
+            return
+        got = h.collect(h.backend.to_list(h.col([1, 2, 3]), "tl"))
+        assert sorted(got[0]) == [1, 2, 3]
+
+
+class TestBeamStageLabels:
+
+    @pytest.mark.skipif(HAVE_BEAM, reason="fake-specific label check")
+    def test_repeated_stage_names_stay_unique(self):
+        hn = BeamHarness()
+        col = hn.col([1, 2, 3])
+        # Same stage name twice: the UniqueLabelsGenerator must suffix
+        # them apart or the (fake = real beam semantics) pipeline raises.
+        a = hn.backend.map(col, lambda x: x + 1, "stage")
+        b = hn.backend.map(a, lambda x: x + 1, "stage")
+        assert sorted(hn.collect(b)) == [3, 4, 5]
+
+
+class TestEngineOnClusterBackends:
+    """Full DPEngine aggregation through each adapter (huge eps: results
+    pin to the exact aggregates)."""
+
+    def _run_engine(self, h, public=None):
+        data = [(u, p, 1.0) for u in range(30) for p in ("x", "y")]
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            max_partitions_contributed=2,
+            max_contributions_per_partition=1,
+            min_value=0.0, max_value=1.0)
+        ex = pdp.DataExtractors(
+            privacy_id_extractor=operator.itemgetter(0),
+            partition_extractor=operator.itemgetter(1),
+            value_extractor=operator.itemgetter(2))
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=BIG_EPS,
+                                        total_delta=1e-2)
+        engine = pdp.DPEngine(acc, h.backend)
+        result = engine.aggregate(h.col(data), params, ex,
+                                  public_partitions=public)
+        acc.compute_budgets()
+        return dict(h.collect(result))
+
+    def test_private_partitions(self, h):
+        noise_ops.seed_host_rng(0)
+        out = self._run_engine(h)
+        assert sorted(out) == ["x", "y"]
+        for v in out.values():
+            assert v.count == pytest.approx(30, abs=0.5)
+            assert v.sum == pytest.approx(30, abs=0.5)
+
+    def test_public_partitions(self, h):
+        noise_ops.seed_host_rng(0)
+        out = self._run_engine(h, public=["x", "z"])
+        assert sorted(out) == ["x", "z"]
+        assert out["x"].count == pytest.approx(30, abs=0.5)
+        assert out["z"].count == pytest.approx(0, abs=0.5)
+
+    def test_select_partitions(self, h):
+        noise_ops.seed_host_rng(0)
+        data = [(u, "big") for u in range(1000)] + [(1, "small")]
+        ex = pdp.DataExtractors(
+            privacy_id_extractor=operator.itemgetter(0),
+            partition_extractor=operator.itemgetter(1))
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                        total_delta=1e-6)
+        engine = pdp.DPEngine(acc, h.backend)
+        result = engine.select_partitions(
+            h.col(data), pdp.SelectPartitionsParams(
+                max_partitions_contributed=2), ex)
+        acc.compute_budgets()
+        got = h.collect(result)
+        assert "big" in got and "small" not in got
+
+
+@pytest.mark.skipif(HAVE_BEAM, reason="fluent fake-beam flow")
+class TestPrivateBeamOnFake:
+
+    def test_count_flow(self):
+        noise_ops.seed_host_rng(0)
+        p = beam.Pipeline()
+        data = ([(u, "a") for u in range(40)] +
+                [(u, "b") for u in range(100, 125)])
+        pcol = p | "create" >> beam.Create(data)
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=BIG_EPS,
+                                        total_delta=1e-2)
+        private = pcol | private_beam.MakePrivate(
+            budget_accountant=acc,
+            privacy_id_extractor=operator.itemgetter(0))
+        counts = private | private_beam.Count(
+            pdp.CountParams(max_partitions_contributed=1,
+                            max_contributions_per_partition=1,
+                            partition_extractor=operator.itemgetter(1)))
+        acc.compute_budgets()
+        got = dict(counts)
+        assert got["a"] == pytest.approx(40, abs=0.5)
+        assert got["b"] == pytest.approx(25, abs=0.5)
+
+    def test_map_then_sum(self):
+        noise_ops.seed_host_rng(0)
+        p = beam.Pipeline()
+        data = [(u, "a", 2.0) for u in range(30)]
+        pcol = p | "create" >> beam.Create(data)
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=BIG_EPS,
+                                        total_delta=1e-2)
+        private = pcol | private_beam.MakePrivate(
+            budget_accountant=acc,
+            privacy_id_extractor=operator.itemgetter(0))
+        doubled = private | private_beam.Map(
+            lambda row: (row[1], row[2] * 2))
+        sums = doubled | private_beam.Sum(
+            pdp.SumParams(max_partitions_contributed=1,
+                          max_contributions_per_partition=1,
+                          min_value=0.0, max_value=10.0,
+                          partition_extractor=operator.itemgetter(0),
+                          value_extractor=operator.itemgetter(1)))
+        acc.compute_budgets()
+        got = dict(sums)
+        assert got["a"] == pytest.approx(120, abs=1.0)
+
+
+@pytest.mark.skipif(HAVE_SPARK, reason="fluent fake-spark flow")
+class TestPrivateSparkOnFake:
+
+    def test_count_and_privacy_id_count(self):
+        noise_ops.seed_host_rng(0)
+        sc = FakeSparkContext()
+        data = [(u, "a") for u in range(40)] + [(0, "a"), (0, "a")]
+        rdd = sc.parallelize(data)
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=BIG_EPS,
+                                        total_delta=1e-2)
+        prdd = private_spark.make_private(
+            rdd, acc, privacy_id_extractor=operator.itemgetter(0))
+        counts = prdd.count(pdp.CountParams(
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1,
+            partition_extractor=operator.itemgetter(1)))
+        pid_counts = prdd.privacy_id_count(pdp.PrivacyIdCountParams(
+            max_partitions_contributed=1,
+            partition_extractor=operator.itemgetter(1)))
+        acc.compute_budgets()
+        assert dict(counts.collect())["a"] == pytest.approx(40, abs=0.5)
+        assert dict(pid_counts.collect())["a"] == pytest.approx(40,
+                                                               abs=0.5)
+
+
+# ---------------------------------------------------------------------------
+# Real-library E2E (skip unless installed)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(not HAVE_BEAM, reason="apache_beam not installed")
+class TestRealBeam:
+    """Runs on the genuine Beam runner where apache_beam is installed:
+    an op-conformance subset (the shuffle-heavy ops whose behavior
+    depends on the real runner) plus a fluent E2E flow."""
+
+    def test_op_conformance_subset(self):
+        from apache_beam.testing.test_pipeline import TestPipeline
+        from apache_beam.testing.util import assert_that, equal_to
+        backend = BeamBackend()
+        with TestPipeline() as p:
+            col = p | "in" >> beam.Create([(1, "a"), (2, "b"), (1, "c")])
+            mapped = backend.map_values(col, str.upper, "mv")
+            assert_that(mapped, equal_to([(1, "A"), (2, "B"), (1, "C")]),
+                        label="check_mv")
+            grouped = backend.group_by_key(
+                p | "in2" >> beam.Create([(1, "a"), (1, "b")]), "gbk")
+            assert_that(grouped | "norm" >> beam.MapTuple(
+                lambda k, v: (k, sorted(v))), equal_to([(1, ["a", "b"])]),
+                        label="check_gbk")
+            combined = backend.combine_accumulators_per_key(
+                p | "in3" >> beam.Create([(1, 2), (1, 3), (2, 5)]),
+                _SumCombiner(), "capk")
+            assert_that(combined, equal_to([(1, 5), (2, 5)]),
+                        label="check_capk")
+            # The distributed filter_by_key regime (CoGroupByKey join).
+            keys_col = p | "keys" >> beam.Create([1])
+            filtered = backend.filter_by_key(
+                p | "in4" >> beam.Create([(1, "x"), (2, "y")]), keys_col,
+                "fbk")
+            assert_that(filtered, equal_to([(1, "x")]), label="check_fbk")
+            sampled = backend.sample_fixed_per_key(
+                p | "in5" >> beam.Create([(1, i) for i in range(10)]), 3,
+                "sample")
+            assert_that(sampled | "count" >> beam.MapTuple(
+                lambda k, v: (k, len(v))), equal_to([(1, 3)]),
+                        label="check_sample")
+
+    def test_count_on_test_pipeline(self):
+        from apache_beam.testing.test_pipeline import TestPipeline
+        from apache_beam.testing.util import assert_that, equal_to
+        noise_ops.seed_host_rng(0)
+        with TestPipeline() as p:
+            data = [(u, "a") for u in range(40)]
+            pcol = p | beam.Create(data)
+            acc = pdp.NaiveBudgetAccountant(total_epsilon=BIG_EPS,
+                                            total_delta=1e-2)
+            private = pcol | private_beam.MakePrivate(
+                budget_accountant=acc,
+                privacy_id_extractor=operator.itemgetter(0))
+            counts = private | private_beam.Count(
+                pdp.CountParams(max_partitions_contributed=1,
+                                max_contributions_per_partition=1,
+                                partition_extractor=operator.itemgetter(1)))
+            acc.compute_budgets()
+            assert_that(counts | beam.Keys(), equal_to(["a"]))
+
+
+@pytest.mark.skipif(not HAVE_SPARK, reason="pyspark not installed")
+class TestRealSpark:
+
+    def test_count_on_local_master(self):
+        import pyspark
+        noise_ops.seed_host_rng(0)
+        conf = pyspark.SparkConf().setMaster("local[1]")
+        with pyspark.SparkContext.getOrCreate(conf=conf) as sc:
+            data = [(u, "a") for u in range(40)]
+            acc = pdp.NaiveBudgetAccountant(total_epsilon=BIG_EPS,
+                                            total_delta=1e-2)
+            prdd = private_spark.make_private(
+                sc.parallelize(data), acc,
+                privacy_id_extractor=operator.itemgetter(0))
+            counts = prdd.count(pdp.CountParams(
+                max_partitions_contributed=1,
+                max_contributions_per_partition=1,
+                partition_extractor=operator.itemgetter(1)))
+            acc.compute_budgets()
+            assert dict(counts.collect())["a"] == pytest.approx(40,
+                                                                abs=0.5)
