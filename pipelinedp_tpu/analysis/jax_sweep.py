@@ -458,15 +458,13 @@ def _metric_chunk(metric_name, x_u, marker, pk_safe, p_u, bounds_lo,
     }
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("metric_names", "strategy", "noise_kind", "P",
-                     "public"))
-def _sweep_chunk_kernel(metric_names, strategy, noise_kind, P, public,
-                        marker, pk_safe, count_u, sum_u, npart_u, users_pk,
-                        l0, linf, min_sum, max_sum, noise_std_rows, table,
-                        thr, scale, log_rs, t_table):
-    """One compiled program: stages B+C for one chunk of configurations."""
+def _sweep_chunk_body(metric_names, strategy, noise_kind, P, public,
+                      marker, pk_safe, count_u, sum_u, npart_u, users_pk,
+                      l0, linf, min_sum, max_sum, noise_std_rows, table,
+                      thr, scale, log_rs, t_table):
+    """Stages B+C for one chunk of configurations (pure function; jitted
+    directly for one device, or shard_mapped over the mesh with the
+    configuration axis sharded and rows replicated)."""
     markerf = marker.astype(jnp.float32)
     p_u = jnp.where(npart_u[:, None] > 0,
                     jnp.minimum(1.0, l0[None, :] /
@@ -518,6 +516,51 @@ def _sweep_chunk_kernel(metric_names, strategy, noise_kind, P, public,
     return out, sel_stats
 
 
+_sweep_chunk_kernel = functools.partial(
+    jax.jit,
+    static_argnames=("metric_names", "strategy", "noise_kind", "P",
+                     "public"))(_sweep_chunk_body)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("metric_names", "strategy", "noise_kind", "P",
+                     "public", "mesh"))
+def _sweep_chunk_sharded(metric_names, strategy, noise_kind, P, public,
+                         mesh, marker, pk_safe, count_u, sum_u, npart_u,
+                         users_pk, l0, linf, min_sum, max_sum,
+                         noise_std_rows, table, thr, scale, log_rs,
+                         t_table):
+    """The chunk body over a device mesh: rows replicated, the
+    configuration axis sharded — each device analyzes its slice of the
+    parameter grid independently (no collectives needed; outputs come
+    back sharded along the config axis)."""
+    from jax.sharding import PartitionSpec as PSpec
+
+    from pipelinedp_tpu.parallel.sharded import _CHECK_KW, shard_map
+
+    axis = mesh.axis_names[0]
+    shard = PSpec(axis)
+    repl = PSpec()
+    check_kw = _CHECK_KW
+
+    def body(*args):
+        return _sweep_chunk_body(metric_names, strategy, noise_kind, P,
+                                 public, *args)
+
+    mapped = shard_map(
+        body, mesh=mesh,
+        in_specs=(repl, repl, repl, repl, repl, repl,  # row/pk arrays
+                  shard, shard, shard, shard,          # l0/linf/min/max
+                  PSpec(None, axis),                   # noise rows [M, C]
+                  shard, shard, shard,                 # table/thr/scale
+                  repl, repl),                         # quantile tables
+        out_specs=shard, **{check_kw: False})
+    return mapped(marker, pk_safe, count_u, sum_u, npart_u, users_pk, l0,
+                  linf, min_sum, max_sum, noise_std_rows, table, thr,
+                  scale, log_rs, t_table)
+
+
 # ---------------------------------------------------------------------------
 # Driver
 # ---------------------------------------------------------------------------
@@ -534,13 +577,14 @@ class LazySweepResult:
     sweep on first iteration — after ``compute_budgets()``."""
 
     def __init__(self, col, options, data_extractors, public_partitions,
-                 budgets, selection_budget):
+                 budgets, selection_budget, mesh=None):
         self._col = col
         self._options = options
         self._extractors = data_extractors
         self._public = public_partitions
         self._budgets = budgets
         self._selection_budget = selection_budget
+        self._mesh = mesh
         self._cache = None
 
     def __iter__(self):
@@ -591,13 +635,29 @@ class LazySweepResult:
 
         # Config chunking: bound both the [n, Cc] broadcast and the
         # [P, Cc, 2·WINDOW+1] selection-window footprints.
+        n_dev = self._mesh.devices.size if self._mesh is not None else 1
         chunk = int(np.clip(
             min((1 << 26) // max(n_pad, 1),
                 (1 << 28) // max(P_pad * (2 * _WINDOW + 1), 1),
                 _pad_pow2(C, minimum=1)),  # don't pad tiny sweeps up
             1, _CHUNK_CAP))
+        if n_dev > 1:
+            # Sharded over the mesh: every device takes an equal slice of
+            # the chunk's configuration axis.
+            chunk = max(chunk // n_dev, 1) * n_dev
         users_in = jnp.where(real_pk, users_pk, -1)
         dlog_rs, dt_table = jax.device_put((log_rs, t_table))
+        if self._mesh is not None and n_dev > 1:
+            # Place the replicated row arrays on the mesh ONCE: left
+            # committed to a single device they would re-broadcast to
+            # every device on each chunk iteration.
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as PSpec
+            repl_sharding = NamedSharding(self._mesh, PSpec())
+            (marker, pk_safe, count_u, sum_u, npart_u, users_in, dlog_rs,
+             dt_table) = jax.device_put(
+                 (marker, pk_safe, count_u, sum_u, npart_u, users_in,
+                  dlog_rs, dt_table), repl_sharding)
         fields: Dict[str, Dict[str, List[np.ndarray]]] = {
             nm: {} for nm in metric_names}
         sel_fields: Dict[str, List[np.ndarray]] = {}
@@ -618,10 +678,16 @@ class LazySweepResult:
                  np.stack([cv(r) for r in noise_rows])
                  if len(noise_rows) else np.zeros((0, chunk), np.float32),
                  cv(table), cv(thr), cv(scale)))
-            out, sel = _sweep_chunk_kernel(
-                metric_names, strategy, params.noise_kind, P_pad, public,
-                marker, pk_safe, count_u, sum_u, npart_u, users_in,
-                *chunk_in, dlog_rs, dt_table)
+            if self._mesh is not None and n_dev > 1:
+                out, sel = _sweep_chunk_sharded(
+                    metric_names, strategy, params.noise_kind, P_pad,
+                    public, self._mesh, marker, pk_safe, count_u, sum_u,
+                    npart_u, users_in, *chunk_in, dlog_rs, dt_table)
+            else:
+                out, sel = _sweep_chunk_kernel(
+                    metric_names, strategy, params.noise_kind, P_pad,
+                    public, marker, pk_safe, count_u, sum_u, npart_u,
+                    users_in, *chunk_in, dlog_rs, dt_table)
             # The tunneled host link pays per round trip: flatten every
             # output field into ONE d2h transfer and split on host.
             leaves, treedef = jax.tree.flatten((out, sel))
@@ -716,7 +782,7 @@ class LazySweepResult:
 
 
 def build_fused_sweep(col, options, data_extractors, public_partitions,
-                      budget_accountant) -> LazySweepResult:
+                      budget_accountant, mesh=None) -> LazySweepResult:
     """Requests the same budgets the host analysis engine would
     (``utility_analysis_engine.py:61-99``) and returns the lazy sweep."""
     params = options.aggregate_params
@@ -730,4 +796,5 @@ def build_fused_sweep(col, options, data_extractors, public_partitions,
         budgets[metric] = budget_accountant.request_budget(
             mechanism_type, weight=params.budget_weight)
     return LazySweepResult(col, options, data_extractors,
-                           public_partitions, budgets, selection_budget)
+                           public_partitions, budgets, selection_budget,
+                           mesh=mesh)

@@ -34,10 +34,9 @@ def perform_utility_analysis(col, backend,
                 options, data_extractors)
             accountant = budget_accounting.NaiveBudgetAccountant(
                 total_epsilon=options.epsilon, total_delta=options.delta)
-            result = jax_sweep.build_fused_sweep(col, options,
-                                                 data_extractors,
-                                                 public_partitions,
-                                                 accountant)
+            result = jax_sweep.build_fused_sweep(
+                col, options, data_extractors, public_partitions,
+                accountant, mesh=getattr(backend, "mesh", None))
             accountant.compute_budgets()
             return result
 

@@ -661,3 +661,43 @@ class TestAnalysisErrorModelClosedForm:
         assert accs[1].kept_partitions_expected == 1.0
         assert accs[3].kept_partitions_expected == 0.25
         assert accs[3].error_l0_expected == pytest.approx(0.25 * -4.0)
+
+
+class TestFusedSweepSharded:
+    """The configuration-axis sweep over the 8-device virtual mesh:
+    each device analyzes its slice of the parameter grid; results must
+    match the single-device sweep."""
+
+    def test_sharded_matches_single_device(self):
+        import jax
+        from pipelinedp_tpu.backends import JaxBackend
+        from pipelinedp_tpu.parallel import make_mesh
+        assert len(jax.devices()) >= 8
+        rng = np.random.default_rng(5)
+        ds = pdp.ArrayDataset(
+            privacy_ids=rng.integers(0, 200, 3000),
+            partition_keys=rng.integers(0, 20, 3000),
+            values=rng.uniform(0, 5, 3000))
+        multi = data_structures.MultiParameterConfiguration(
+            max_partitions_contributed=list(range(1, 17)),
+            max_contributions_per_partition=[2] * 16)
+        options = analysis.UtilityAnalysisOptions(
+            epsilon=1.0, delta=1e-6,
+            aggregate_params=count_params(l0=4, linf=2),
+            multi_param_configuration=multi)
+        ex = pdp.DataExtractors()
+        single = list(analysis.perform_utility_analysis(
+            ds, JaxBackend(), options, ex))[0]
+        sharded = list(analysis.perform_utility_analysis(
+            ds, JaxBackend(mesh=make_mesh(8)), options, ex))[0]
+        assert len(single) == len(sharded) == 16
+        for s, m in zip(single, sharded):
+            a, b = s.count_metrics, m.count_metrics
+            assert b.error_expected == pytest.approx(a.error_expected,
+                                                     rel=1e-4, abs=1e-4)
+            assert b.error_variance == pytest.approx(a.error_variance,
+                                                     rel=1e-4)
+            sp = s.partition_selection_metrics
+            mp = m.partition_selection_metrics
+            assert mp.dropped_partitions_expected == pytest.approx(
+                sp.dropped_partitions_expected, rel=1e-4, abs=1e-5)
