@@ -604,7 +604,8 @@ class LazySweepResult:
         P = len(encoded.pk_vocab)
         P_pad = _pad_pow2(max(P, 1))
 
-        pid, pk, values, valid = pad_and_put(encoded, None)
+        pid, pk, values, valid = pad_and_put(
+            encoded, None, with_values=Metrics.SUM in params.metrics)
         marker, pk_safe, count_u, sum_u, npart_u = _preagg_kernel(
             pid, pk, values, valid)
         users_pk = jax.ops.segment_sum(marker.astype(jnp.int32), pk_safe,
@@ -646,18 +647,19 @@ class LazySweepResult:
             # the chunk's configuration axis.
             chunk = max(chunk // n_dev, 1) * n_dev
         users_in = jnp.where(real_pk, users_pk, -1)
-        dlog_rs, dt_table = jax.device_put((log_rs, t_table))
         if self._mesh is not None and n_dev > 1:
-            # Place the replicated row arrays on the mesh ONCE: left
-            # committed to a single device they would re-broadcast to
-            # every device on each chunk iteration.
+            # Place the replicated row arrays and quantile tables on the
+            # mesh ONCE: left committed to a single device they would
+            # re-broadcast to every device on each chunk iteration.
             from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as PSpec
             repl_sharding = NamedSharding(self._mesh, PSpec())
             (marker, pk_safe, count_u, sum_u, npart_u, users_in, dlog_rs,
              dt_table) = jax.device_put(
                  (marker, pk_safe, count_u, sum_u, npart_u, users_in,
-                  dlog_rs, dt_table), repl_sharding)
+                  log_rs, t_table), repl_sharding)
+        else:
+            dlog_rs, dt_table = jax.device_put((log_rs, t_table))
         fields: Dict[str, Dict[str, List[np.ndarray]]] = {
             nm: {} for nm in metric_names}
         sel_fields: Dict[str, List[np.ndarray]] = {}
