@@ -99,17 +99,25 @@ class DPEngine:
     # must not take the fused shortcut.
     _supports_fused_dispatch = True
 
+    def _fused_backend_options(self):
+        """(fused?, rng_seed, mesh) — the one place probing the backend's
+        fused capability and options."""
+        if not (self._supports_fused_dispatch and getattr(
+                self._backend, "supports_fused_aggregation", False)):
+            return False, None, None
+        return (True, getattr(self._backend, "rng_seed", None),
+                getattr(self._backend, "mesh", None))
+
     def _aggregate(self, col, params, data_extractors, public_partitions):
-        if self._supports_fused_dispatch and getattr(
-                self._backend, "supports_fused_aggregation", False):
+        fused, rng_seed, mesh = self._fused_backend_options()
+        if fused:
             from pipelinedp_tpu import jax_engine
             if jax_engine.params_are_fusable(params):
                 return jax_engine.build_fused_aggregation(
                     col, params, data_extractors, public_partitions,
                     self._budget_accountant,
                     self._current_report_generator,
-                    rng_seed=getattr(self._backend, "rng_seed", None),
-                    mesh=getattr(self._backend, "mesh", None))
+                    rng_seed=rng_seed, mesh=mesh)
         from pipelinedp_tpu import jax_engine
         if isinstance(col, jax_engine.ArrayDataset):
             # Columnar input on a generic backend: expand to row tuples
@@ -205,6 +213,13 @@ class DPEngine:
                                           budget=budget)
 
     def _select_partitions(self, col, params, data_extractors):
+        fused, rng_seed, mesh = self._fused_backend_options()
+        if fused:
+            from pipelinedp_tpu import jax_engine
+            return jax_engine.build_fused_select_partitions(
+                col, params, data_extractors, self._budget_accountant,
+                self._current_report_generator,
+                rng_seed=rng_seed, mesh=mesh)
         max_partitions_contributed = params.max_partitions_contributed
         col = self._backend.map(
             col, lambda row: (data_extractors.privacy_id_extractor(row),

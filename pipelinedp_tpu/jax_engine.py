@@ -1085,28 +1085,10 @@ class LazyFusedResult:
             keep_table, thr, s_scale, min_count = selection_inputs(
                 config, 1.0, 1e-9, None)
 
-        from pipelinedp_tpu.ops import noise as noise_ops
-        seed = (self._rng_seed if self._rng_seed is not None else
-                int(noise_ops._host_rng.integers(0, 2**31 - 1)))
-        key = jax.random.PRNGKey(seed)
-        P_pad = _pad_pow2(P)
-
         t1 = _time.perf_counter()
-        if self._mesh is not None:
-            from pipelinedp_tpu.parallel import sharded_fused_aggregate
-            keep_pk, metrics = sharded_fused_aggregate(
-                self._mesh, config, P_pad, encoded.pid, encoded.pk,
-                encoded.values, np.ones(encoded.n_rows, bool), scales,
-                keep_table, thr, s_scale, min_count, rows_per_uid, key)
-        else:
-            pid, pk, values, valid = pad_and_put(
-                encoded, config.vector_size,
-                with_values=config.needs_values)
-            keep_pk, metrics = fused_aggregate_kernel(
-                config, P_pad, pid, pk, values, valid,
-                jnp.asarray(scales), jnp.asarray(keep_table),
-                jnp.float32(thr), jnp.float32(s_scale),
-                jnp.float32(min_count), jnp.float32(rows_per_uid), key)
+        keep_pk, metrics = _run_fused_kernel(
+            config, encoded, scales, keep_table, thr, s_scale, min_count,
+            rows_per_uid, self._rng_seed, self._mesh)
 
         # Fetching the outputs forces device execution; the fetch is
         # attributed to device_s, pure-Python row assembly to decode_s.
@@ -1147,6 +1129,101 @@ class LazyFusedResult:
         ]
         self.timings["host_decode_s"] = _time.perf_counter() - t2
         return out
+
+
+def _run_fused_kernel(config: FusedConfig, encoded: EncodedData, scales,
+                      keep_table, thr, s_scale, min_count, rows_per_uid,
+                      rng_seed, mesh):
+    """Shared encode→seed→dispatch scaffolding of the lazy results: one
+    place owns the kernel/sharded invocation and the seed protocol."""
+    from pipelinedp_tpu.ops import noise as noise_ops
+
+    P = len(encoded.pk_vocab)
+    P_pad = _pad_pow2(P)
+    seed = (rng_seed if rng_seed is not None else
+            int(noise_ops._host_rng.integers(0, 2**31 - 1)))
+    key = jax.random.PRNGKey(seed)
+    if mesh is not None:
+        from pipelinedp_tpu.parallel import sharded_fused_aggregate
+        return sharded_fused_aggregate(
+            mesh, config, P_pad, encoded.pid, encoded.pk,
+            encoded.values if config.needs_values else None,
+            np.ones(encoded.n_rows, bool), scales, keep_table, thr,
+            s_scale, min_count, rows_per_uid, key)
+    pid, pk, values, valid = pad_and_put(encoded, config.vector_size,
+                                         with_values=config.needs_values)
+    return fused_aggregate_kernel(
+        config, P_pad, pid, pk, values, valid, jnp.asarray(scales),
+        jnp.asarray(keep_table), jnp.float32(thr), jnp.float32(s_scale),
+        jnp.float32(min_count), jnp.float32(rows_per_uid), key)
+
+
+class LazySelectResult:
+    """Iterable of kept partition keys; runs the fused kernel (with an
+    empty metric set — only bounding + selection) on first iteration."""
+
+    def __init__(self, rows, params, data_extractors, spec, rng_seed,
+                 mesh):
+        self._rows = rows
+        self._params = params
+        self._extractors = data_extractors
+        self._spec = spec
+        self._rng_seed = rng_seed
+        self._mesh = mesh
+        self._cache = None
+
+    def __iter__(self):
+        if self._cache is None:
+            self._cache = self._execute()
+        yield from self._cache
+
+    def _execute(self):
+        params = self._params
+        config = FusedConfig(
+            metrics=(), noise_kind=NoiseKind.LAPLACE, linf=None,
+            l0=params.max_partitions_contributed,
+            per_partition_bounds=False, min_value=None, max_value=None,
+            min_sum_per_partition=None, max_sum_per_partition=None,
+            vector_size=None, vector_norm_kind=None, vector_max_norm=None,
+            selection=params.partition_selection_strategy,
+            bounds_already_enforced=False)
+        encoded = encode(self._rows, self._extractors, None, None)
+        P = len(encoded.pk_vocab)
+        if P == 0:
+            return []
+        keep_table, thr, s_scale, min_count = selection_inputs(
+            config, self._spec.eps, self._spec.delta, params.pre_threshold)
+        keep_pk, _ = _run_fused_kernel(
+            config, encoded, np.zeros(0, np.float32), keep_table, thr,
+            s_scale, min_count, 1.0, self._rng_seed, self._mesh)
+        keep_np = np.asarray(keep_pk)[:P]
+        vocab = encoded.pk_vocab
+        return [vocab[i] for i in np.flatnonzero(keep_np)]
+
+
+def build_fused_select_partitions(col, params, data_extractors,
+                                  budget_accountant, report_gen,
+                                  rng_seed=None,
+                                  mesh=None) -> LazySelectResult:
+    """Fused ``select_partitions`` (reference ``dp_engine.py:204-278``):
+    the L0 bound over distinct (pid, pk) pairs and the batched selection
+    are exactly the aggregation kernel with no metrics requested."""
+    from pipelinedp_tpu.aggregate_params import MechanismType
+
+    spec = budget_accountant.request_budget(
+        mechanism_type=MechanismType.GENERIC)
+    strategy = params.partition_selection_strategy
+    report_gen.add_stage(
+        f"Cross-partition contribution bounding: for each privacy_id "
+        f"randomly select max(actual_partition_contributed, "
+        f"{params.max_partitions_contributed}) partitions (fused on "
+        "device).")
+    report_gen.add_stage(
+        lambda: f"Private Partition selection: using {strategy.value} "
+        f"method with (eps={spec.eps}, delta={spec.delta}) — batched over "
+        "all partitions")
+    return LazySelectResult(col, params, data_extractors, spec, rng_seed,
+                            mesh)
 
 
 def build_fused_aggregation(col, params: AggregateParams, data_extractors,

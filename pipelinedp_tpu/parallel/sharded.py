@@ -129,14 +129,21 @@ def sharded_fused_aggregate(mesh: Mesh, config, num_partitions: int,
 
     pid_s = shard_array(pid)
     pk_s = shard_array(pk)
-    values_s = shard_array(values)
     valid_s = shard_array(valid, fill=False)
 
     sharding = NamedSharding(mesh, PSpec(mesh.axis_names[0]))
     dev = functools.partial(jax.device_put, device=sharding)
+    if values is None:
+        # Config never reads values (COUNT-style / select_partitions):
+        # materialize the zeros on device instead of shipping them over
+        # the host link.
+        values_dev = jax.device_put(
+            jnp.zeros(n_dev * per_shard, jnp.float32), sharding)
+    else:
+        values_dev = dev(shard_array(values))
     return _sharded_kernel(
         config, num_partitions, mesh, dev(pid_s), dev(pk_s),
-        dev(values_s), dev(valid_s), jnp.asarray(noise_scales),
+        values_dev, dev(valid_s), jnp.asarray(noise_scales),
         jnp.asarray(keep_table), jnp.float32(sel_threshold),
         jnp.float32(sel_scale), jnp.float32(sel_min_count),
         jnp.float32(sel_rows_per_uid), key)

@@ -512,3 +512,73 @@ class TestShardedMultiChipBroad:
         for k in single:
             assert sharded[k].count == pytest.approx(single[k].count,
                                                      rel=0.05)
+
+
+class TestFusedSelectPartitions:
+    """select_partitions on the fused plane vs the host graph."""
+
+    def _run(self, backend, data, l0=2, eps=BIG_EPS, delta=1e-2,
+             pre_threshold=None):
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=eps,
+                                        total_delta=delta)
+        engine = pdp.DPEngine(acc, backend)
+        ex = pdp.DataExtractors(
+            privacy_id_extractor=operator.itemgetter(0),
+            partition_extractor=operator.itemgetter(1))
+        params = pdp.SelectPartitionsParams(
+            max_partitions_contributed=l0, pre_threshold=pre_threshold)
+        result = engine.select_partitions(data, params, ex)
+        acc.compute_budgets()
+        return sorted(result)
+
+    def test_matches_local_at_huge_eps(self):
+        noise_ops.seed_host_rng(0)
+        data = [(u, f"p{u % 4}") for u in range(400)]
+        local = self._run(pdp.LocalBackend(), data)
+        fused = self._run(JaxBackend(rng_seed=50), data)
+        assert local == fused == ["p0", "p1", "p2", "p3"]
+
+    def test_small_partition_dropped(self):
+        data = [(u, "big") for u in range(2000)] + [(9999, "tiny")]
+        fused = self._run(JaxBackend(rng_seed=51), data, eps=1.0,
+                          delta=1e-6)
+        assert "big" in fused and "tiny" not in fused
+
+    def test_l0_bounding_limits_contributions(self):
+        # One user in 50 partitions with l0=1: at most 1 partition sees a
+        # contribution, so at huge eps at most 1 partition survives a
+        # selection that needs >= 1 user.
+        data = [(0, f"p{i}") for i in range(50)]
+        fused = self._run(JaxBackend(rng_seed=52), data, l0=1)
+        assert len(fused) <= 1
+
+    def test_pre_threshold(self):
+        data = [(u, "mid") for u in range(30)]
+        kept = self._run(JaxBackend(rng_seed=53), data, l0=1,
+                         pre_threshold=100)
+        assert kept == []  # 30 users < pre_threshold 100
+
+    def test_on_mesh(self):
+        from pipelinedp_tpu.parallel import make_mesh
+        noise_ops.seed_host_rng(0)
+        data = [(u, f"p{u % 3}") for u in range(300)]
+        fused = self._run(JaxBackend(mesh=make_mesh(8), rng_seed=54),
+                          data)
+        assert fused == ["p0", "p1", "p2"]
+
+    def test_duplicate_contributions_counted_once(self):
+        # A pid contributing many rows to one partition counts once.
+        data = [(0, "a")] * 100 + [(1, "a")] * 100
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                        total_delta=1e-6)
+        engine = pdp.DPEngine(acc, JaxBackend(rng_seed=55))
+        ex = pdp.DataExtractors(
+            privacy_id_extractor=operator.itemgetter(0),
+            partition_extractor=operator.itemgetter(1))
+        result = engine.select_partitions(
+            data, pdp.SelectPartitionsParams(max_partitions_contributed=1),
+            ex)
+        acc.compute_budgets()
+        # 2 distinct users: with delta=1e-6 a 2-user partition is
+        # (nearly) never kept; 200 rows must not inflate the count.
+        assert list(result) == []
