@@ -173,7 +173,16 @@ def _compute_partition_privacy_id_count_histogram(col_distinct, backend):
 def compute_dataset_histograms(col, data_extractors: DataExtractors,
                                backend) -> "collection":
     """All four histograms in one pass graph; returns a 1-element
-    collection with DatasetHistograms (reference :319-361)."""
+    collection with DatasetHistograms (reference :319-361). On a fused
+    backend the whole computation runs on device
+    (``jax_sweep.fused_dataset_histograms``)."""
+    if getattr(backend, "supports_fused_aggregation", False):
+        from pipelinedp_tpu.analysis import jax_sweep
+        return jax_sweep.fused_dataset_histograms(col, data_extractors)
+    from pipelinedp_tpu import jax_engine
+    if isinstance(col, jax_engine.ArrayDataset):
+        col, data_extractors = jax_engine.array_dataset_to_rows(
+            col, data_extractors)
     col = backend.map(
         col, lambda row: (data_extractors.privacy_id_extractor(row),
                           data_extractors.partition_extractor(row)),

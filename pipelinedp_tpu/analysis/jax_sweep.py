@@ -566,6 +566,134 @@ def _sweep_chunk_sharded(metric_names, strategy, noise_kind, P, public,
 # ---------------------------------------------------------------------------
 
 
+# ---------------------------------------------------------------------------
+# Dataset histograms on device (tuning input)
+# ---------------------------------------------------------------------------
+
+# Bin-id space of the 3-leading-digit binning: values <= 1000 are their
+# own bin; each later decade d contributes 900 bins for n//10^(d+1) in
+# [100, 1000). 7 decades cover int32.
+_HIST_DECADES = 7
+_HIST_BINS = 1001 + _HIST_DECADES * 900
+
+
+def _bin_ids(v):
+    """Exact integer 3-leading-digit binning (host twin
+    ``histograms._to_bin_lower``): returns dense bin ids [same shape].
+    The >= folds v == 10^k into decade k-2's first bin, whose decoded
+    lower edge (10^k) matches the host's _to_bin_lower — in particular
+    1000 shares the lower-1000 bin with 1001..1009."""
+    thresholds = jnp.asarray(
+        [10**(3 + j) for j in range(_HIST_DECADES)], jnp.int32)
+    e = jnp.sum(v[..., None] >= thresholds[None, :], axis=-1)
+    rb = jnp.asarray([10**j for j in range(_HIST_DECADES + 1)],
+                     jnp.int32)[e]
+    lead = v // rb  # in [100, 1000) for e >= 1
+    return jnp.where(e == 0, v, 1001 + (e - 1) * 900 + lead - 100)
+
+
+def _bin_lower_of_id(ids: np.ndarray) -> np.ndarray:
+    """Host inverse of _bin_ids: dense bin id -> bin lower edge."""
+    ids = np.asarray(ids, np.int64)
+    d = np.maximum((ids - 1001) // 900, 0)  # clamp: small ids unused below
+    m = (ids - 1001) % 900 + 100
+    return np.where(ids <= 1000, ids, m * 10**(d + 1))
+
+
+def _bin_stats(v, mask, P):
+    """(count, sum, max) per dense bin over masked values — [BINS, 3].
+    int32 accumulators are exact here: every histogram's total sum is
+    bounded by the dataset's row count (< 2^31)."""
+    ids = jnp.where(mask, _bin_ids(v), _HIST_BINS)  # masked -> dropped
+    cnt = jax.ops.segment_sum(mask.astype(jnp.int32), ids,
+                              num_segments=_HIST_BINS + 1)
+    tot = jax.ops.segment_sum(jnp.where(mask, v, 0), ids,
+                              num_segments=_HIST_BINS + 1)
+    mx = jax.ops.segment_max(jnp.where(mask, v, -1), ids,
+                             num_segments=_HIST_BINS + 1)
+    return jnp.stack([cnt, tot, mx], axis=-1)[:_HIST_BINS]
+
+
+@functools.partial(jax.jit, static_argnames=("P",))
+def _histogram_kernel(P, pid, pk, valid):
+    """All four tuning histograms in one program (host graph twin:
+    ``histograms.compute_dataset_histograms``). Returns [4, BINS, 3]."""
+    n = pid.shape[0]
+    idx = jnp.arange(n)
+    big_pid = jnp.where(valid, pid, seg_ops.PAD_ID)
+    big_pk = jnp.where(valid, pk, seg_ops.PAD_ID)
+    sort_idx = jnp.lexsort((big_pk, big_pid))
+    spid = big_pid[sort_idx]
+    spk = big_pk[sort_idx]
+    svalid = idx < jnp.sum(valid.astype(jnp.int32))
+
+    new_pid = (idx == 0) | (spid != jnp.roll(spid, 1))
+    new_seg = new_pid | (spk != jnp.roll(spk, 1))
+    marker = new_seg & svalid
+    pid_marker = new_pid & svalid
+    pk_safe = jnp.where(svalid, spk, 0)
+
+    seg_start = seg_ops.run_starts(new_seg)
+    last_of_seg = jnp.roll(new_seg, -1).at[-1].set(True)
+    seg_end = n - 1 - jnp.flip(seg_ops.run_starts(jnp.flip(last_of_seg)))
+    count_u = (seg_end - seg_start + 1).astype(jnp.int32)  # Linf values
+
+    seg_in_pid = seg_ops.run_ordinal_in_group(new_seg, new_pid)
+    last_of_pid = jnp.roll(new_pid, -1).at[-1].set(True)
+    pid_end = n - 1 - jnp.flip(seg_ops.run_starts(jnp.flip(last_of_pid)))
+    npart_u = (seg_in_pid[pid_end] + 1).astype(jnp.int32)  # L0 values
+
+    rows_pk = jax.ops.segment_sum(svalid.astype(jnp.int32), pk_safe,
+                                  num_segments=P)
+    pids_pk = jax.ops.segment_sum(marker.astype(jnp.int32), pk_safe,
+                                  num_segments=P)
+    pk_mask = pids_pk > 0
+
+    return jnp.stack([
+        _bin_stats(npart_u, pid_marker, P),          # L0
+        _bin_stats(count_u, marker, P),              # Linf
+        _bin_stats(rows_pk, pk_mask, P),             # count / partition
+        _bin_stats(pids_pk, pk_mask, P),             # pids / partition
+    ])
+
+
+def fused_dataset_histograms(col, data_extractors):
+    """Device twin of ``compute_dataset_histograms``: one sort + four
+    binned reductions; only ~90KB of per-bin stats return to host."""
+    from pipelinedp_tpu.analysis import histograms as hs
+    from pipelinedp_tpu.jax_engine import pad_and_put
+
+    encoded = encode(col, data_extractors, None, None)
+    if encoded.n_rows == 0:
+        empty = [hs.Histogram(t, []) for t in (
+            hs.HistogramType.L0_CONTRIBUTIONS,
+            hs.HistogramType.LINF_CONTRIBUTIONS,
+            hs.HistogramType.COUNT_PER_PARTITION,
+            hs.HistogramType.COUNT_PRIVACY_ID_PER_PARTITION)]
+        return [hs.DatasetHistograms(*empty)]
+    P = _pad_pow2(len(encoded.pk_vocab))
+    pid, pk, _, valid = pad_and_put(encoded, None, with_values=False)
+    stats = np.asarray(_histogram_kernel(P, pid, pk, valid))
+
+    def to_histogram(name, table):
+        nz = np.flatnonzero(table[:, 0] > 0)
+        lowers = _bin_lower_of_id(nz)
+        bins = [
+            hs.FrequencyBin(lower=int(lo), count=int(table[i, 0]),
+                            sum=int(table[i, 1]), max=int(table[i, 2]))
+            for lo, i in zip(lowers, nz)
+        ]
+        return hs.Histogram(name, bins)
+
+    return [hs.DatasetHistograms(
+        to_histogram(hs.HistogramType.L0_CONTRIBUTIONS, stats[0]),
+        to_histogram(hs.HistogramType.LINF_CONTRIBUTIONS, stats[1]),
+        to_histogram(hs.HistogramType.COUNT_PER_PARTITION, stats[2]),
+        to_histogram(hs.HistogramType.COUNT_PRIVACY_ID_PER_PARTITION,
+                     stats[3]),
+    )]
+
+
 _METRIC_ORDER = [(Metrics.SUM, "sum", am.AggregateMetricType.SUM),
                  (Metrics.COUNT, "count", am.AggregateMetricType.COUNT),
                  (Metrics.PRIVACY_ID_COUNT, "privacy_id_count",

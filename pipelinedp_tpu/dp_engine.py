@@ -120,22 +120,9 @@ class DPEngine:
                     rng_seed=rng_seed, mesh=mesh)
         from pipelinedp_tpu import jax_engine
         if isinstance(col, jax_engine.ArrayDataset):
-            # Columnar input on a generic backend: expand to row tuples
-            # with positional extractors.
-            if (col.privacy_ids is None and
-                    not params.contribution_bounds_already_enforced):
-                raise ValueError(
-                    "ArrayDataset.privacy_ids must be set unless "
-                    "contribution_bounds_already_enforced is True.")
-            col = col.to_rows()
-            if data_extractors.partition_extractor is None:
-                import operator
-                data_extractors = DataExtractors(
-                    privacy_id_extractor=(
-                        None if params.contribution_bounds_already_enforced
-                        else operator.itemgetter(0)),
-                    partition_extractor=operator.itemgetter(1),
-                    value_extractor=operator.itemgetter(2))
+            col, data_extractors = jax_engine.array_dataset_to_rows(
+                col, data_extractors,
+                require_pid=not params.contribution_bounds_already_enforced)
         if params.custom_combiners:
             combiner = combiners.create_compound_combiner_with_custom_combiners(
                 params, self._budget_accountant, params.custom_combiners)

@@ -229,6 +229,30 @@ def _pid_ids(pid_arr: np.ndarray) -> np.ndarray:
     return pid_idx.astype(np.int32)
 
 
+def array_dataset_to_rows(ds: ArrayDataset, data_extractors,
+                          require_pid: bool = True):
+    """Columnar input on a generic (row-based) backend: expand to row
+    tuples with positional extractors — shared by ``DPEngine._aggregate``
+    and the histogram graph. Caller-supplied extractors are honored when
+    a partition extractor is set."""
+    import operator
+
+    from pipelinedp_tpu.dp_engine import DataExtractors
+
+    if ds.privacy_ids is None and require_pid:
+        raise ValueError(
+            "ArrayDataset.privacy_ids must be set unless "
+            "contribution_bounds_already_enforced is True.")
+    rows = ds.to_rows()
+    if data_extractors.partition_extractor is None:
+        data_extractors = DataExtractors(
+            privacy_id_extractor=(None if not require_pid else
+                                  operator.itemgetter(0)),
+            partition_extractor=operator.itemgetter(1),
+            value_extractor=operator.itemgetter(2))
+    return rows, data_extractors
+
+
 def pad_and_put(encoded: EncodedData, vector_size: Optional[int],
                 with_values: bool = True):
     """One batched h2d transfer of the exact-size encoded columns; padding

@@ -701,3 +701,78 @@ class TestFusedSweepSharded:
             mp = m.partition_selection_metrics
             assert mp.dropped_partitions_expected == pytest.approx(
                 sp.dropped_partitions_expected, rel=1e-4, abs=1e-5)
+
+
+class TestFusedHistograms:
+    """Device dataset histograms vs the host graph, bin by bin."""
+
+    @staticmethod
+    def _hists(backend, data):
+        ex = extractors()
+        return list(histograms.compute_dataset_histograms(
+            data, ex, backend))[0]
+
+    @staticmethod
+    def _assert_equal(a, b):
+        assert len(a.bins) == len(b.bins), (a.bins, b.bins)
+        for x, y in zip(a.bins, b.bins):
+            assert (x.lower, x.count, x.sum, x.max) == (
+                y.lower, y.count, y.sum, y.max)
+
+    def test_matches_host_graph(self):
+        from pipelinedp_tpu.backends import JaxBackend
+        rng = np.random.default_rng(11)
+        data = [(int(u), int(p), 1.0)
+                for u, p in zip(rng.integers(0, 60, 4000),
+                                rng.integers(0, 25, 4000))]
+        # Heavy-hitter user and a hot partition to spread bin decades.
+        data += [(999, 7, 1.0)] * 2500
+        host = self._hists(pdp.LocalBackend(), data)
+        fused = self._hists(JaxBackend(), data)
+        self._assert_equal(host.l0_contributions_histogram,
+                           fused.l0_contributions_histogram)
+        self._assert_equal(host.linf_contributions_histogram,
+                           fused.linf_contributions_histogram)
+        self._assert_equal(host.count_per_partition_histogram,
+                           fused.count_per_partition_histogram)
+        self._assert_equal(host.count_privacy_id_per_partition,
+                           fused.count_privacy_id_per_partition)
+
+    def test_bin_lower_roundtrip(self):
+        from pipelinedp_tpu.analysis import jax_sweep
+        import jax.numpy as jnp
+        vals = np.array([1, 2, 999, 1000, 1001, 1010, 9999, 10000, 10001,
+                         123456, 9876543, 2**30], np.int32)
+        ids = np.asarray(jax_sweep._bin_ids(jnp.asarray(vals)))
+        lowers = jax_sweep._bin_lower_of_id(ids)
+        expected = [histograms._to_bin_lower(int(v)) for v in vals]
+        assert lowers.tolist() == expected
+
+    def test_quantiles_drive_tuning(self):
+        # tune() consumes the histograms; check quantiles agree too.
+        from pipelinedp_tpu.backends import JaxBackend
+        rng = np.random.default_rng(12)
+        data = [(int(u), int(p), 1.0)
+                for u, p in zip(rng.integers(0, 100, 3000),
+                                rng.zipf(1.5, 3000) % 40)]
+        host = self._hists(pdp.LocalBackend(), data)
+        fused = self._hists(JaxBackend(), data)
+        qs = [0.9, 0.95, 0.99]
+        assert (host.l0_contributions_histogram.quantiles(qs) ==
+                fused.l0_contributions_histogram.quantiles(qs))
+        assert (host.linf_contributions_histogram.quantiles(qs) ==
+                fused.linf_contributions_histogram.quantiles(qs))
+
+    def test_value_1000_shares_bin_with_1001(self):
+        # Regression: 1000 and 1003 must merge into one lower-1000 bin on
+        # both planes (host _to_bin_lower(1000) == _to_bin_lower(1003)).
+        from pipelinedp_tpu.backends import JaxBackend
+        data = ([(u, 0, 1.0) for u in range(1000)] +
+                [(u, 1, 1.0) for u in range(1003)])
+        host = self._hists(pdp.LocalBackend(), data)
+        fused = self._hists(JaxBackend(), data)
+        hb = host.count_per_partition_histogram.bins
+        fb = fused.count_per_partition_histogram.bins
+        assert [(b.lower, b.count, b.sum, b.max) for b in hb] == \
+               [(b.lower, b.count, b.sum, b.max) for b in fb]
+        assert len(fb) == 1 and fb[0].lower == 1000 and fb[0].count == 2
