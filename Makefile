@@ -1,0 +1,36 @@
+# Developer entry points (the reference ships the same targets).
+
+PYTHON ?= python
+
+.PHONY: test test-fast bench smoke multichip lint dev clean
+
+test:
+	$(PYTHON) -m pytest tests/ -q
+
+test-fast:
+	$(PYTHON) -m pytest tests/ -q -x
+
+bench:
+	$(PYTHON) bench.py
+
+smoke:
+	$(PYTHON) bench.py --smoke
+
+multichip:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	  $(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+lint:
+	@if $(PYTHON) -c "import pyflakes" 2>/dev/null; then \
+	  $(PYTHON) -m pyflakes pipelinedp_tpu tests; \
+	else \
+	  $(PYTHON) -m py_compile $$(git ls-files '*.py'); \
+	fi
+
+dev:
+	$(PYTHON) -m pip install -e . --no-deps --no-build-isolation
+
+clean:
+	rm -rf build *.egg-info .pytest_cache
+	find . -name __pycache__ -type d -exec rm -rf {} +
+	rm -f pipelinedp_tpu/native/_secure_noise.so

@@ -190,7 +190,7 @@ def main():
         q_rows, q_parts = 10_000_000, 100_000
         # vs_baseline is a unit rate (config*rows/s), comparable across
         # sizes; the host baseline is measured on a small slice.
-        a_rows, a_configs = 100_000, 256
+        a_rows, a_configs = 500_000, 256
 
     def flagship_params():
         return pdp.AggregateParams(
