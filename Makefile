@@ -17,8 +17,10 @@ smoke:
 	$(PYTHON) bench.py --smoke
 
 multichip:
-	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-	  $(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+	# dryrun_multichip self-bootstraps a virtual 8-device CPU mesh when
+	# fewer real devices exist; it owns the platform selection (the env
+	# var alone loses to auto-registered TPU plugins).
+	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
 lint:
 	@if $(PYTHON) -c "import pyflakes" 2>/dev/null; then \

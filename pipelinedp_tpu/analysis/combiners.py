@@ -13,6 +13,7 @@ from __future__ import annotations
 import abc
 import copy
 import math
+import dataclasses
 from dataclasses import dataclass
 from typing import Any, List, Optional, Tuple
 
@@ -110,27 +111,28 @@ PartitionSelectionAccumulator = Tuple[Optional[List[float]],
 
 
 def _merge_list(a: List, b: List) -> List:
-    if len(a) >= len(b):
-        a.extend(b)
-        return a
-    b.extend(a)
-    return b
+    """In-place merge that always extends the longer list (O(min))."""
+    shorter, longer = (a, b) if len(a) < len(b) else (b, a)
+    longer.extend(shorter)
+    return longer
 
 
 def _merge_partition_selection_accumulators(
         acc1: PartitionSelectionAccumulator,
         acc2: PartitionSelectionAccumulator
 ) -> PartitionSelectionAccumulator:
-    probs1, moments1 = acc1
-    probs2, moments2 = acc2
-    if (probs1 is not None and probs2 is not None and
-            len(probs1) + len(probs2) <= MAX_PROBABILITIES_IN_ACCUMULATOR):
-        return (_merge_list(probs1, probs2), None)
-    if moments1 is None:
-        moments1 = _probabilities_to_moments(probs1)
-    if moments2 is None:
-        moments2 = _probabilities_to_moments(probs2)
-    return (None, moments1 + moments2)
+    """Stays exact (explicit probability lists) while small; degrades to
+    summed moments once the merged list would exceed the cap."""
+    both_exact = acc1[1] is None and acc2[1] is None
+    if both_exact and (len(acc1[0]) + len(acc2[0]) <=
+                       MAX_PROBABILITIES_IN_ACCUMULATOR):
+        return (_merge_list(acc1[0], acc2[0]), None)
+
+    def as_moments(acc):
+        return (acc[1] if acc[1] is not None else
+                _probabilities_to_moments(acc[0]))
+
+    return (None, as_moments(acc1) + as_moments(acc2))
 
 
 class PartitionSelectionCombiner(UtilityAnalysisCombiner):
@@ -270,23 +272,20 @@ class CompoundCombiner(dp_combiners.CompoundCombiner):
                       for c in self._combiners))
 
     def merge_accumulators(self, acc1, acc2):
-        sparse1, dense1 = acc1
-        sparse2, dense2 = acc2
-        if sparse1 and sparse2:
-            merged_sparse = tuple(
-                _merge_list(s, t) for s, t in zip(sparse1, sparse2))
-            if len(merged_sparse[0]) <= 2 * len(self._combiners):
-                return (merged_sparse, None)
-            return (None, self._to_dense(merged_sparse))
-        dense1 = self._to_dense(sparse1) if sparse1 else dense1
-        dense2 = self._to_dense(sparse2) if sparse2 else dense2
-        return (None, super().merge_accumulators(dense1, dense2))
+        if acc1[0] and acc2[0]:  # both still sparse
+            columns = tuple(_merge_list(s, t)
+                            for s, t in zip(acc1[0], acc2[0]))
+            if len(columns[0]) <= 2 * len(self._combiners):
+                return (columns, None)
+            return (None, self._to_dense(columns))
+        return (None, super().merge_accumulators(
+            self._as_dense(acc1), self._as_dense(acc2)))
+
+    def _as_dense(self, acc):
+        return self._to_dense(acc[0]) if acc[0] else acc[1]
 
     def compute_metrics(self, acc):
-        sparse, dense = acc
-        if sparse:
-            dense = self._to_dense(sparse)
-        return super().compute_metrics(dense)
+        return super().compute_metrics(self._as_dense(acc))
 
 
 @dataclass
@@ -321,57 +320,22 @@ class AggregateErrorMetricsAccumulator:
     noise_std: float
 
     def __add__(self, other):
+        """Every field is additive across partitions (quantile lists
+        elementwise) except noise_std, which is a per-mechanism constant
+        carried through."""
         assert self.noise_std == other.noise_std, (
             "Accumulators must share noise_std to merge")
-        return AggregateErrorMetricsAccumulator(
-            num_partitions=self.num_partitions + other.num_partitions,
-            kept_partitions_expected=(self.kept_partitions_expected +
-                                      other.kept_partitions_expected),
-            total_aggregate=self.total_aggregate + other.total_aggregate,
-            data_dropped_l0=self.data_dropped_l0 + other.data_dropped_l0,
-            data_dropped_linf=(self.data_dropped_linf +
-                               other.data_dropped_linf),
-            data_dropped_partition_selection=(
-                self.data_dropped_partition_selection +
-                other.data_dropped_partition_selection),
-            error_l0_expected=(self.error_l0_expected +
-                               other.error_l0_expected),
-            error_linf_expected=(self.error_linf_expected +
-                                 other.error_linf_expected),
-            error_linf_min_expected=(self.error_linf_min_expected +
-                                     other.error_linf_min_expected),
-            error_linf_max_expected=(self.error_linf_max_expected +
-                                     other.error_linf_max_expected),
-            error_l0_variance=(self.error_l0_variance +
-                               other.error_l0_variance),
-            error_variance=self.error_variance + other.error_variance,
-            error_quantiles=[
-                a + b for a, b in zip(self.error_quantiles,
-                                      other.error_quantiles)
-            ],
-            rel_error_l0_expected=(self.rel_error_l0_expected +
-                                   other.rel_error_l0_expected),
-            rel_error_linf_expected=(self.rel_error_linf_expected +
-                                     other.rel_error_linf_expected),
-            rel_error_linf_min_expected=(self.rel_error_linf_min_expected +
-                                         other.rel_error_linf_min_expected),
-            rel_error_linf_max_expected=(self.rel_error_linf_max_expected +
-                                         other.rel_error_linf_max_expected),
-            rel_error_l0_variance=(self.rel_error_l0_variance +
-                                   other.rel_error_l0_variance),
-            rel_error_variance=(self.rel_error_variance +
-                                other.rel_error_variance),
-            rel_error_quantiles=[
-                a + b for a, b in zip(self.rel_error_quantiles,
-                                      other.rel_error_quantiles)
-            ],
-            error_expected_w_dropped_partitions=(
-                self.error_expected_w_dropped_partitions +
-                other.error_expected_w_dropped_partitions),
-            rel_error_expected_w_dropped_partitions=(
-                self.rel_error_expected_w_dropped_partitions +
-                other.rel_error_expected_w_dropped_partitions),
-            noise_std=self.noise_std)
+        merged = {}
+        for field in dataclasses.fields(self):
+            mine = getattr(self, field.name)
+            theirs = getattr(other, field.name)
+            if field.name == "noise_std":
+                merged[field.name] = mine
+            elif isinstance(mine, list):
+                merged[field.name] = [a + b for a, b in zip(mine, theirs)]
+            else:
+                merged[field.name] = mine + theirs
+        return AggregateErrorMetricsAccumulator(**merged)
 
 
 class AggregateErrorMetricsCompoundCombiner(dp_combiners.CompoundCombiner):
@@ -416,137 +380,95 @@ class SumAggregateErrorMetricsCombiner(dp_combiners.Combiner):
     def create_accumulator(self,
                            partition_metrics: metrics.SumMetrics,
                            prob_to_keep: float = 1) -> AccumulatorType:
-        total_aggregate = partition_metrics.sum
-        data_dropped_l0 = data_dropped_linf = 0
-        data_dropped_partition_selection = 0
+        """One partition's error contribution, weighted by its keep
+        probability. The relative fields are the absolute fields scaled
+        by 1/|true sum| (variances by 1/sum²), all zero on an empty
+        partition."""
+        m = partition_metrics
+        keep = prob_to_keep
+        bounding_error = (m.expected_cross_partition_error +
+                          m.per_partition_error_min +
+                          m.per_partition_error_max)
+
+        absolute = {
+            "error_l0_expected": keep * m.expected_cross_partition_error,
+            "error_linf_min_expected": keep * m.per_partition_error_min,
+            "error_linf_max_expected": keep * m.per_partition_error_max,
+            "error_l0_variance": keep * m.std_cross_partition_error**2,
+            "error_variance": keep * (m.std_cross_partition_error**2 +
+                                      m.std_noise**2),
+            "error_expected_w_dropped_partitions": (
+                keep * bounding_error + (1 - keep) * -m.sum),
+        }
+        absolute["error_linf_expected"] = (
+            absolute["error_linf_min_expected"] +
+            absolute["error_linf_max_expected"])
+        quantiles = self._compute_error_quantiles(keep, m)
+
+        inv = 0.0 if m.sum == 0 else 1.0 / abs(m.sum)
+        inv_sq = inv * inv
+        relative = {
+            "rel_" + name: value * (inv_sq if "variance" in name else inv)
+            for name, value in absolute.items()
+        }
+
+        # COUNT-style metrics report what bounding/selection discards as
+        # data-drop ratios; for SUM the clipped "excess" is not data.
+        dropped = dict(data_dropped_l0=0.0, data_dropped_linf=0.0,
+                       data_dropped_partition_selection=0.0)
         if self._metric_type != metrics.AggregateMetricType.SUM:
-            data_dropped_l0 = (
-                -partition_metrics.expected_cross_partition_error)
-            data_dropped_linf = -partition_metrics.per_partition_error_max
-            data_dropped_partition_selection = (1 - prob_to_keep) * (
-                partition_metrics.sum +
-                partition_metrics.expected_cross_partition_error +
-                partition_metrics.per_partition_error_max)
-
-        error_l0_expected = (
-            prob_to_keep * partition_metrics.expected_cross_partition_error)
-        error_linf_min_expected = (
-            prob_to_keep * partition_metrics.per_partition_error_min)
-        error_linf_max_expected = (
-            prob_to_keep * partition_metrics.per_partition_error_max)
-        error_linf_expected = (error_linf_min_expected +
-                               error_linf_max_expected)
-        error_l0_variance = (
-            prob_to_keep * partition_metrics.std_cross_partition_error**2)
-        error_variance = prob_to_keep * (
-            partition_metrics.std_cross_partition_error**2 +
-            partition_metrics.std_noise**2)
-        error_quantiles = self._compute_error_quantiles(prob_to_keep,
-                                                        partition_metrics)
-        error_expected_w_dropped = prob_to_keep * (
-            partition_metrics.expected_cross_partition_error +
-            partition_metrics.per_partition_error_min +
-            partition_metrics.per_partition_error_max) + (
-                1 - prob_to_keep) * -partition_metrics.sum
-
-        if partition_metrics.sum == 0:
-            rel_error_l0_expected = 0
-            rel_error_linf_expected = 0
-            rel_error_linf_min_expected = 0
-            rel_error_linf_max_expected = 0
-            rel_error_l0_variance = 0
-            rel_error_variance = 0
-            rel_error_quantiles = [0] * len(self._error_quantiles)
-            rel_error_expected_w_dropped = 0
-        else:
-            abs_sum = abs(partition_metrics.sum)
-            rel_error_l0_expected = error_l0_expected / abs_sum
-            rel_error_linf_min_expected = error_linf_min_expected / abs_sum
-            rel_error_linf_max_expected = error_linf_max_expected / abs_sum
-            rel_error_linf_expected = (rel_error_linf_min_expected +
-                                       rel_error_linf_max_expected)
-            rel_error_l0_variance = (error_l0_variance /
-                                     partition_metrics.sum**2)
-            rel_error_variance = error_variance / partition_metrics.sum**2
-            rel_error_quantiles = [e / abs_sum for e in error_quantiles]
-            rel_error_expected_w_dropped = (error_expected_w_dropped /
-                                            abs_sum)
+            dropped = dict(
+                data_dropped_l0=-m.expected_cross_partition_error,
+                data_dropped_linf=-m.per_partition_error_max,
+                data_dropped_partition_selection=(
+                    (1 - keep) * (m.sum + m.expected_cross_partition_error
+                                  + m.per_partition_error_max)))
 
         return AggregateErrorMetricsAccumulator(
             num_partitions=1,
-            kept_partitions_expected=prob_to_keep,
-            total_aggregate=total_aggregate,
-            data_dropped_l0=data_dropped_l0,
-            data_dropped_linf=data_dropped_linf,
-            data_dropped_partition_selection=(
-                data_dropped_partition_selection),
-            error_l0_expected=error_l0_expected,
-            error_linf_expected=error_linf_expected,
-            error_linf_min_expected=error_linf_min_expected,
-            error_linf_max_expected=error_linf_max_expected,
-            error_l0_variance=error_l0_variance,
-            error_variance=error_variance,
-            error_quantiles=error_quantiles,
-            rel_error_l0_expected=rel_error_l0_expected,
-            rel_error_linf_expected=rel_error_linf_expected,
-            rel_error_linf_min_expected=rel_error_linf_min_expected,
-            rel_error_linf_max_expected=rel_error_linf_max_expected,
-            rel_error_l0_variance=rel_error_l0_variance,
-            rel_error_variance=rel_error_variance,
-            rel_error_quantiles=rel_error_quantiles,
-            error_expected_w_dropped_partitions=error_expected_w_dropped,
-            rel_error_expected_w_dropped_partitions=(
-                rel_error_expected_w_dropped),
-            noise_std=partition_metrics.std_noise)
+            kept_partitions_expected=keep,
+            total_aggregate=m.sum,
+            error_quantiles=quantiles,
+            rel_error_quantiles=[q * inv for q in quantiles],
+            noise_std=m.std_noise,
+            **absolute, **relative, **dropped)
 
     def merge_accumulators(self, acc1, acc2):
         return acc1 + acc2
 
+    # Fields averaged over EXPECTED KEPT partitions vs over ALL
+    # partitions; data-drop sums become ratios of the total aggregate.
+    _PER_KEPT = ("error_l0_expected", "error_linf_min_expected",
+                 "error_linf_max_expected", "error_linf_expected",
+                 "error_l0_variance", "error_variance", "error_quantiles",
+                 "rel_error_l0_expected", "rel_error_linf_min_expected",
+                 "rel_error_linf_max_expected", "rel_error_linf_expected",
+                 "rel_error_l0_variance", "rel_error_variance",
+                 "rel_error_quantiles")
+    _PER_PARTITION = ("error_expected_w_dropped_partitions",
+                      "rel_error_expected_w_dropped_partitions")
+
     def compute_metrics(self, acc) -> metrics.AggregateErrorMetrics:
-        kept = acc.kept_partitions_expected
-        error_l0_expected = acc.error_l0_expected / kept
-        error_linf_min_expected = acc.error_linf_min_expected / kept
-        error_linf_max_expected = acc.error_linf_max_expected / kept
-        error_linf_expected = (error_linf_min_expected +
-                               error_linf_max_expected)
-        rel_error_l0_expected = acc.rel_error_l0_expected / kept
-        rel_error_linf_min_expected = acc.rel_error_linf_min_expected / kept
-        rel_error_linf_max_expected = acc.rel_error_linf_max_expected / kept
-        rel_error_linf_expected = (rel_error_linf_min_expected +
-                                   rel_error_linf_max_expected)
-        total_aggregate = max(1.0, acc.total_aggregate)
+        out = {}
+        for name in self._PER_KEPT:
+            value = getattr(acc, name)
+            denom = acc.kept_partitions_expected
+            out[name] = ([v / denom for v in value]
+                         if isinstance(value, list) else value / denom)
+        for name in self._PER_PARTITION:
+            out[name] = getattr(acc, name) / acc.num_partitions
+        out["error_expected"] = (out["error_l0_expected"] +
+                                 out["error_linf_expected"])
+        out["rel_error_expected"] = (out["rel_error_l0_expected"] +
+                                     out["rel_error_linf_expected"])
+        denom = max(1.0, acc.total_aggregate)
+        for src, dst in (("data_dropped_l0", "ratio_data_dropped_l0"),
+                         ("data_dropped_linf", "ratio_data_dropped_linf"),
+                         ("data_dropped_partition_selection",
+                          "ratio_data_dropped_partition_selection")):
+            out[dst] = getattr(acc, src) / denom
         return metrics.AggregateErrorMetrics(
-            metric_type=self._metric_type,
-            ratio_data_dropped_l0=acc.data_dropped_l0 / total_aggregate,
-            ratio_data_dropped_linf=acc.data_dropped_linf / total_aggregate,
-            ratio_data_dropped_partition_selection=(
-                acc.data_dropped_partition_selection / total_aggregate),
-            error_l0_expected=error_l0_expected,
-            error_linf_expected=error_linf_expected,
-            error_linf_min_expected=error_linf_min_expected,
-            error_linf_max_expected=error_linf_max_expected,
-            error_expected=error_l0_expected + error_linf_expected,
-            error_l0_variance=acc.error_l0_variance / kept,
-            error_variance=acc.error_variance / kept,
-            error_quantiles=[q / kept for q in acc.error_quantiles],
-            rel_error_l0_expected=rel_error_l0_expected,
-            rel_error_linf_expected=rel_error_linf_expected,
-            rel_error_linf_min_expected=rel_error_linf_min_expected,
-            rel_error_linf_max_expected=rel_error_linf_max_expected,
-            rel_error_expected=(rel_error_l0_expected +
-                                rel_error_linf_expected),
-            rel_error_l0_variance=acc.rel_error_l0_variance / kept,
-            rel_error_variance=acc.rel_error_variance / kept,
-            rel_error_quantiles=[
-                q / kept for q in acc.rel_error_quantiles
-            ],
-            error_expected_w_dropped_partitions=(
-                acc.error_expected_w_dropped_partitions /
-                acc.num_partitions),
-            rel_error_expected_w_dropped_partitions=(
-                acc.rel_error_expected_w_dropped_partitions /
-                acc.num_partitions),
-            noise_std=acc.noise_std)
+            metric_type=self._metric_type, noise_std=acc.noise_std, **out)
 
     def metrics_names(self) -> List[str]:
         return []
