@@ -582,3 +582,55 @@ class TestFusedSelectPartitions:
         # 2 distinct users: with delta=1e-6 a 2-user partition is
         # (nearly) never kept; 200 rows must not inflate the count.
         assert list(result) == []
+
+
+class TestFusedSelectMore:
+    """Extra fused select_partitions coverage: columnar input, all
+    strategies, report stages."""
+
+    def test_array_dataset_input(self):
+        ds = pdp.ArrayDataset(privacy_ids=np.arange(500) % 100,
+                              partition_keys=np.arange(500) % 4)
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=BIG_EPS,
+                                        total_delta=1e-2)
+        engine = pdp.DPEngine(acc, JaxBackend(rng_seed=60))
+        result = engine.select_partitions(
+            ds, pdp.SelectPartitionsParams(max_partitions_contributed=4),
+            pdp.DataExtractors())
+        acc.compute_budgets()
+        assert sorted(result) == [0, 1, 2, 3]
+
+    @pytest.mark.parametrize("strategy", list(
+        pdp.PartitionSelectionStrategy))
+    def test_all_strategies(self, strategy):
+        data = [(u, "only") for u in range(500)]
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                        total_delta=1e-6)
+        engine = pdp.DPEngine(acc, JaxBackend(rng_seed=61))
+        ex = pdp.DataExtractors(
+            privacy_id_extractor=operator.itemgetter(0),
+            partition_extractor=operator.itemgetter(1))
+        result = engine.select_partitions(
+            data, pdp.SelectPartitionsParams(
+                max_partitions_contributed=1,
+                partition_selection_strategy=strategy), ex)
+        acc.compute_budgets()
+        assert list(result) == ["only"]
+
+    def test_report_stages(self):
+        data = [(u, "a") for u in range(10)]
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                        total_delta=1e-6)
+        engine = pdp.DPEngine(acc, JaxBackend(rng_seed=62))
+        ex = pdp.DataExtractors(
+            privacy_id_extractor=operator.itemgetter(0),
+            partition_extractor=operator.itemgetter(1))
+        result = engine.select_partitions(
+            data, pdp.SelectPartitionsParams(max_partitions_contributed=2),
+            ex)
+        acc.compute_budgets()
+        list(result)
+        report = engine.explain_computations_report()[0]
+        assert "Cross-partition contribution bounding" in report
+        assert "Private Partition selection" in report
+        assert "eps=" in report
