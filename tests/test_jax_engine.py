@@ -588,48 +588,45 @@ class TestFusedSelectMore:
     """Extra fused select_partitions coverage: columnar input, all
     strategies, report stages."""
 
-    def test_array_dataset_input(self):
-        ds = pdp.ArrayDataset(privacy_ids=np.arange(500) % 100,
-                              partition_keys=np.arange(500) % 4)
-        acc = pdp.NaiveBudgetAccountant(total_epsilon=BIG_EPS,
-                                        total_delta=1e-2)
-        engine = pdp.DPEngine(acc, JaxBackend(rng_seed=60))
+    def _select(self, data, l0=4, eps=BIG_EPS, delta=1e-2, strategy=None,
+                seed=60, ex=None):
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=eps,
+                                        total_delta=delta)
+        engine = pdp.DPEngine(acc, JaxBackend(rng_seed=seed))
+        kw = dict(max_partitions_contributed=l0)
+        if strategy is not None:
+            kw["partition_selection_strategy"] = strategy
         result = engine.select_partitions(
-            ds, pdp.SelectPartitionsParams(max_partitions_contributed=4),
-            pdp.DataExtractors())
+            data, pdp.SelectPartitionsParams(**kw),
+            ex or pdp.DataExtractors(
+                privacy_id_extractor=operator.itemgetter(0),
+                partition_extractor=operator.itemgetter(1)))
         acc.compute_budgets()
-        assert sorted(result) == [0, 1, 2, 3]
+        return sorted(result), engine
+
+    def test_array_dataset_input(self):
+        # pid stride 101 is coprime to the 4 partitions: users genuinely
+        # span partitions, exercising columnar cross-partition bounding.
+        ds = pdp.ArrayDataset(privacy_ids=np.arange(500) % 101,
+                              partition_keys=np.arange(500) % 4)
+        kept, _ = self._select(ds, ex=pdp.DataExtractors())
+        assert kept == [0, 1, 2, 3]
 
     @pytest.mark.parametrize("strategy", list(
         pdp.PartitionSelectionStrategy))
     def test_all_strategies(self, strategy):
         data = [(u, "only") for u in range(500)]
-        acc = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
-                                        total_delta=1e-6)
-        engine = pdp.DPEngine(acc, JaxBackend(rng_seed=61))
-        ex = pdp.DataExtractors(
-            privacy_id_extractor=operator.itemgetter(0),
-            partition_extractor=operator.itemgetter(1))
-        result = engine.select_partitions(
-            data, pdp.SelectPartitionsParams(
-                max_partitions_contributed=1,
-                partition_selection_strategy=strategy), ex)
-        acc.compute_budgets()
-        assert list(result) == ["only"]
+        kept, engine = self._select(data, l0=1, eps=1.0, delta=1e-6,
+                                    strategy=strategy, seed=61)
+        assert kept == ["only"]
+        # The configured strategy must actually reach the fused plane.
+        report = engine.explain_computations_report()[0]
+        assert f"using {strategy.value}" in report
 
     def test_report_stages(self):
         data = [(u, "a") for u in range(10)]
-        acc = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
-                                        total_delta=1e-6)
-        engine = pdp.DPEngine(acc, JaxBackend(rng_seed=62))
-        ex = pdp.DataExtractors(
-            privacy_id_extractor=operator.itemgetter(0),
-            partition_extractor=operator.itemgetter(1))
-        result = engine.select_partitions(
-            data, pdp.SelectPartitionsParams(max_partitions_contributed=2),
-            ex)
-        acc.compute_budgets()
-        list(result)
+        kept, engine = self._select(data, l0=2, eps=1.0, delta=1e-6,
+                                    seed=62)
         report = engine.explain_computations_report()[0]
         assert "Cross-partition contribution bounding" in report
         assert "Private Partition selection" in report
