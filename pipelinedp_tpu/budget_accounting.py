@@ -373,11 +373,11 @@ class PLDBudgetAccountant(BudgetAccountant):
             logging.warning("No budgets were requested.")
             return
         from pipelinedp_tpu import pld as pld_lib
+        sum_weights = sum(m.weight for m in self._mechanisms)
         if self._total_delta == 0:
             # Pure-DP pipeline: only Laplace-style composition is possible;
             # the reference uses the closed form sum(weights)/eps * sqrt(2)
             # (``budget_accounting.py:509-514``).
-            sum_weights = sum(m.weight for m in self._mechanisms)
             minimum_noise_std = (sum_weights / self._total_epsilon *
                                  math.sqrt(2.0))
         else:
@@ -400,3 +400,50 @@ class PLDBudgetAccountant(BudgetAccountant):
                 eps0, delta0 = pld_lib.generic_mechanism_eps_delta(
                     stddev, self._total_epsilon, self._total_delta)
                 spec.set_eps_delta(eps0, delta0)
+            else:
+                # Also publish the EQUIVALENT per-mechanism (eps, delta):
+                # the combiner layer calibrates noise from them, and with
+                # these values its calibration round-trips to exactly the
+                # PLD-granted noise level — which is what makes this
+                # accountant work end-to-end with DPEngine (the reference's
+                # PLD accountant never could, reference :406).
+                spec.set_eps_delta(*self._equivalent_eps_delta(
+                    spec.mechanism_type, stddev, m.sensitivity, m.weight,
+                    sum_weights))
+
+    def _equivalent_eps_delta(self, mechanism_type: MechanismType,
+                              stddev: float, sensitivity: float,
+                              weight: float, sum_weights: float):
+        """(eps, delta) whose standard calibration reproduces ``stddev``
+        at the spec's registered sensitivity. A downstream combiner
+        multiplying in its own (larger) sensitivity scales the granted
+        noise proportionally, which is exactly the PLD model's semantics.
+
+        Laplace: noise scale b = sensitivity/eps, so eps =
+        sensitivity*sqrt(2)/stddev and delta = 0. Gaussian: fix this
+        mechanism's delta share and invert the analytic-Gaussian
+        calibration by bisection so gaussian_sigma(eps, delta,
+        sensitivity) == stddev."""
+        from pipelinedp_tpu.ops import noise as noise_ops
+
+        if mechanism_type == MechanismType.LAPLACE:
+            return math.sqrt(2.0) * sensitivity / stddev, 0.0
+        delta_share = self._total_delta * weight / sum_weights
+        lo, hi = 1e-12, 1e12
+        for _ in range(200):
+            mid = math.sqrt(lo * hi)
+            if noise_ops.gaussian_sigma(mid, delta_share,
+                                        sensitivity) > stddev:
+                lo = mid  # too little eps -> too much noise
+            else:
+                hi = mid
+        # Returning a bracket endpoint would silently publish an eps whose
+        # calibration UNDER-noises relative to the PLD grant — fail loudly
+        # instead (never reached for any sane budget).
+        recomputed = noise_ops.gaussian_sigma(hi, delta_share, sensitivity)
+        if not (0.999 * recomputed <= stddev <= 1.001 *
+                noise_ops.gaussian_sigma(lo, delta_share, sensitivity)):
+            raise ValueError(
+                f"could not invert the Gaussian calibration for noise "
+                f"std {stddev} (eps bracket [{lo}, {hi}] exhausted)")
+        return hi, delta_share
