@@ -170,6 +170,26 @@ def bench_analysis_sweep(n_rows, n_users, n_partitions, n_configs):
     return rec
 
 
+def _check_device_reachable(timeout_s: int = 300) -> None:
+    """Fail fast (with a diagnostic) when the accelerator is unreachable:
+    jax backend initialization can block indefinitely on a wedged TPU
+    tunnel, and a hung benchmark is worse than a failed one."""
+    import subprocess
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True, text=True)
+        if probe.returncode == 0:
+            return
+        detail = (probe.stderr or "")[-300:]
+    except subprocess.TimeoutExpired:
+        detail = f"device probe did not return within {timeout_s}s"
+    log(f"## DEVICE UNREACHABLE: {detail}")
+    log("## benchmark aborted: jax backend initialization is blocked "
+        "(wedged TPU tunnel?); rerun when the device is available")
+    raise SystemExit(3)
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--smoke", action="store_true",
@@ -177,6 +197,8 @@ def main():
     parser.add_argument("--rows", type=int, default=None)
     parser.add_argument("--flagship-only", action="store_true")
     args = parser.parse_args()
+
+    _check_device_reachable()
 
     import pipelinedp_tpu as pdp
 

@@ -428,21 +428,23 @@ class PLDBudgetAccountant(BudgetAccountant):
 
         if mechanism_type == MechanismType.LAPLACE:
             return math.sqrt(2.0) * sensitivity / stddev, 0.0
+        # Bisect eps directly on the exact delta(eps) curve at the granted
+        # sigma (monotone decreasing in eps); delta is this mechanism's
+        # share of the total.
         delta_share = self._total_delta * weight / sum_weights
         lo, hi = 1e-12, 1e12
-        for _ in range(200):
+        for _ in range(80):
             mid = math.sqrt(lo * hi)
-            if noise_ops.gaussian_sigma(mid, delta_share,
-                                        sensitivity) > stddev:
-                lo = mid  # too little eps -> too much noise
+            if noise_ops.gaussian_delta(mid, stddev,
+                                        sensitivity) > delta_share:
+                lo = mid  # too little eps -> too much residual delta
             else:
                 hi = mid
         # Returning a bracket endpoint would silently publish an eps whose
         # calibration UNDER-noises relative to the PLD grant — fail loudly
         # instead (never reached for any sane budget).
         recomputed = noise_ops.gaussian_sigma(hi, delta_share, sensitivity)
-        if not (0.999 * recomputed <= stddev <= 1.001 *
-                noise_ops.gaussian_sigma(lo, delta_share, sensitivity)):
+        if not 0.999 * stddev <= recomputed <= 1.001 * stddev:
             raise ValueError(
                 f"could not invert the Gaussian calibration for noise "
                 f"std {stddev} (eps bracket [{lo}, {hi}] exhausted)")

@@ -336,6 +336,27 @@ class DPEngine:
                                           None) is not None:
             raise NotImplementedError(
                 "max_contributions is not supported yet.")
+        from pipelinedp_tpu import budget_accounting
+        if params is not None and isinstance(
+                self._budget_accountant,
+                budget_accounting.PLDBudgetAccountant):
+            # The PLD accountant publishes per-spec equivalent (eps,
+            # delta); metrics whose combiners RE-SPLIT that budget into
+            # several internal mechanisms (normalized-sum mean/variance,
+            # per-coordinate vectors, per-level trees) would realize a
+            # composition the PLD accounting never convolved — reject
+            # rather than silently void the certificate.
+            resplit = [m for m in (params.metrics or [])
+                       if m.is_percentile or m in (
+                           Metrics.MEAN, Metrics.VARIANCE,
+                           Metrics.VECTOR_SUM)]
+            if resplit:
+                raise NotImplementedError(
+                    f"PLDBudgetAccountant supports single-mechanism "
+                    f"metrics (COUNT, PRIVACY_ID_COUNT, SUM); "
+                    f"{[str(m) for m in resplit]} split their budget "
+                    "into several internal mechanisms, which the PLD "
+                    "composition does not model yet.")
         if col is None or not col:
             raise ValueError("col must be non-empty")
         if params is None:

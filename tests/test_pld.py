@@ -270,3 +270,22 @@ class TestPLDWithEngine:
         naive_std = noise_ops.gaussian_sigma(2.0 / n_mech,
                                              1e-6 / n_mech, 1.0)
         assert pld_std < naive_std
+
+    def test_resplitting_metrics_rejected(self):
+        # MEAN/VARIANCE/VECTOR_SUM/PERCENTILE split their published
+        # budget into several internal mechanisms — a composition the
+        # PLD accounting never modeled; the engine must reject them.
+        import operator
+        import pipelinedp_tpu as pdp
+        acc = PLDBudgetAccountant(total_epsilon=1.0, total_delta=1e-6)
+        engine = pdp.DPEngine(acc, pdp.LocalBackend())
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.MEAN], max_partitions_contributed=1,
+            max_contributions_per_partition=1, min_value=0.0,
+            max_value=1.0)
+        ex = pdp.DataExtractors(
+            privacy_id_extractor=operator.itemgetter(0),
+            partition_extractor=operator.itemgetter(1),
+            value_extractor=operator.itemgetter(2))
+        with pytest.raises(NotImplementedError, match="single-mechanism"):
+            engine.aggregate([(0, "a", 1.0)], params, ex)
