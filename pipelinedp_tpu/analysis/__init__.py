@@ -20,6 +20,8 @@ from pipelinedp_tpu.analysis.metrics import (
     AggregateMetricType,
     PartitionSelectionMetrics,
     SumMetrics,
+    UtilityReport,
+    to_utility_report,
 )
 from pipelinedp_tpu.analysis.parameter_tuning import (
     MinimizingFunction,

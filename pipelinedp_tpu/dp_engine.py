@@ -336,8 +336,14 @@ class DPEngine:
                                           None) is not None:
             raise NotImplementedError(
                 "max_contributions is not supported yet.")
+        if col is None or not col:
+            raise ValueError("col must be non-empty")
+        if params is None:
+            raise ValueError("params must be set to a valid AggregateParams")
+        if not isinstance(params, AggregateParams):
+            raise TypeError("params must be set to a valid AggregateParams")
         from pipelinedp_tpu import budget_accounting
-        if params is not None and isinstance(
+        if isinstance(
                 self._budget_accountant,
                 budget_accounting.PLDBudgetAccountant):
             # The PLD accountant publishes per-spec equivalent (eps,
@@ -357,12 +363,6 @@ class DPEngine:
                     f"{[str(m) for m in resplit]} split their budget "
                     "into several internal mechanisms, which the PLD "
                     "composition does not model yet.")
-        if col is None or not col:
-            raise ValueError("col must be non-empty")
-        if params is None:
-            raise ValueError("params must be set to a valid AggregateParams")
-        if not isinstance(params, AggregateParams):
-            raise TypeError("params must be set to a valid AggregateParams")
         if check_data_extractors:
             if data_extractors is None:
                 raise ValueError(

@@ -776,3 +776,60 @@ class TestFusedHistograms:
         assert [(b.lower, b.count, b.sum, b.max) for b in hb] == \
                [(b.lower, b.count, b.sum, b.max) for b in fb]
         assert len(fb) == 1 and fb[0].lower == 1000 and fb[0].count == 2
+
+
+class TestUtilityReport:
+    """The richer report schema, wired via to_utility_report (the
+    reference carries the schema but never wires it)."""
+
+    def _analysis(self):
+        rng = np.random.default_rng(20)
+        ds = pdp.ArrayDataset(
+            privacy_ids=rng.integers(0, 200, 3000),
+            partition_keys=rng.integers(0, 20, 3000),
+            values=rng.uniform(0, 5, 3000))
+        options = analysis.UtilityAnalysisOptions(
+            epsilon=1.0, delta=1e-6,
+            aggregate_params=count_params(l0=3, linf=2))
+        return list(analysis.perform_utility_analysis(
+            ds, pdp.LocalBackend(), options, pdp.DataExtractors(
+                privacy_id_extractor=operator.itemgetter(0),
+                partition_extractor=operator.itemgetter(1),
+                value_extractor=operator.itemgetter(2))))[0][0]
+
+    def test_conversion_structure(self):
+        agg = self._analysis()
+        report = analysis.to_utility_report(agg)
+        assert report.input_aggregate_params is agg.input_aggregate_params
+        assert len(report.metric_errors) == 1
+        mu = report.metric_errors[0]
+        assert mu.metric == pdp.Metrics.COUNT
+        m = agg.count_metrics
+        assert mu.noise_std == m.noise_std
+        assert mu.ratio_data_dropped.l0 == m.ratio_data_dropped_l0
+        ae = mu.absolute_error
+        assert ae.bias == m.error_expected
+        assert ae.variance == m.error_variance
+        assert ae.rmse == pytest.approx(m.absolute_rmse())
+        assert ae.bounding_errors.l0.mean == m.error_l0_expected
+        assert ae.bounding_errors.linf == m.error_linf_expected
+        assert ae.l1 >= abs(ae.bias) - 1e-9  # E|X| >= |E X|
+        re = mu.relative_error
+        assert re.rmse == pytest.approx(m.relative_rmse())
+        sel = report.partition_selection_metrics
+        assert sel is not None
+        assert sel.num_partitions == (
+            agg.partition_selection_metrics.num_partitions)
+        assert sel.dropped_partitions.mean == (
+            agg.partition_selection_metrics.dropped_partitions_expected)
+
+    def test_l1_gaussian_identity(self):
+        # Zero bias: E|N(0, s^2)| = s*sqrt(2/pi).
+        from pipelinedp_tpu.analysis.metrics import _value_errors
+        agg = self._analysis()
+        m = agg.count_metrics
+        import dataclasses as dc
+        m0 = dc.replace(m, error_expected=0.0, rel_error_expected=0.0)
+        v = _value_errors(m0, relative=False)
+        assert v.l1 == pytest.approx(
+            math.sqrt(m0.error_variance) * math.sqrt(2 / math.pi))
