@@ -165,7 +165,6 @@ class AggregateParams:
     pre_threshold: Optional[int] = None
     public_partitions_already_filtered: bool = False
     custom_combiners: Optional[Sequence] = None
-    output_noise_stddev: bool = False
 
     @property
     def metrics_str(self) -> str:
