@@ -253,3 +253,145 @@ class TestCompoundFactory:
             params, acc, params.custom_combiners)
         out = compound.compute_metrics(compound.create_accumulator([1, 2]))
         assert out == (3,)
+
+
+class TestCombinerMatrix:
+    """Parameterized create/merge/compute matrix over every scalar
+    combiner — the reference's per-combiner case tables
+    (``tests/combiners_test.py:160-628``), at huge eps so computed
+    metrics pin to the exact bounded aggregates."""
+
+    @pytest.mark.parametrize("values,expected", [
+        ([], 0), ([1], 1), ([1, 2], 2),
+        # Linf capping is the BOUNDER's job; the combiner counts its input.
+        ([1, 2, 3, 4, 5], 5),
+    ])
+    def test_count_create(self, values, expected):
+        c = combiners.CountCombiner(combiner_params(make_params(
+            [Metrics.COUNT])))
+        assert c.create_accumulator(values) == expected
+
+    @pytest.mark.parametrize("accs,expected", [
+        ([0, 0], 0), ([1, 2], 3), ([3, 3, 3], 9),
+    ])
+    def test_count_merge_associative(self, accs, expected):
+        c = combiners.CountCombiner(combiner_params(make_params(
+            [Metrics.COUNT])))
+        total = accs[0]
+        for a in accs[1:]:
+            total = c.merge_accumulators(total, a)
+        assert total == expected
+        assert c.compute_metrics(expected)["count"] == pytest.approx(
+            expected, abs=0.01)
+
+    @pytest.mark.parametrize("values,bounds,expected", [
+        ([1.0, 2.0], (0.0, 10.0), 3.0),
+        ([-5.0, 20.0], (0.0, 10.0), 10.0),     # clip both ends
+        ([-5.0, -7.0], (-6.0, 0.0), -11.0),    # negative bounds
+        ([], (0.0, 10.0), 0.0),
+    ])
+    def test_sum_per_value_clip(self, values, bounds, expected):
+        c = combiners.SumCombiner(combiner_params(make_params(
+            [Metrics.SUM], min_value=bounds[0], max_value=bounds[1])))
+        acc = c.create_accumulator(values)
+        assert acc == pytest.approx(expected)
+        assert c.compute_metrics(acc)["sum"] == pytest.approx(expected,
+                                                             abs=0.01)
+
+    @pytest.mark.parametrize("values,expected_count,expected_mean", [
+        ([4.0, 6.0], 2, 5.0),
+        ([0.0], 1, 0.0),
+        ([10.0, 10.0, 10.0], 3, 10.0),
+    ])
+    def test_mean_normalized_sum_roundtrip(self, values, expected_count,
+                                           expected_mean):
+        params = make_params([Metrics.MEAN, Metrics.COUNT],
+                             max_contributions_per_partition=5)
+        c = combiners.MeanCombiner(combiner_params(params),
+                                   ["mean", "count"])
+        acc = c.create_accumulator(values)
+        out = c.compute_metrics(acc)
+        assert out["count"] == pytest.approx(expected_count, abs=0.01)
+        assert out["mean"] == pytest.approx(expected_mean, abs=0.01)
+
+    def test_mean_merge_matches_pooled(self):
+        params = make_params([Metrics.MEAN])
+        c = combiners.MeanCombiner(combiner_params(params), ["mean"])
+        a = c.create_accumulator([2.0, 4.0])
+        b = c.create_accumulator([6.0])
+        merged = c.merge_accumulators(a, b)
+        assert c.compute_metrics(merged)["mean"] == pytest.approx(4.0,
+                                                                  abs=0.01)
+
+    def test_variance_matches_numpy(self):
+        rng = np.random.default_rng(0)
+        vals = rng.uniform(0, 10, 50).tolist()
+        params = make_params([Metrics.VARIANCE],
+                             max_contributions_per_partition=100)
+        c = combiners.VarianceCombiner(combiner_params(params),
+                                       ["variance"])
+        out = c.compute_metrics(c.create_accumulator(vals))
+        assert out["variance"] == pytest.approx(np.var(vals), rel=0.02)
+
+    def test_variance_merge_matches_pooled(self):
+        params = make_params([Metrics.VARIANCE],
+                             max_contributions_per_partition=100)
+        c = combiners.VarianceCombiner(combiner_params(params),
+                                       ["variance"])
+        a = c.create_accumulator([1.0, 2.0, 3.0])
+        b = c.create_accumulator([7.0, 8.0])
+        out = c.compute_metrics(c.merge_accumulators(a, b))
+        assert out["variance"] == pytest.approx(
+            np.var([1.0, 2.0, 3.0, 7.0, 8.0]), rel=0.05, abs=0.05)
+
+    def test_privacy_id_count_merge(self):
+        c = combiners.PrivacyIdCountCombiner(combiner_params(make_params(
+            [Metrics.PRIVACY_ID_COUNT])))
+        accs = [c.create_accumulator(v) for v in ([1], [], [2, 3], [4])]
+        total = accs[0]
+        for a in accs[1:]:
+            total = c.merge_accumulators(total, a)
+        # Empty creates count 0; non-empty count 1 privacy unit each.
+        assert total == 3
+
+    def test_vector_sum_norm_modes(self):
+        for kind, raw, expected in [
+            (NormKind.Linf, [3.0, -4.0], [2.0, -2.0]),
+            (NormKind.L2, [3.0, 4.0], [1.2, 1.6]),  # scale to norm 2
+        ]:
+            params = make_params(
+                [Metrics.VECTOR_SUM], min_value=None, max_value=None,
+                vector_size=2, vector_max_norm=2.0, vector_norm_kind=kind)
+            c = combiners.VectorSumCombiner(combiner_params(params))
+            acc = c.create_accumulator([np.array(raw)])
+            out = c.compute_metrics(acc)["vector_sum"]
+            np.testing.assert_allclose(out, expected, atol=0.05)
+
+    def test_quantile_tree_accumulator_is_mergeable_any_order(self):
+        params = make_params([Metrics.PERCENTILE(50)],
+                             max_contributions_per_partition=100)
+        c = combiners.QuantileCombiner(combiner_params(params), [50])
+        chunks = [[1.0, 2.0], [8.0, 9.0], [5.0]]
+        accs = [c.create_accumulator(ch) for ch in chunks]
+        left = c.merge_accumulators(c.merge_accumulators(accs[0], accs[1]),
+                                    accs[2])
+        right = c.merge_accumulators(accs[0], c.merge_accumulators(
+            accs[1], accs[2]))
+        m_l = c.compute_metrics(left)
+        m_r = c.compute_metrics(right)
+        assert m_l["percentile_50"] == pytest.approx(m_r["percentile_50"],
+                                                     abs=0.2)
+
+    def test_compound_merge_merges_children_fieldwise(self):
+        params = make_params([Metrics.COUNT, Metrics.SUM])
+        acc = budget_accounting.NaiveBudgetAccountant(total_epsilon=1e5,
+                                                      total_delta=1e-10)
+        compound = combiners.create_compound_combiner(params, acc)
+        acc.compute_budgets()
+        a = compound.create_accumulator([1.0, 2.0])
+        b = compound.create_accumulator([3.0])
+        row_count, children = compound.merge_accumulators(a, b)
+        assert row_count == 2  # two creates -> two privacy-unit rows
+        out = compound.compute_metrics((row_count, children))
+        assert out.count == pytest.approx(3, abs=0.01)
+        assert out.sum == pytest.approx(6.0, abs=0.01)
