@@ -24,6 +24,16 @@ def combiner_params(agg_params, eps=1e5, delta=1e-10):
     return combiners.CombinerParams(spec, agg_params)
 
 
+def make_compound(metrics=(Metrics.COUNT, Metrics.SUM)):
+    """COUNT+SUM compound with budgets already computed (huge eps)."""
+    params = make_params(list(metrics))
+    acc = budget_accounting.NaiveBudgetAccountant(total_epsilon=1e5,
+                                                  total_delta=1e-10)
+    compound = combiners.create_compound_combiner(params, acc)
+    acc.compute_budgets()
+    return compound
+
+
 class TestCountCombiner:
 
     def test_create_merge_compute(self):
@@ -158,12 +168,7 @@ class TestVectorSumCombiner:
 class TestCompoundCombiner:
 
     def _compound(self):
-        params = make_params([Metrics.COUNT, Metrics.SUM])
-        acc = budget_accounting.NaiveBudgetAccountant(total_epsilon=1e5,
-                                                      total_delta=1e-10)
-        compound = combiners.create_compound_combiner(params, acc)
-        acc.compute_budgets()
-        return compound
+        return make_compound()
 
     def test_row_count_tracks_creates(self):
         compound = self._compound()
@@ -272,15 +277,15 @@ class TestCombinerMatrix:
         assert c.create_accumulator(values) == expected
 
     @pytest.mark.parametrize("accs,expected", [
-        ([0, 0], 0), ([1, 2], 3), ([3, 3, 3], 9),
+        ([0, 0, 0], 0), ([1, 2, 4], 7), ([3, 3, 3], 9),
     ])
     def test_count_merge_associative(self, accs, expected):
         c = combiners.CountCombiner(combiner_params(make_params(
             [Metrics.COUNT])))
-        total = accs[0]
-        for a in accs[1:]:
-            total = c.merge_accumulators(total, a)
-        assert total == expected
+        a, b, d = accs
+        left = c.merge_accumulators(c.merge_accumulators(a, b), d)
+        right = c.merge_accumulators(a, c.merge_accumulators(b, d))
+        assert left == right == expected
         assert c.compute_metrics(expected)["count"] == pytest.approx(
             expected, abs=0.01)
 
@@ -354,18 +359,18 @@ class TestCombinerMatrix:
         # Empty creates count 0; non-empty count 1 privacy unit each.
         assert total == 3
 
-    def test_vector_sum_norm_modes(self):
-        for kind, raw, expected in [
-            (NormKind.Linf, [3.0, -4.0], [2.0, -2.0]),
-            (NormKind.L2, [3.0, 4.0], [1.2, 1.6]),  # scale to norm 2
-        ]:
-            params = make_params(
-                [Metrics.VECTOR_SUM], min_value=None, max_value=None,
-                vector_size=2, vector_max_norm=2.0, vector_norm_kind=kind)
-            c = combiners.VectorSumCombiner(combiner_params(params))
-            acc = c.create_accumulator([np.array(raw)])
-            out = c.compute_metrics(acc)["vector_sum"]
-            np.testing.assert_allclose(out, expected, atol=0.05)
+    @pytest.mark.parametrize("kind,raw,expected", [
+        (NormKind.Linf, [3.0, -4.0], [2.0, -2.0]),
+        (NormKind.L2, [3.0, 4.0], [1.2, 1.6]),  # scale to norm 2
+    ])
+    def test_vector_sum_norm_modes(self, kind, raw, expected):
+        params = make_params(
+            [Metrics.VECTOR_SUM], min_value=None, max_value=None,
+            vector_size=2, vector_max_norm=2.0, vector_norm_kind=kind)
+        c = combiners.VectorSumCombiner(combiner_params(params))
+        acc = c.create_accumulator([np.array(raw)])
+        out = c.compute_metrics(acc)["vector_sum"]
+        np.testing.assert_allclose(out, expected, atol=0.05)
 
     def test_quantile_tree_accumulator_is_mergeable_any_order(self):
         params = make_params([Metrics.PERCENTILE(50)],
@@ -383,11 +388,7 @@ class TestCombinerMatrix:
                                                      abs=0.2)
 
     def test_compound_merge_merges_children_fieldwise(self):
-        params = make_params([Metrics.COUNT, Metrics.SUM])
-        acc = budget_accounting.NaiveBudgetAccountant(total_epsilon=1e5,
-                                                      total_delta=1e-10)
-        compound = combiners.create_compound_combiner(params, acc)
-        acc.compute_budgets()
+        compound = make_compound()
         a = compound.create_accumulator([1.0, 2.0])
         b = compound.create_accumulator([3.0])
         row_count, children = compound.merge_accumulators(a, b)
