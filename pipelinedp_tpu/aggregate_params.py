@@ -168,7 +168,10 @@ class AggregateParams:
 
     @property
     def metrics_str(self) -> str:
-        return f"[{', '.join(str(m) for m in self.metrics)}]"
+        if self.custom_combiners:
+            names = [c.metrics_names() for c in self.custom_combiners]
+            return f"custom combiners={names}"
+        return f"[{', '.join(str(m) for m in self.metrics or [])}]"
 
     @property
     def bounds_per_contribution_are_set(self) -> bool:
@@ -202,6 +205,9 @@ class AggregateParams:
                 raise ValueError(
                     "custom_combiners are set, 'metrics' must not be set")
             return
+        if not self.metrics:
+            raise ValueError(
+                "metrics must be set (or provide custom_combiners)")
 
         self._validate_metrics()
         self._validate_value_bounds()
@@ -256,9 +262,12 @@ class AggregateParams:
                                 "max_contributions_per_partition")
 
     def _validate_value_bounds(self):
+        # metrics may be None (e.g. params destined for custom combiners,
+        # reference aggregate_params.py:201 guards every use the same way).
+        metrics = self.metrics or []
         needs_values = any(
             m in (Metrics.SUM, Metrics.MEAN, Metrics.VARIANCE) or
-            m.is_percentile for m in self.metrics)
+            m.is_percentile for m in metrics)
         has_pair = self.bounds_per_contribution_are_set
         has_sum_pair = self.bounds_per_partition_are_set
         if (self.min_value is None) != (self.max_value is None):
@@ -272,7 +281,7 @@ class AggregateParams:
                 "set either (min_value, max_value) or "
                 "(min_sum_per_partition, max_sum_per_partition), not both")
         if has_sum_pair and any(
-                m in (Metrics.MEAN, Metrics.VARIANCE) for m in self.metrics):
+                m in (Metrics.MEAN, Metrics.VARIANCE) for m in metrics):
             raise ValueError(
                 "per-partition sum bounds support only SUM, not MEAN/VARIANCE")
         if needs_values and not (has_pair or has_sum_pair):
@@ -290,7 +299,7 @@ class AggregateParams:
                 raise ValueError(f"min_{what} must be <= max_{what}")
 
     def _validate_vector_params(self):
-        if Metrics.VECTOR_SUM not in self.metrics:
+        if Metrics.VECTOR_SUM not in (self.metrics or []):
             return
         if self.vector_size is None or self.vector_size <= 0:
             raise ValueError("vector_size must be a positive int for "

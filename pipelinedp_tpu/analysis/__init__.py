@@ -28,6 +28,7 @@ from pipelinedp_tpu.analysis.parameter_tuning import (
     ParametersToTune,
     TuneOptions,
     TuneResult,
+    UtilityAnalysisRun,
     tune,
 )
 from pipelinedp_tpu.analysis.pre_aggregation import preaggregate

@@ -19,6 +19,18 @@ from pipelinedp_tpu.analysis import (data_structures, histograms, metrics,
 QUANTILES_TO_USE = [0.9, 0.95, 0.98, 0.99, 0.995]
 
 
+@dataclass
+class UtilityAnalysisRun:
+    """One executed utility analysis: the options it ran with and the
+    aggregate error metrics it produced. Public result-record type for
+    callers pairing sweep inputs with outputs; like the reference, the
+    tuning flow itself returns ``TuneResult`` and never constructs this
+    (reference ``analysis/parameter_tuning.py:31-34``,
+    ``analysis/__init__.py:26``)."""
+    params: data_structures.UtilityAnalysisOptions
+    result: metrics.AggregateErrorMetrics
+
+
 class MinimizingFunction(Enum):
     ABSOLUTE_ERROR = "absolute_error"
     RELATIVE_ERROR = "relative_error"

@@ -126,6 +126,22 @@ def _add_random_noise(value: ArrayLike, eps: float, delta: float,
     return result if shape else float(result)
 
 
+def apply_laplace_mechanism(value: ArrayLike, eps: float,
+                            l1_sensitivity: float) -> ArrayLike:
+    """Releases ``value`` with Laplace noise of scale l1/eps
+    (reference ``dp_computations.py:111-124``); batched over arrays."""
+    return _add_random_noise(value, eps, 0.0, 1.0, l1_sensitivity,
+                             NoiseKind.LAPLACE)
+
+
+def apply_gaussian_mechanism(value: ArrayLike, eps: float, delta: float,
+                             l2_sensitivity: float) -> ArrayLike:
+    """Releases ``value`` with Gaussian noise at the optimal sigma for
+    (eps, delta) (reference ``dp_computations.py:127-143``)."""
+    return _add_random_noise(value, eps, delta, 1.0, l2_sensitivity,
+                             NoiseKind.GAUSSIAN)
+
+
 def equally_split_budget(eps: float, delta: float, no_mechanisms: int):
     """Splits (eps, delta) into ``no_mechanisms`` equal parts; the last part
     absorbs the floating-point residue so the shares sum exactly to the

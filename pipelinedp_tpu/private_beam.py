@@ -6,6 +6,7 @@ a ``PrivatePCollection`` that only releases DP aggregates through typed
 from __future__ import annotations
 
 import abc
+import dataclasses
 import typing
 from typing import Callable, Optional
 
@@ -218,21 +219,61 @@ class PrivateCombineFn(combiners.CustomCombiner, abc.ABC):
         return self.extract_private_output(accumulator, self._budget)
 
 
+@dataclasses.dataclass
+class CombinePerKeyParams:
+    """Contribution bounds + budget share for ``CombinePerKey``
+    (reference :586-605)."""
+    max_partitions_contributed: int
+    max_contributions_per_partition: int
+    budget_weight: float = 1
+    public_partitions: typing.Any = None
+
+
 class CombinePerKey(PrivatePTransform):
-    """Custom-combiner aggregation (reference :608-649)."""
+    """Custom-combiner aggregation over (key, value) elements
+    (reference :608-649). ``params`` may also be a full
+    ``AggregateParams`` carrying ``custom_combiners`` for callers that
+    need the extra knobs."""
 
     def __init__(self, combine_fn: PrivateCombineFn,
-                 combiner_params: agg.AggregateParams,
+                 params: typing.Union[CombinePerKeyParams,
+                                      agg.AggregateParams],
                  label: Optional[str] = None):
         super().__init__(return_anonymized=True, label=label)
         self._combine_fn = combine_fn
-        self._combiner_params = combiner_params
+        self._params = params
 
     def expand(self, pcol):
         engine = self._create_engine()
-        params = self._combiner_params
+        backend = _get_beam_backend()
+        public_partitions = None
+        if isinstance(self._params, CombinePerKeyParams):
+            p = self._params
+            public_partitions = p.public_partitions
+            params = agg.AggregateParams(
+                metrics=None,
+                max_partitions_contributed=p.max_partitions_contributed,
+                max_contributions_per_partition=(
+                    p.max_contributions_per_partition),
+                budget_weight=p.budget_weight,
+                custom_combiners=[self._combine_fn])
+        else:
+            params = self._params
+            if (not params.custom_combiners or
+                    self._combine_fn not in params.custom_combiners):
+                raise ValueError(
+                    "CombinePerKey got an AggregateParams whose "
+                    "custom_combiners do not include the combine_fn; the "
+                    "combiner would silently never run.")
         extractors = dp_engine_mod.DataExtractors(
             privacy_id_extractor=lambda row: row[0],
             partition_extractor=lambda row: row[1][0],
             value_extractor=lambda row: row[1][1])
-        return engine.aggregate(pcol, params, extractors)
+        result = engine.aggregate(pcol, params, extractors,
+                                  public_partitions)
+        if len(params.custom_combiners) == 1:
+            # Exactly one combiner -> unwrap its 1-element result tuple
+            # (reference :644-646); multi-combiner params keep the tuple.
+            result = backend.map_values(result, lambda v: v[0],
+                                        "Unnest tuple")
+        return result

@@ -328,6 +328,87 @@ class TestPrivateBeamOnFake:
         got = dict(sums)
         assert got["a"] == pytest.approx(120, abs=1.0)
 
+    def test_combine_per_key_with_private_combine_fn(self):
+        noise_ops.seed_host_rng(0)
+
+        class SumCombineFn(private_beam.PrivateCombineFn):
+
+            def create_accumulator_for_private_output(self):
+                return 0.0
+
+            def add_input_for_private_output(self, acc_, v):
+                return acc_ + min(v, 5.0)
+
+            def merge_accumulators(self, a, b):
+                return a + b
+
+            def extract_private_output(self, accumulator, budget):
+                return accumulator + noise_ops.np_laplace(
+                    5.0 / budget.eps)
+
+            def request_budget(self, budget_accountant):
+                self._budget = budget_accountant.request_budget(
+                    pdp.MechanismType.LAPLACE)
+
+            def explain_computation(self):
+                return "private sum via CombineFn"
+
+        p = beam.Pipeline()
+        data = [(u, ("a", 2.0)) for u in range(30)]
+        pcol = p | "create" >> beam.Create(data)
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=BIG_EPS,
+                                        total_delta=1e-2)
+        private = pcol | private_beam.MakePrivate(
+            budget_accountant=acc, privacy_id_extractor=lambda row: row[0])
+        # CombinePerKey consumes (key, value) elements.
+        private = private | private_beam.Map(lambda row: row[1])
+        out = private | private_beam.CombinePerKey(
+            SumCombineFn(),
+            private_beam.CombinePerKeyParams(
+                max_partitions_contributed=1,
+                max_contributions_per_partition=1))
+        acc.compute_budgets()
+        got = dict(out)
+        # Unnested: the value is the combiner's scalar, not a 1-tuple.
+        assert got["a"] == pytest.approx(60, abs=1.0)
+
+        # AggregateParams path: the combine_fn must appear in
+        # custom_combiners; a single combiner is unnested the same way.
+        fn = SumCombineFn()
+        p2 = beam.Pipeline()
+        pcol2 = p2 | "create2" >> beam.Create(data)
+        acc2 = pdp.NaiveBudgetAccountant(total_epsilon=BIG_EPS,
+                                         total_delta=1e-2)
+        private2 = pcol2 | private_beam.MakePrivate(
+            budget_accountant=acc2, privacy_id_extractor=lambda row: row[0])
+        private2 = private2 | private_beam.Map(lambda row: row[1])
+        out2 = private2 | private_beam.CombinePerKey(
+            fn,
+            pdp.AggregateParams(metrics=None,
+                                max_partitions_contributed=1,
+                                max_contributions_per_partition=1,
+                                custom_combiners=[fn]))
+        acc2.compute_budgets()
+        got2 = dict(out2)
+        assert got2["a"] == pytest.approx(60, abs=1.0)
+
+        # A params whose custom_combiners omit the combine_fn is an error.
+        other = SumCombineFn()
+        with pytest.raises(ValueError, match="combine_fn"):
+            bad = private2 | private_beam.CombinePerKey(
+                SumCombineFn(),
+                pdp.AggregateParams(metrics=None,
+                                    max_partitions_contributed=1,
+                                    max_contributions_per_partition=1,
+                                    custom_combiners=[other]))
+
+        # metrics=None without custom combiners is rejected at
+        # construction with a clear message.
+        with pytest.raises(ValueError, match="metrics must be set"):
+            pdp.AggregateParams(metrics=None,
+                                max_partitions_contributed=1,
+                                max_contributions_per_partition=1)
+
 
 @pytest.mark.skipif(HAVE_SPARK, reason="fluent fake-spark flow")
 class TestPrivateSparkOnFake:

@@ -39,6 +39,39 @@ class TestHelpers:
             dpc.equally_split_budget(1.0, 0.0, 0)
 
 
+class TestApplyMechanisms:
+    """Public single-release helpers (reference dp_computations.py:111-143)."""
+
+    def test_laplace_big_eps_near_identity(self):
+        noise_ops.seed_host_rng(0)
+        assert dpc.apply_laplace_mechanism(42.0, 1e6, 3.0) == pytest.approx(
+            42.0, abs=1e-3)
+
+    def test_laplace_std(self):
+        noise_ops.seed_host_rng(0)
+        draws = np.array([
+            dpc.apply_laplace_mechanism(0.0, 1.0, 2.0) for _ in range(20000)
+        ])
+        # b = l1/eps = 2 -> std = 2*sqrt(2).
+        assert np.std(draws) == pytest.approx(2 * math.sqrt(2), rel=0.05)
+
+    def test_gaussian_std_matches_compute_sigma(self):
+        noise_ops.seed_host_rng(0)
+        sigma = dpc.compute_sigma(1.0, 1e-6, 2.0)
+        draws = np.array([
+            dpc.apply_gaussian_mechanism(0.0, 1.0, 1e-6, 2.0)
+            for _ in range(20000)
+        ])
+        assert np.std(draws) == pytest.approx(sigma, rel=0.05)
+
+    def test_batched(self):
+        noise_ops.seed_host_rng(0)
+        vals = np.zeros(5000)
+        got = dpc.apply_laplace_mechanism(vals, 1.0, 1.0)
+        assert got.shape == (5000,)
+        assert np.std(got) == pytest.approx(math.sqrt(2), rel=0.1)
+
+
 class TestCount:
 
     def test_big_eps_deterministic(self):
