@@ -258,34 +258,53 @@ def pad_and_put(encoded: EncodedData, vector_size: Optional[int],
     """One batched h2d transfer of the exact-size encoded columns; padding
     happens on device and the padding mask is derived from a scalar — the
     (slow, high-latency) host link moves only real rows in a single round
-    trip. Id columns whose values fit ship as uint16 (the link runs at
-    tens of MB/s; halving bytes halves the wall time) and widen back on
-    device. ``with_values=False`` skips the value column entirely (COUNT
-    -style aggregations never read it). Returns (pid, pk, values, valid)
-    padded to a power of two."""
+    trip. Id columns ship at their minimal byte width (the link runs at
+    tens of MB/s, so bytes ARE wall time): uint16 when the ids fit,
+    3xuint8 planes for ids in [2^16, 2^24) — dense-factorized vocabularies
+    routinely land there — widened back to int32 on device.
+    ``with_values=False`` skips the value column entirely (COUNT-style
+    aggregations never read it). Returns (pid, pk, values, valid) padded
+    to a power of two."""
     n = encoded.n_rows
     n_pad = _pad_pow2(max(n, 1))
 
     def narrow(arr):
         # encode() guarantees non-negative ids.
-        if arr.size and int(arr.max()) < (1 << 16):
-            return arr.astype(np.uint16)
-        return arr
+        if not arr.size:
+            return (arr,)
+        mx = int(arr.max())
+        if mx < (1 << 16):
+            return (arr.astype(np.uint16),)
+        if mx < (1 << 24):
+            a32 = arr.astype(np.uint32)
+            return (a32.astype(np.uint8), (a32 >> 8).astype(np.uint8),
+                    (a32 >> 16).astype(np.uint8))
+        return (arr,)
 
-    host = [narrow(encoded.pid), narrow(encoded.pk)]
+    def widen(planes) -> jnp.ndarray:
+        if len(planes) == 1:
+            return planes[0].astype(jnp.int32)
+        b0, b1, b2 = (p.astype(jnp.int32) for p in planes)
+        return b0 | (b1 << 8) | (b2 << 16)
+
+    pid_planes = narrow(encoded.pid)
+    pk_planes = narrow(encoded.pk)
+    host = list(pid_planes) + list(pk_planes)
     if with_values:
         host.append(encoded.values)
     dev = jax.device_put(tuple(host))
-    pid = jnp.zeros(n_pad, jnp.int32).at[:n].set(dev[0].astype(jnp.int32))
-    pk = jnp.zeros(n_pad, jnp.int32).at[:n].set(dev[1].astype(jnp.int32))
+    n_pid = len(pid_planes)
+    pid = jnp.zeros(n_pad, jnp.int32).at[:n].set(widen(dev[:n_pid]))
+    pk = jnp.zeros(n_pad, jnp.int32).at[:n].set(
+        widen(dev[n_pid:n_pid + len(pk_planes)]))
     if vector_size:
         values = jnp.zeros((n_pad, vector_size), jnp.float32)
         if with_values:
-            values = values.at[:n].set(dev[2])
+            values = values.at[:n].set(dev[-1])
     else:
         values = jnp.zeros(n_pad, jnp.float32)
         if with_values:
-            values = values.at[:n].set(dev[2])
+            values = values.at[:n].set(dev[-1])
     valid = jnp.arange(n_pad) < n
     return pid, pk, values, valid
 

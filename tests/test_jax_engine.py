@@ -41,6 +41,53 @@ def count_params(**kw):
     return pdp.AggregateParams(**base)
 
 
+class TestWideIdPacking:
+    """Ids >= 2^16 ship as 3xuint8 planes over the host link; the pack /
+    widen round trip must be exact at every width boundary."""
+
+    @pytest.mark.parametrize("top", [(1 << 16) - 1, 1 << 16, (1 << 16) + 1,
+                                     (1 << 24) - 1, 1 << 24])
+    def test_roundtrip_at_boundaries(self, top):
+        from pipelinedp_tpu import jax_engine as je
+        ids = np.array([0, 1, 7, top - 1, top], np.int64)
+        enc = je.EncodedData(pid=ids.astype(np.int64),
+                             pk=np.arange(len(ids), dtype=np.int32),
+                             values=np.zeros(len(ids), np.float32),
+                             pk_vocab=list(range(len(ids))),
+                             n_rows=len(ids))
+        pid, pk, _, valid = je.pad_and_put(enc, None)
+        got = np.asarray(pid)[:len(ids)]
+        np.testing.assert_array_equal(got, ids)
+        assert np.asarray(valid)[:len(ids)].all()
+
+    def test_wide_ids_match_oracle(self):
+        # pids and pks both above 2^16: the fused result must equal the
+        # LocalBackend oracle partition by partition (caps never bind).
+        rng = np.random.default_rng(5)
+        n = 4000
+        pid = rng.integers(70_000, 120_000, n)
+        pk = rng.integers(0, 300, n) + 100_000
+        vals = rng.uniform(0, 10, n)
+        ds = pdp.ArrayDataset(privacy_ids=pid, partition_keys=pk,
+                              values=vals)
+        public = sorted(np.unique(pk).tolist())
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            max_partitions_contributed=20,
+            max_contributions_per_partition=20,
+            min_value=0.0, max_value=10.0)
+        fused = run(JaxBackend(rng_seed=0), ds, params,
+                    public_partitions=public, eps=1e6,
+                    ext=pdp.DataExtractors())
+        local = run(pdp.LocalBackend(), ds, params,
+                    public_partitions=public, eps=1e6,
+                    ext=pdp.DataExtractors())
+        assert set(fused) == set(local) == set(public)
+        for k in public:
+            assert round(fused[k].count) == round(local[k].count), k
+            assert fused[k].sum == pytest.approx(local[k].sum, abs=0.5), k
+
+
 class TestDifferentialVsLocal:
 
     def test_count(self):
