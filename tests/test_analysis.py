@@ -60,6 +60,84 @@ class TestPoissonBinomial:
         assert pmf.probabilities.tolist() == [1.0]
 
 
+class TestProbabilityComputations:
+    """MC quantiles of the Laplace+Gaussian convolution vs analytically
+    computed expectations (reference
+    ``analysis/tests/probability_computations_test.py``: the expected
+    values there are derived analytically to 1e-10; the distribution is
+    symmetric, so q and 1-q must be mirror images)."""
+
+    @pytest.mark.parametrize("b,sigma,qs,expected", [
+        (1.0, 2.0, [0.1, 0.5, 0.9], [-3.0874, 0.0, 3.0874]),
+        (1.01, 0.55, [0.5, 0.7, 0.9, 0.99],
+         [0.0, 0.63892, 1.77515, 4.10093]),
+    ])
+    def test_quantiles_match_analytic(self, b, sigma, qs, expected):
+        from pipelinedp_tpu.analysis import probability_computations as pc
+        got = pc.compute_sum_laplace_gaussian_quantiles(
+            b, sigma, qs, 4 * 10**6, rng=np.random.default_rng(0))
+        np.testing.assert_allclose(got, expected, atol=0.02)
+
+    def test_symmetry(self):
+        from pipelinedp_tpu.analysis import probability_computations as pc
+        got = pc.compute_sum_laplace_gaussian_quantiles(
+            2.0, 1.0, [0.05, 0.25, 0.75, 0.95], 10**6,
+            rng=np.random.default_rng(1))
+        assert got[0] == pytest.approx(-got[3], abs=0.05)
+        assert got[1] == pytest.approx(-got[2], abs=0.05)
+
+    def test_batch_matches_scalar(self):
+        from pipelinedp_tpu.analysis import probability_computations as pc
+        qs = [0.1, 0.5, 0.9]
+        batch = pc.compute_sum_laplace_gaussian_quantiles_batch(
+            np.array([1.0, 3.0]), np.array([2.0, 0.5]), qs, 10**6,
+            rng=np.random.default_rng(2))
+        for i, (b, s) in enumerate([(1.0, 2.0), (3.0, 0.5)]):
+            scalar = pc.compute_sum_laplace_gaussian_quantiles(
+                b, s, qs, 10**6, rng=np.random.default_rng(3))
+            np.testing.assert_allclose(batch[i], scalar, atol=0.05)
+
+
+class TestAnalysisContributionBounders:
+    """The analysis bounders record, not enforce (reference
+    ``analysis/tests/contribution_bounders_test.py``)."""
+
+    def _bound(self, rows, prob=1.0):
+        from pipelinedp_tpu.analysis.contribution_bounders import (
+            SamplingL0LinfContributionBounder)
+        backend = pdp.LocalBackend()
+        out = SamplingL0LinfContributionBounder(prob).bound_contributions(
+            rows, count_params(), backend, None, lambda x: x)
+        return dict(out)
+
+    def test_emits_count_sum_npartitions_per_pid_pk(self):
+        rows = [("u1", "a", 1.0), ("u1", "a", 2.0), ("u1", "b", 5.0),
+                ("u2", "a", 7.0)]
+        got = self._bound(rows)
+        # No bounding happens regardless of tiny caps in params.
+        assert got[("u1", "a")] == (2, 3.0, 2)
+        assert got[("u1", "b")] == (1, 5.0, 2)
+        assert got[("u2", "a")] == (1, 7.0, 1)
+
+    def test_n_partitions_counts_pre_sampling_partitions(self):
+        # Partition sampling drops partitions deterministically but
+        # n_partitions still reflects the privacy id's full spread.
+        rows = [("u1", pk, 1.0) for pk in range(200)]
+        got = self._bound(rows, prob=0.5)
+        assert 0 < len(got) < 200  # some partitions sampled away
+        assert all(v == (1, 1.0, 200) for v in got.values())
+        # Deterministic: same keys kept on a second run.
+        assert got == self._bound(rows, prob=0.5)
+
+    def test_noop_bounder_preaggregated(self):
+        from pipelinedp_tpu.analysis.contribution_bounders import (
+            NoOpContributionBounder)
+        rows = [("a", (2, 3.0, 4)), ("b", (1, 1.0, 4))]
+        out = dict(NoOpContributionBounder().bound_contributions(
+            rows, count_params(), pdp.LocalBackend(), None, lambda x: x))
+        assert out == {(None, "a"): (2, 3.0, 4), (None, "b"): (1, 1.0, 4)}
+
+
 class TestMultiParameterConfiguration:
 
     def test_validation(self):
