@@ -215,6 +215,24 @@ def _int_factorize(arr: np.ndarray):
     return uniq, lookup[offs]
 
 
+def _unique_inverse(arr: np.ndarray):
+    """``np.unique(arr, return_inverse=True)`` with the native hash
+    factorizer (``native/encode.cc``: O(N + U log U) vs the full O(N log N)
+    sort) when the toolchain can build it; inverse always int32."""
+    if (arr.dtype.kind in "iu" and arr.dtype.itemsize <= 8 and
+            not (arr.dtype.kind == "u" and arr.size and
+                 int(arr.max()) > np.iinfo(np.int64).max)):
+        try:
+            from pipelinedp_tpu import native
+            if native.encode_available():
+                uniq, inv = native.factorize_i64(arr)
+                return uniq.astype(arr.dtype), inv
+        except Exception:  # never let the fast path break ingest
+            pass
+    uniq, inv = np.unique(arr, return_inverse=True)
+    return uniq, inv.astype(np.int32)
+
+
 def _pid_ids(pid_arr: np.ndarray) -> np.ndarray:
     """int32 ids for privacy units: any injective mapping works (the kernel
     only groups by equality), so in-range integer ids pass through without
@@ -225,8 +243,7 @@ def _pid_ids(pid_arr: np.ndarray) -> np.ndarray:
     fac = _int_factorize(pid_arr)
     if fac is not None:
         return fac[1]
-    _, pid_idx = np.unique(pid_arr, return_inverse=True)
-    return pid_idx.astype(np.int32)
+    return _unique_inverse(pid_arr)[1]
 
 
 def array_dataset_to_rows(ds: ArrayDataset, data_extractors,
@@ -341,8 +358,7 @@ def _encode_arrays(ds: ArrayDataset, vector_size: Optional[int],
         if fac is not None:
             uniq, pk_idx = fac
         else:
-            uniq, pk_idx = np.unique(pk_arr, return_inverse=True)
-            pk_idx = pk_idx.astype(np.int32)
+            uniq, pk_idx = _unique_inverse(pk_arr)
         pk_vocab = list(uniq.tolist())
     pid_idx = _pid_ids(pid_arr)
     if vector_size:

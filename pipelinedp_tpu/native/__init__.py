@@ -51,19 +51,25 @@ def _cache_dir() -> str:
     return d if os.access(d, os.W_OK) else tempfile.gettempdir()
 
 
-def _build() -> str:
-    out = os.path.join(_cache_dir(), "_secure_noise.so")
+def _build_shared_lib(src: str, out_name: str) -> str:
+    """Compile ``src`` into the cache dir on first use (mtime-checked)."""
+    out = os.path.join(_cache_dir(), out_name)
     if (os.path.exists(out) and
-            os.path.getmtime(out) >= os.path.getmtime(_SRC)):
+            os.path.getmtime(out) >= os.path.getmtime(src)):
         return out
     tmp = out + f".tmp{os.getpid()}"
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src, "-o", tmp]
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
         raise NativeUnavailableError(
-            f"g++ failed building secure_noise: {proc.stderr[-500:]}")
+            f"g++ failed building {os.path.basename(src)}: "
+            f"{proc.stderr[-500:]}")
     os.replace(tmp, out)  # atomic: concurrent builders race harmlessly
     return out
+
+
+def _build() -> str:
+    return _build_shared_lib(_SRC, "_secure_noise.so")
 
 
 def _lib() -> ctypes.CDLL:
@@ -180,3 +186,76 @@ def uniform(n: int) -> np.ndarray:
     _lib().sn_uniform(out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
                       n)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Ingest acceleration: hash-based integer factorization (encode.cc)
+# ---------------------------------------------------------------------------
+
+_ENC_SRC = os.path.join(os.path.dirname(__file__), "encode.cc")
+_ENC_LIB: Optional[ctypes.CDLL] = None
+_ENC_ERROR: Optional[str] = None
+
+
+def _enc_lib() -> ctypes.CDLL:
+    global _ENC_LIB, _ENC_ERROR
+    if _ENC_LIB is not None:
+        return _ENC_LIB
+    if _ENC_ERROR is not None:
+        raise NativeUnavailableError(_ENC_ERROR)
+    with _LOCK:
+        if _ENC_LIB is not None:
+            return _ENC_LIB
+        try:
+            lib = ctypes.CDLL(_build_shared_lib(_ENC_SRC, "_encode.so"))
+        except (OSError, NativeUnavailableError) as e:
+            _ENC_ERROR = str(e)
+            raise NativeUnavailableError(_ENC_ERROR) from e
+        lib.pdp_factorize_i64.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64)]
+        lib.pdp_factorize_i64.restype = ctypes.c_int64
+        _ENC_LIB = lib
+        return _ENC_LIB
+
+
+def encode_available() -> bool:
+    """True when the native factorizer can be (or was) built and loaded."""
+    try:
+        _enc_lib()
+        return True
+    except NativeUnavailableError:
+        return False
+
+
+def factorize_i64(arr: np.ndarray):
+    """``np.unique(arr, return_inverse=True)`` for integer arrays, via a
+    grow-as-needed open-addressing hash: O(N + U log U) instead of the
+    full O(N log N) sort — the ingest hot path when the vocabulary is
+    (much) smaller than the data, which keyed DP datasets are. When an
+    early sample finds mostly-distinct keys the C++ side bails and this
+    falls back to np.unique, which wins that regime. Returns
+    (sorted uniques int64, inverse int32); bit-identical to np.unique."""
+    arr = np.asarray(arr)
+    if (arr.dtype.kind == "u" and arr.size and
+            int(arr.max()) > np.iinfo(np.int64).max):
+        raise ValueError(
+            "factorize_i64: uint64 values above int64 max would wrap; "
+            "use np.unique for this input")
+    flat = np.ascontiguousarray(arr, dtype=np.int64).ravel()
+    n = flat.size
+    inverse = np.empty(n, dtype=np.int32)
+    uniq = np.empty(n, dtype=np.int64)
+    if n == 0:
+        return uniq[:0], inverse
+    u = _enc_lib().pdp_factorize_i64(
+        flat.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+        inverse.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        uniq.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    if u == -2:  # mostly-distinct sample: sort-based wins
+        nu, ni = np.unique(flat, return_inverse=True)
+        return nu, ni.astype(np.int32)
+    if u < 0:
+        raise NativeUnavailableError(
+            "pdp_factorize_i64 failed (allocation or id overflow)")
+    return uniq[:u].copy(), inverse

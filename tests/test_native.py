@@ -142,3 +142,54 @@ class TestHostPathWiring:
     def test_disabled_by_default(self):
         from pipelinedp_tpu.ops import noise as noise_ops
         assert not noise_ops.secure_host_noise_enabled()
+
+
+class TestFactorize:
+    """The native hash factorizer must be bit-identical to
+    np.unique(return_inverse=True)."""
+
+    @pytest.mark.skipif(not native.encode_available(),
+                        reason="native toolchain unavailable")
+    @pytest.mark.parametrize("gen", [
+        lambda rng: rng.integers(-1000, 1000, 10_000),
+        lambda rng: rng.integers(0, 2**62, 10_000),       # wide range
+        lambda rng: rng.integers(0, 50, 100_000),         # heavy duplicates
+        lambda rng: rng.integers(0, 2**62, 2_000_000),    # big + wide
+        lambda rng: np.array([7]),                        # single element
+        lambda rng: np.array([5, 5, 5, 5]),               # one unique
+    ])
+    def test_matches_np_unique(self, gen):
+        rng = np.random.default_rng(0)
+        arr = gen(rng).astype(np.int64)
+        uniq, inv = native.factorize_i64(arr)
+        exp_uniq, exp_inv = np.unique(arr, return_inverse=True)
+        np.testing.assert_array_equal(uniq, exp_uniq)
+        np.testing.assert_array_equal(inv, exp_inv)
+        np.testing.assert_array_equal(uniq[inv], arr)
+
+    @pytest.mark.skipif(not native.encode_available(),
+                        reason="native toolchain unavailable")
+    def test_empty(self):
+        uniq, inv = native.factorize_i64(np.array([], np.int64))
+        assert uniq.size == 0 and inv.size == 0
+
+    @pytest.mark.skipif(not native.encode_available(),
+                        reason="native toolchain unavailable")
+    def test_uint64_above_int64_max_rejected(self):
+        with pytest.raises(ValueError, match="wrap"):
+            native.factorize_i64(np.array([2**63 + 5, 3], np.uint64))
+
+    def test_unique_inverse_helper_matches(self):
+        # The engine helper must agree with np.unique regardless of
+        # whether the native path engaged.
+        from pipelinedp_tpu.jax_engine import _unique_inverse
+        rng = np.random.default_rng(1)
+        for arr in (rng.integers(0, 2**40, 50_000),
+                    rng.integers(-5, 5, 1000).astype(np.int32),
+                    np.array([2**63 + 5, 3, 2**63 + 5], np.uint64),
+                    rng.random(1000)):  # float: always numpy path
+            uniq, inv = _unique_inverse(np.asarray(arr))
+            exp_u, exp_i = np.unique(arr, return_inverse=True)
+            np.testing.assert_array_equal(uniq, exp_u)
+            np.testing.assert_array_equal(inv, exp_i)
+            assert inv.dtype == np.int32
