@@ -219,12 +219,12 @@ def _unique_inverse(arr: np.ndarray):
     """``np.unique(arr, return_inverse=True)`` with the native hash
     factorizer (``native/encode.cc``: O(N + U log U) vs the full O(N log N)
     sort) when the toolchain can build it; inverse always int32."""
-    if (arr.dtype.kind in "iu" and arr.dtype.itemsize <= 8 and
-            not (arr.dtype.kind == "u" and arr.size and
-                 int(arr.max()) > np.iinfo(np.int64).max)):
+    if arr.dtype.kind in "iu" and arr.dtype.itemsize <= 8:
         try:
             from pipelinedp_tpu import native
             if native.encode_available():
+                # factorize_i64 itself rejects uint64 values that would
+                # wrap; that ValueError lands in the fallback below.
                 uniq, inv = native.factorize_i64(arr)
                 return uniq.astype(arr.dtype), inv
         except Exception:  # never let the fast path break ingest

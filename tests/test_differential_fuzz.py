@@ -1,0 +1,229 @@
+"""Differential fuzzing: randomized datasets and parameter combinations,
+fused JAX plane vs the LocalBackend oracle.
+
+Strategy: at huge epsilon the noise vanishes, and with contribution caps
+chosen to never bind, the bounded aggregates are a deterministic function
+of the data — the two planes must agree partition by partition. Each case
+draws a random point from the full parameter space (metric combinations,
+noise kind, bounding mode, selection strategy / public partitions,
+bounds-already-enforced). Fixed seeds keep failures reproducible; a
+failing case prints its spec.
+
+When caps DO bind, outputs legitimately differ (each plane samples its
+own rows), so binding-cap cases check invariants instead: per-partition
+counts respect linf*l0 and the global row count is conserved or reduced.
+"""
+
+import operator
+
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu.backends import JaxBackend
+
+# Huge enough that even the Gaussian mechanism's noise vanishes: the
+# analytic-Gaussian sigma only decays as Delta2/sqrt(2*eps) (not 1/eps),
+# so eps=1e7 still leaves sigma ~ 0.1 at the sensitivities drawn here.
+BIG_EPS = 1e12
+
+SCALAR_COMBOS = [
+    [pdp.Metrics.COUNT],
+    [pdp.Metrics.PRIVACY_ID_COUNT],
+    [pdp.Metrics.SUM],
+    [pdp.Metrics.COUNT, pdp.Metrics.SUM],
+    [pdp.Metrics.MEAN],
+    [pdp.Metrics.VARIANCE],
+    [pdp.Metrics.MEAN, pdp.Metrics.COUNT, pdp.Metrics.SUM],
+    [pdp.Metrics.VARIANCE, pdp.Metrics.MEAN, pdp.Metrics.COUNT],
+    [pdp.Metrics.COUNT, pdp.Metrics.PERCENTILE(50), pdp.Metrics.PERCENTILE(90)],
+    [pdp.Metrics.SUM, pdp.Metrics.PRIVACY_ID_COUNT],
+]
+
+
+def make_dataset(rng, n_rows, n_users, n_parts):
+    return pdp.ArrayDataset(
+        privacy_ids=rng.integers(0, n_users, n_rows),
+        partition_keys=rng.integers(0, n_parts, n_rows),
+        values=rng.uniform(0.0, 10.0, n_rows))
+
+
+def run_engine(backend, ds, params, public, ext=None):
+    acc = pdp.NaiveBudgetAccountant(total_epsilon=BIG_EPS, total_delta=1e-2)
+    engine = pdp.DPEngine(acc, backend)
+    res = engine.aggregate(ds, params, ext or pdp.DataExtractors(),
+                           public_partitions=public)
+    acc.compute_budgets()
+    return dict(res)
+
+
+def case_spec(seed):
+    """Draws one random parameter-space point (deterministic per seed)."""
+    rng = np.random.default_rng(seed)
+    n_parts = int(rng.integers(3, 40))
+    n_users = int(rng.integers(5, 300))
+    n_rows = int(rng.integers(50, 3000))
+    metrics = SCALAR_COMBOS[int(rng.integers(0, len(SCALAR_COMBOS)))]
+    noise = (pdp.NoiseKind.LAPLACE
+             if rng.random() < 0.5 else pdp.NoiseKind.GAUSSIAN)
+    public = rng.random() < 0.5
+    strategy = list(pdp.PartitionSelectionStrategy)[
+        int(rng.integers(0, len(pdp.PartitionSelectionStrategy)))]
+    needs_values = any(
+        m.is_percentile or m.name in ("SUM", "MEAN", "VARIANCE")
+        for m in metrics)
+    return dict(n_parts=n_parts, n_users=n_users, n_rows=n_rows,
+                metrics=metrics, noise=noise, public=public,
+                strategy=strategy, needs_values=needs_values, rng=rng)
+
+
+class TestDifferentialFuzz:
+
+    @pytest.mark.parametrize("seed", range(14))
+    def test_nonbinding_caps_match_oracle(self, seed):
+        spec = case_spec(seed)
+        rng = spec["rng"]
+        ds = make_dataset(rng, spec["n_rows"], spec["n_users"],
+                          spec["n_parts"])
+        # Caps that can never bind: every pid's rows fit under linf and
+        # every pid's partition spread fits under l0.
+        counts_per_pair = {}
+        for u, p in zip(ds.privacy_ids.tolist(),
+                        ds.partition_keys.tolist()):
+            counts_per_pair[(u, p)] = counts_per_pair.get((u, p), 0) + 1
+        linf = max(counts_per_pair.values()) + 1
+        l0 = spec["n_parts"] + 1
+        kw = dict(metrics=spec["metrics"], noise_kind=spec["noise"],
+                  max_partitions_contributed=l0,
+                  max_contributions_per_partition=linf,
+                  partition_selection_strategy=spec["strategy"])
+        if spec["needs_values"]:
+            kw.update(min_value=0.0, max_value=10.0)
+        params = pdp.AggregateParams(**kw)
+        public = (sorted(np.unique(ds.partition_keys).tolist())
+                  if spec["public"] else None)
+
+        fused = run_engine(JaxBackend(rng_seed=seed), ds, params, public)
+        local = run_engine(pdp.LocalBackend(), ds, params, public)
+
+        if public:
+            assert set(fused) == set(local) == set(public), spec
+        # Private selection keeps/drops randomly per plane: compare the
+        # intersection (dropping small partitions is legitimate).
+        common = set(fused) & set(local)
+        users_per_part = {}
+        for u, p in zip(ds.privacy_ids.tolist(),
+                        ds.partition_keys.tolist()):
+            users_per_part.setdefault(p, set()).add(u)
+        # Private selection may legitimately drop every small partition;
+        # only a partition with plenty of users is guaranteed kept at
+        # huge eps on both planes.
+        if public or max(len(s) for s in users_per_part.values()) >= 20:
+            assert common, (spec, len(fused), len(local))
+        values_per_part = {}
+        for p, v in zip(ds.partition_keys.tolist(), ds.values.tolist()):
+            values_per_part.setdefault(p, []).append(v)
+        for k in common:
+            f, l = fused[k], local[k]
+            for field in f._fields:
+                if field.startswith("percentile_"):
+                    # At an exact rank boundary (e.g. the median of an
+                    # even count) the tree walk's child choice is decided
+                    # by vanishing noise, and ANY point between the two
+                    # adjacent order statistics is a valid quantile
+                    # estimate — the reference's C++ tree behaves the
+                    # same. Check both planes against the order-statistic
+                    # envelope instead of each other.
+                    q = float(field.split("_", 1)[1].replace("_", ".")) / 100
+                    s = sorted(values_per_part[k])
+                    m = len(s)
+                    kf = q * m
+                    lw = 10.0 / 16**4  # leaf width of the [0,10] tree
+                    lo = s[max(int(np.floor(kf)) - 1, 0)] - lw - 1e-3
+                    hi = s[min(int(np.ceil(kf)), m - 1)] + lw + 1e-3
+                    for plane, val in (("fused", getattr(f, field)),
+                                       ("local", getattr(l, field))):
+                        assert lo <= val <= hi, (
+                            spec, k, field, plane, val, (lo, hi))
+                else:
+                    assert getattr(f, field) == pytest.approx(
+                        getattr(l, field), rel=2e-3, abs=2e-2), (
+                            spec, k, field, f, l)
+
+    @pytest.mark.parametrize("seed", range(14, 20))
+    def test_binding_caps_invariants(self, seed):
+        spec = case_spec(seed)
+        rng = spec["rng"]
+        ds = make_dataset(rng, spec["n_rows"], spec["n_users"],
+                          spec["n_parts"])
+        linf = int(rng.integers(1, 3))
+        l0 = int(rng.integers(1, 4))
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT], noise_kind=spec["noise"],
+            max_partitions_contributed=l0,
+            max_contributions_per_partition=linf)
+        public = sorted(np.unique(ds.partition_keys).tolist())
+        fused = run_engine(JaxBackend(rng_seed=seed), ds, params, public)
+        # Per-partition: at most (users contributing) * linf rows; global:
+        # bounding only removes rows.
+        users_per_part = {}
+        for u, p in zip(ds.privacy_ids.tolist(),
+                        ds.partition_keys.tolist()):
+            users_per_part.setdefault(p, set()).add(u)
+        total = 0.0
+        for k, v in fused.items():
+            cap = len(users_per_part.get(k, ())) * linf
+            assert v.count <= cap + 0.5, (spec, k, v.count, cap)
+            total += v.count
+        assert total <= spec["n_rows"] + 0.5, spec
+
+    @pytest.mark.parametrize("seed", [30, 31, 32])
+    def test_bounds_already_enforced(self, seed):
+        spec = case_spec(seed)
+        rng = spec["rng"]
+        ds = pdp.ArrayDataset(
+            privacy_ids=None,
+            partition_keys=rng.integers(0, spec["n_parts"], spec["n_rows"]),
+            values=rng.uniform(0.0, 10.0, spec["n_rows"]))
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            noise_kind=spec["noise"],
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1,
+            min_value=0.0, max_value=10.0,
+            contribution_bounds_already_enforced=True)
+        public = sorted(np.unique(ds.partition_keys).tolist())
+        ext = pdp.DataExtractors()
+        fused = run_engine(JaxBackend(rng_seed=seed), ds, params, public,
+                           ext=ext)
+        local = run_engine(pdp.LocalBackend(), ds, params, public, ext=ext)
+        assert set(fused) == set(local)
+        for k in fused:
+            assert fused[k].count == pytest.approx(local[k].count,
+                                                   abs=2e-2), (spec, k)
+            assert fused[k].sum == pytest.approx(local[k].sum,
+                                                 rel=2e-3, abs=5e-2), (
+                                                     spec, k)
+
+    @pytest.mark.parametrize("seed,norm", [
+        (40, pdp.NormKind.Linf), (41, pdp.NormKind.L1), (42, pdp.NormKind.L2)])
+    def test_vector_sum(self, seed, norm):
+        rng = np.random.default_rng(seed)
+        n_rows, n_parts, dim = 400, 6, 3
+        ds = pdp.ArrayDataset(
+            privacy_ids=rng.integers(0, 100, n_rows),
+            partition_keys=rng.integers(0, n_parts, n_rows),
+            values=rng.uniform(-1.0, 1.0, (n_rows, dim)))
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.VECTOR_SUM],
+            max_partitions_contributed=n_parts + 1,
+            max_contributions_per_partition=50,
+            vector_size=dim, vector_max_norm=100.0, vector_norm_kind=norm)
+        public = sorted(np.unique(ds.partition_keys).tolist())
+        fused = run_engine(JaxBackend(rng_seed=seed), ds, params, public)
+        local = run_engine(pdp.LocalBackend(), ds, params, public)
+        assert set(fused) == set(local)
+        for k in fused:
+            np.testing.assert_allclose(
+                np.asarray(fused[k].vector_sum),
+                np.asarray(local[k].vector_sum), rtol=1e-3, atol=5e-2)
