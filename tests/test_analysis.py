@@ -741,6 +741,71 @@ class TestAnalysisErrorModelClosedForm:
         assert accs[3].error_l0_expected == pytest.approx(0.25 * -4.0)
 
 
+class TestFusedSweepFuzz:
+    """Randomized sweep configurations, device vs host — the sweep
+    counterpart of ``tests/test_differential_fuzz.py``. Reuses the
+    dataset/compare helpers; fixed seeds keep failures reproducible."""
+
+    _dataset = staticmethod(TestFusedSweep._dataset)
+    _run_both = staticmethod(TestFusedSweep._run_both)
+    _assert_metrics_close = staticmethod(TestFusedSweep._assert_metrics_close)
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_config(self, seed):
+        from pipelinedp_tpu.ops import noise as noise_ops
+        # The host oracle Monte-Carlos its Laplace error quantiles from
+        # the module-level host RNG; seed it so failures reproduce.
+        noise_ops.seed_host_rng(seed)
+        rng = np.random.default_rng(1000 + seed)
+        ds = self._dataset(n=int(rng.integers(500, 4000)),
+                           users=int(rng.integers(30, 400)),
+                           parts=int(rng.integers(5, 40)),
+                           seed=seed)
+        metric = [pdp.Metrics.COUNT, pdp.Metrics.PRIVACY_ID_COUNT,
+                  pdp.Metrics.SUM][int(rng.integers(0, 3))]
+        kw = dict(metrics=[metric],
+                  noise_kind=(pdp.NoiseKind.LAPLACE if rng.random() < 0.5
+                              else pdp.NoiseKind.GAUSSIAN),
+                  max_partitions_contributed=int(rng.integers(1, 6)),
+                  max_contributions_per_partition=int(rng.integers(1, 4)),
+                  partition_selection_strategy=list(
+                      pdp.PartitionSelectionStrategy)[
+                          int(rng.integers(0, 3))])
+        if metric == pdp.Metrics.SUM:
+            kw.update(min_sum_per_partition=0.0,
+                      max_sum_per_partition=float(rng.uniform(2, 30)))
+        params = pdp.AggregateParams(**kw)
+        n_cfg = int(rng.integers(1, 5))
+        multi = None
+        if n_cfg > 1:
+            multi = data_structures.MultiParameterConfiguration(
+                max_partitions_contributed=sorted(
+                    int(x) for x in rng.integers(1, 12, n_cfg)),
+                max_contributions_per_partition=[
+                    int(x) for x in rng.integers(1, 5, n_cfg)])
+        options = analysis.UtilityAnalysisOptions(
+            epsilon=float(rng.uniform(0.3, 5.0)),
+            delta=float(10.0**-rng.integers(4, 9)),
+            aggregate_params=params,
+            multi_param_configuration=multi)
+        public = (sorted(np.unique(ds.partition_keys).tolist())
+                  if rng.random() < 0.4 else None)
+        host, fused = self._run_both(ds, options, public=public)
+        assert len(host) == len(fused) == (multi.size if multi else 1)
+        field = {pdp.Metrics.COUNT: "count_metrics",
+                 pdp.Metrics.PRIVACY_ID_COUNT: "privacy_id_count_metrics",
+                 pdp.Metrics.SUM: "sum_metrics"}[metric]
+        for h, f in zip(host, fused):
+            self._assert_metrics_close(getattr(h, field),
+                                       getattr(f, field))
+            if public is None:
+                hp = h.partition_selection_metrics
+                fp = f.partition_selection_metrics
+                assert fp.num_partitions == hp.num_partitions
+                assert fp.dropped_partitions_expected == pytest.approx(
+                    hp.dropped_partitions_expected, rel=0.07, abs=0.5)
+
+
 class TestFusedSweepSharded:
     """The configuration-axis sweep over the 8-device virtual mesh:
     each device analyzes its slice of the parameter grid; results must
