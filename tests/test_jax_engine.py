@@ -88,6 +88,55 @@ class TestWideIdPacking:
             assert fused[k].sum == pytest.approx(local[k].sum, abs=0.5), k
 
 
+class TestFusedEdgeCases:
+    """Degenerate shapes through the fused plane."""
+
+    @staticmethod
+    def _run(ds, public=None):
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            max_partitions_contributed=5,
+            max_contributions_per_partition=5,
+            min_value=0.0, max_value=10.0)
+        return run(JaxBackend(rng_seed=0), ds, params,
+                   public_partitions=public, eps=1e12, delta=1e-2,
+                   ext=pdp.DataExtractors())
+
+    def test_empty_rejected_like_reference(self):
+        ds = pdp.ArrayDataset(privacy_ids=np.array([], np.int64),
+                              partition_keys=np.array([], np.int64),
+                              values=np.array([], np.float64))
+        with pytest.raises(ValueError, match="non-empty"):
+            self._run(ds)
+
+    def test_single_row(self):
+        got = self._run(
+            pdp.ArrayDataset(privacy_ids=np.array([3]),
+                             partition_keys=np.array([5]),
+                             values=np.array([2.5])), public=[5])
+        assert got[5].count == pytest.approx(1.0, abs=1e-3)
+        assert got[5].sum == pytest.approx(2.5, abs=1e-3)
+
+    def test_one_pid_one_partition_caps_bind(self):
+        # 5000 identical contributions from one user: linf=5 keeps 5.
+        got = self._run(
+            pdp.ArrayDataset(privacy_ids=np.zeros(5000, np.int64),
+                             partition_keys=np.zeros(5000, np.int64),
+                             values=np.full(5000, 1.0)), public=[0])
+        assert got[0].count == pytest.approx(5.0, abs=1e-2)
+        assert got[0].sum == pytest.approx(5.0, abs=1e-2)
+
+    def test_negative_keys_roundtrip(self):
+        got = self._run(
+            pdp.ArrayDataset(privacy_ids=np.array([-5, -5, 7]),
+                             partition_keys=np.array([-9, -9, -9]),
+                             values=np.array([1.0, 2.0, 3.0])),
+            public=[-9])
+        assert set(got) == {-9}
+        assert got[-9].count == pytest.approx(3.0, abs=1e-2)
+        assert got[-9].sum == pytest.approx(6.0, abs=1e-2)
+
+
 class TestDifferentialVsLocal:
 
     def test_count(self):
