@@ -136,6 +136,10 @@ def _check_utility_analysis_params(options, data_extractors):
     params = options.aggregate_params
     if params.custom_combiners is not None:
         raise NotImplementedError("custom combiners are not supported")
+    if params.max_contributions is not None:
+        raise NotImplementedError(
+            "utility analysis models (l0, linf) bounding; "
+            "max_contributions is not supported")
     supported = {Metrics.COUNT, Metrics.SUM, Metrics.PRIVACY_ID_COUNT}
     if not set(params.metrics).issubset(supported):
         unsupported = list(set(params.metrics) - supported)

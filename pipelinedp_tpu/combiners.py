@@ -96,7 +96,7 @@ class CombinerParams:
             self.eps, self.delta, p.min_value, p.max_value,
             p.min_sum_per_partition, p.max_sum_per_partition,
             p.max_partitions_contributed, p.max_contributions_per_partition,
-            p.noise_kind)
+            p.noise_kind, max_contributions=p.max_contributions)
 
     @property
     def additive_vector_noise_params(
@@ -157,7 +157,7 @@ class PrivacyIdCountCombiner(Combiner):
     def compute_metrics(self, count: int) -> dict:
         return {
             "privacy_id_count":
-                dp_computations.compute_dp_count(
+                dp_computations.compute_dp_privacy_id_count(
                     count, self._params.scalar_noise_params)
         }
 

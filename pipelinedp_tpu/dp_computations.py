@@ -45,16 +45,26 @@ def compute_squares_interval(min_value: float,
 
 @dataclasses.dataclass
 class ScalarNoiseParams:
-    """Parameters of scalar DP aggregations (reference :23-55)."""
+    """Parameters of scalar DP aggregations (reference :23-55).
+
+    Contribution bounding comes in two modes: the (l0, linf) pair
+    (``max_partitions_contributed`` x ``max_contributions_per_partition``)
+    or a single total cap ``max_contributions`` across all partitions —
+    a parameter the reference declares end-to-end but never implements
+    (its engine raises, reference ``dp_engine.py:395-396``). Here the
+    total-cap mode is fully supported; see ``count_sensitivities`` /
+    ``pid_count_sensitivities`` / ``sum_sensitivities`` for the
+    calculus."""
     eps: float
     delta: float
     min_value: Optional[float]
     max_value: Optional[float]
     min_sum_per_partition: Optional[float]
     max_sum_per_partition: Optional[float]
-    max_partitions_contributed: int
+    max_partitions_contributed: Optional[int]
     max_contributions_per_partition: Optional[int]
     noise_kind: NoiseKind
+    max_contributions: Optional[int] = None
 
     def __post_init__(self):
         assert (self.min_value is None) == (self.max_value is None), (
@@ -63,9 +73,50 @@ class ScalarNoiseParams:
             self.max_sum_per_partition is None), (
                 "min_sum_per_partition and max_sum_per_partition should both "
                 "be set or both be None.")
+        assert (self.max_contributions is not None or
+                self.max_partitions_contributed is not None), (
+            "either max_contributions or max_partitions_contributed "
+            "must be set")
 
     def l0_sensitivity(self) -> int:
+        if self.max_contributions is not None:
+            # A privacy unit touches at most max_contributions partitions.
+            return self.max_contributions
         return self.max_partitions_contributed
+
+    def count_sensitivities(self):
+        """(l0, linf) for count-like releases. Total-cap mode: a unit's
+        M rows can all land in ONE partition, so the L2-worst case is
+        concentration — (1, M) yields Delta1 = Delta2 = M, valid for both
+        mechanisms."""
+        if self.max_contributions is not None:
+            return 1.0, float(self.max_contributions)
+        return float(self.l0_sensitivity()), float(
+            self.max_contributions_per_partition)
+
+    def pid_count_sensitivities(self):
+        """(l0, linf) for the privacy-id count: a unit adds at most 1 per
+        touched partition, so concentration cannot occur — total-cap mode
+        gets the tight (M, 1) with Delta2 = sqrt(M). Pair mode keeps the
+        reference's (l0, linf) exactly (conservative when linf > 1,
+        reference ``combiners.py:211-239``)."""
+        if self.max_contributions is not None:
+            return float(self.max_contributions), 1.0
+        return float(self.l0_sensitivity()), float(
+            self.max_contributions_per_partition)
+
+    def sum_sensitivities(self):
+        """(l0, linf) for the SUM release in either clipping mode: with
+        per-contribution value bounds, linf scales the count-like pair by
+        max|bound|; with per-partition sum bounds, each touched
+        partition's sum is capped directly."""
+        if self.bounds_per_contribution_are_set:
+            max_abs = max(abs(self.min_value), abs(self.max_value))
+            l0, linf = self.count_sensitivities()
+            return l0, linf * max_abs
+        return float(self.l0_sensitivity()), max(
+            abs(self.min_sum_per_partition),
+            abs(self.max_sum_per_partition))
 
     @property
     def bounds_per_contribution_are_set(self) -> bool:
@@ -162,28 +213,32 @@ def equally_split_budget(eps: float, delta: float, no_mechanisms: int):
 
 def compute_dp_count(count: ArrayLike, dp_params: ScalarNoiseParams,
                      rng: Optional[np.random.Generator] = None) -> ArrayLike:
-    """DP count; linf = max_contributions_per_partition (reference :255)."""
-    return _add_random_noise(count, dp_params.eps, dp_params.delta,
-                             dp_params.l0_sensitivity(),
-                             dp_params.max_contributions_per_partition,
-                             dp_params.noise_kind, rng)
+    """DP count; linf = max_contributions_per_partition (reference :255),
+    or the concentration-safe (1, max_contributions) in total-cap mode."""
+    l0, linf = dp_params.count_sensitivities()
+    return _add_random_noise(count, dp_params.eps, dp_params.delta, l0,
+                             linf, dp_params.noise_kind, rng)
+
+
+def compute_dp_privacy_id_count(
+        count: ArrayLike, dp_params: ScalarNoiseParams,
+        rng: Optional[np.random.Generator] = None) -> ArrayLike:
+    """DP privacy-id count: like compute_dp_count but with the tight
+    1-per-partition sensitivities (matters only in total-cap mode)."""
+    l0, linf = dp_params.pid_count_sensitivities()
+    return _add_random_noise(count, dp_params.eps, dp_params.delta, l0,
+                             linf, dp_params.noise_kind, rng)
 
 
 def compute_dp_sum(sum_: ArrayLike, dp_params: ScalarNoiseParams,
                    rng: Optional[np.random.Generator] = None) -> ArrayLike:
     """DP sum; linf from value bounds x contributions, or per-partition sum
     bounds; zero sensitivity short-circuits to 0 (reference :278-307)."""
-    if dp_params.bounds_per_contribution_are_set:
-        max_abs = max(abs(dp_params.min_value), abs(dp_params.max_value))
-        linf = dp_params.max_contributions_per_partition * max_abs
-    else:
-        linf = max(abs(dp_params.min_sum_per_partition),
-                   abs(dp_params.max_sum_per_partition))
+    l0, linf = dp_params.sum_sensitivities()
     if linf == 0:
         return np.zeros_like(sum_) if np.shape(sum_) else 0
-    return _add_random_noise(sum_, dp_params.eps, dp_params.delta,
-                             dp_params.l0_sensitivity(), linf,
-                             dp_params.noise_kind, rng)
+    return _add_random_noise(sum_, dp_params.eps, dp_params.delta, l0,
+                             linf, dp_params.noise_kind, rng)
 
 
 def _compute_mean_for_normalized_sum(
@@ -213,14 +268,12 @@ def compute_dp_mean(count: ArrayLike, normalized_sum: ArrayLike,
     two-way budget split (reference :353-397)."""
     (count_eps, count_delta), (sum_eps, sum_delta) = equally_split_budget(
         dp_params.eps, dp_params.delta, 2)
-    l0 = dp_params.l0_sensitivity()
-    dp_count = _add_random_noise(count, count_eps, count_delta, l0,
-                                 dp_params.max_contributions_per_partition,
+    l0, linf = dp_params.count_sensitivities()
+    dp_count = _add_random_noise(count, count_eps, count_delta, l0, linf,
                                  dp_params.noise_kind, rng)
     dp_mean = _compute_mean_for_normalized_sum(
         dp_count, normalized_sum, dp_params.min_value, dp_params.max_value,
-        sum_eps, sum_delta, l0, dp_params.max_contributions_per_partition,
-        dp_params.noise_kind, rng)
+        sum_eps, sum_delta, l0, linf, dp_params.noise_kind, rng)
     if dp_params.min_value != dp_params.max_value:
         dp_mean = dp_mean + compute_middle(dp_params.min_value,
                                            dp_params.max_value)
@@ -236,20 +289,17 @@ def compute_dp_var(count: ArrayLike, normalized_sum: ArrayLike,
     ((count_eps, count_delta), (sum_eps, sum_delta),
      (sq_eps, sq_delta)) = equally_split_budget(dp_params.eps,
                                                 dp_params.delta, 3)
-    l0 = dp_params.l0_sensitivity()
-    dp_count = _add_random_noise(count, count_eps, count_delta, l0,
-                                 dp_params.max_contributions_per_partition,
+    l0, linf = dp_params.count_sensitivities()
+    dp_count = _add_random_noise(count, count_eps, count_delta, l0, linf,
                                  dp_params.noise_kind, rng)
     dp_mean = _compute_mean_for_normalized_sum(
         dp_count, normalized_sum, dp_params.min_value, dp_params.max_value,
-        sum_eps, sum_delta, l0, dp_params.max_contributions_per_partition,
-        dp_params.noise_kind, rng)
+        sum_eps, sum_delta, l0, linf, dp_params.noise_kind, rng)
     squares_min, squares_max = compute_squares_interval(
         dp_params.min_value, dp_params.max_value)
     dp_mean_squares = _compute_mean_for_normalized_sum(
         dp_count, normalized_sum_squares, squares_min, squares_max, sq_eps,
-        sq_delta, l0, dp_params.max_contributions_per_partition,
-        dp_params.noise_kind, rng)
+        sq_delta, l0, linf, dp_params.noise_kind, rng)
     dp_var = dp_mean_squares - dp_mean**2
     if dp_params.min_value != dp_params.max_value:
         dp_mean = dp_mean + compute_middle(dp_params.min_value,
@@ -307,23 +357,13 @@ def add_noise_vector(vec: np.ndarray,
 # ---------------------------------------------------------------------------
 
 
-def _compute_noise_std(linf_sensitivity: float,
-                       dp_params: ScalarNoiseParams) -> float:
-    return _noise_std(dp_params.eps, dp_params.delta,
-                      dp_params.l0_sensitivity(), linf_sensitivity,
+def compute_dp_count_noise_std(dp_params: ScalarNoiseParams) -> float:
+    l0, linf = dp_params.count_sensitivities()
+    return _noise_std(dp_params.eps, dp_params.delta, l0, linf,
                       dp_params.noise_kind)
 
 
-def compute_dp_count_noise_std(dp_params: ScalarNoiseParams) -> float:
-    return _compute_noise_std(dp_params.max_contributions_per_partition,
-                              dp_params)
-
-
 def compute_dp_sum_noise_std(dp_params: ScalarNoiseParams) -> float:
-    if dp_params.bounds_per_contribution_are_set:
-        max_abs = max(abs(dp_params.min_value), abs(dp_params.max_value))
-        linf = dp_params.max_contributions_per_partition * max_abs
-    else:
-        linf = max(abs(dp_params.min_sum_per_partition),
-                   abs(dp_params.max_sum_per_partition))
-    return _compute_noise_std(linf, dp_params)
+    l0, linf = dp_params.sum_sensitivities()
+    return _noise_std(dp_params.eps, dp_params.delta, l0, linf,
+                      dp_params.noise_kind)

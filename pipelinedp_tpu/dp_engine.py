@@ -170,7 +170,11 @@ class DPEngine:
                     params.max_contributions or
                     params.max_contributions_per_partition)
             col = self._select_private_partitions_internal(
-                col, params.max_partitions_contributed,
+                col,
+                # Total-cap mode: a unit touches <= max_contributions
+                # partitions, which is the selection's L0.
+                (params.max_partitions_contributed or
+                 params.max_contributions),
                 max_rows_per_privacy_id,
                 params.partition_selection_strategy,
                 params.pre_threshold)
@@ -334,8 +338,18 @@ class DPEngine:
                                 check_data_extractors: bool = True):
         if params is not None and getattr(params, "max_contributions",
                                           None) is not None:
-            raise NotImplementedError(
-                "max_contributions is not supported yet.")
+            # The reference declares this parameter end-to-end but its
+            # engine rejects it (reference dp_engine.py:395-396); here the
+            # total-cap mode is implemented for the scalar metrics.
+            unsupported = [
+                m for m in (params.metrics or [])
+                if m.is_percentile or m.name == "VECTOR_SUM"
+            ]
+            if unsupported:
+                raise NotImplementedError(
+                    f"max_contributions does not support {unsupported}; "
+                    "use (max_partitions_contributed, "
+                    "max_contributions_per_partition)")
         if col is None or not col:
             raise ValueError("col must be non-empty")
         if params is None:

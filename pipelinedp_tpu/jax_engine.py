@@ -130,6 +130,11 @@ _VALUE_METRICS = {"SUM", "MEAN", "VARIANCE", "VECTOR_SUM", "PERCENTILE"}
 def params_are_fusable(params: AggregateParams) -> bool:
     if params.custom_combiners:
         return False
+    if params.max_contributions is not None:
+        # Total-cap bounding samples M rows per privacy unit across all
+        # partitions — a different bounding structure than the fused
+        # kernel's (linf, l0) rank caps; it runs on the generic path.
+        return False
     for m in params.metrics:
         if m.is_percentile:
             # The quantile walk needs real tree bounds; a degenerate
