@@ -30,6 +30,34 @@ compute_l2_sensitivity = noise_ops.compute_l2_sensitivity
 compute_sigma = noise_ops.compute_sigma
 
 
+def count_sensitivity_pair(max_partitions_contributed,
+                           max_contributions_per_partition,
+                           max_contributions):
+    """(l0, linf) for count-like releases, shared by the host mechanisms
+    and the fused plane's noise calibration. Total-cap mode: a unit's M
+    rows can all land in ONE partition, so the L2-worst case is
+    concentration — (1, M) yields Delta1 = Delta2 = M, valid for both
+    mechanisms."""
+    if max_contributions is not None:
+        return 1.0, float(max_contributions)
+    return float(max_partitions_contributed), float(
+        max_contributions_per_partition)
+
+
+def pid_count_sensitivity_pair(max_partitions_contributed,
+                               max_contributions_per_partition,
+                               max_contributions):
+    """(l0, linf) for the privacy-id count: a unit adds at most 1 per
+    touched partition, so concentration cannot occur — total-cap mode
+    gets the tight (M, 1) with Delta2 = sqrt(M). Pair mode keeps the
+    reference's (l0, linf) exactly (conservative when linf > 1,
+    reference ``combiners.py:211-239``)."""
+    if max_contributions is not None:
+        return float(max_contributions), 1.0
+    return float(max_partitions_contributed), float(
+        max_contributions_per_partition)
+
+
 def compute_middle(min_value: float, max_value: float) -> float:
     """Midpoint, written to avoid overflow on large bounds (reference :65)."""
     return min_value + (max_value - min_value) / 2
@@ -85,25 +113,18 @@ class ScalarNoiseParams:
         return self.max_partitions_contributed
 
     def count_sensitivities(self):
-        """(l0, linf) for count-like releases. Total-cap mode: a unit's
-        M rows can all land in ONE partition, so the L2-worst case is
-        concentration — (1, M) yields Delta1 = Delta2 = M, valid for both
-        mechanisms."""
-        if self.max_contributions is not None:
-            return 1.0, float(self.max_contributions)
-        return float(self.l0_sensitivity()), float(
-            self.max_contributions_per_partition)
+        """(l0, linf) for count-like releases — see
+        :func:`count_sensitivity_pair`."""
+        return count_sensitivity_pair(self.max_partitions_contributed,
+                                      self.max_contributions_per_partition,
+                                      self.max_contributions)
 
     def pid_count_sensitivities(self):
-        """(l0, linf) for the privacy-id count: a unit adds at most 1 per
-        touched partition, so concentration cannot occur — total-cap mode
-        gets the tight (M, 1) with Delta2 = sqrt(M). Pair mode keeps the
-        reference's (l0, linf) exactly (conservative when linf > 1,
-        reference ``combiners.py:211-239``)."""
-        if self.max_contributions is not None:
-            return float(self.max_contributions), 1.0
-        return float(self.l0_sensitivity()), float(
-            self.max_contributions_per_partition)
+        """(l0, linf) for the privacy-id count — see
+        :func:`pid_count_sensitivity_pair`."""
+        return pid_count_sensitivity_pair(
+            self.max_partitions_contributed,
+            self.max_contributions_per_partition, self.max_contributions)
 
     def sum_sensitivities(self):
         """(l0, linf) for the SUM release in either clipping mode: with

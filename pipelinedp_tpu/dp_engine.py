@@ -341,6 +341,11 @@ class DPEngine:
             # The reference declares this parameter end-to-end but its
             # engine rejects it (reference dp_engine.py:395-396); here the
             # total-cap mode is implemented for the scalar metrics.
+            if params.custom_combiners:
+                raise NotImplementedError(
+                    "max_contributions is not supported with custom "
+                    "combiners (combiners receive no (l0, linf) pair to "
+                    "calibrate against)")
             unsupported = [
                 m for m in (params.metrics or [])
                 if m.is_percentile or m.name == "VECTOR_SUM"

@@ -79,6 +79,16 @@ class FusedConfig:
     selection: Optional[PartitionSelectionStrategy]  # None = public
     bounds_already_enforced: bool
     percentiles: Tuple[float, ...] = ()  # PERCENTILE(p) parameters, in order
+    # Total-cap bounding: M rows per privacy unit across ALL partitions
+    # (l0/linf are None in this mode).
+    max_contributions: Optional[int] = None
+
+    @property
+    def selection_l0(self) -> int:
+        """L0 for partition selection: a unit touches at most this many
+        partitions in either bounding mode."""
+        return (self.max_contributions if self.max_contributions is not None
+                else self.l0)
 
     @property
     def needs_values(self) -> bool:
@@ -105,6 +115,7 @@ class FusedConfig:
             noise_kind=params.noise_kind,
             linf=params.max_contributions_per_partition,
             l0=params.max_partitions_contributed,
+            max_contributions=params.max_contributions,
             per_partition_bounds=params.bounds_per_partition_are_set,
             min_value=params.min_value,
             max_value=params.max_value,
@@ -130,11 +141,9 @@ _VALUE_METRICS = {"SUM", "MEAN", "VARIANCE", "VECTOR_SUM", "PERCENTILE"}
 def params_are_fusable(params: AggregateParams) -> bool:
     if params.custom_combiners:
         return False
-    if params.max_contributions is not None:
-        # Total-cap bounding samples M rows per privacy unit across all
-        # partitions — a different bounding structure than the fused
-        # kernel's (linf, l0) rank caps; it runs on the generic path.
-        return False
+    # (Total-cap ``max_contributions`` bounding is fused too: the engine
+    # rejects PERCENTILE/VECTOR_SUM with it before dispatch, and in
+    # bounds-already-enforced mode no bounding runs anywhere.)
     for m in params.metrics:
         if m.is_percentile:
             # The quantile walk needs real tree bounds; a degenerate
@@ -491,7 +500,7 @@ def _partials(config: FusedConfig, num_partitions: int, pid, pk, values,
         part_nseg = part["count"]
         return part, part_nseg, qrows
 
-    k_tie, k_salt = jax.random.split(key, 2)
+    k_tie, k_salt, k_m = jax.random.split(key, 3)
     salt = jax.random.bits(k_salt, (), dtype=jnp.uint32)
     tiebreak = jax.random.bits(k_tie, (n,), dtype=jnp.uint32)
     big_pid = jnp.where(valid, pid, seg_ops.PAD_ID)
@@ -511,21 +520,45 @@ def _partials(config: FusedConfig, num_partitions: int, pid, pk, values,
 
     new_pid = (idx == 0) | (spid != jnp.roll(spid, 1))
     new_seg = new_pid | (spk != jnp.roll(spk, 1))
-    # Linf bound: keep the first linf (randomly ordered) rows per segment.
-    linf_cap = config.linf if config.linf is not None else n
-    row_keep = svalid & (seg_ops.rank_in_run(new_seg) < linf_cap)
-    # L0 bound: the segment's ordinal within its pid — uniform by the hpk
-    # sort key — must be < l0.
-    keep_l0 = seg_ops.run_ordinal_in_group(new_seg, new_pid) < config.l0
-    keep_row = row_keep & keep_l0
+    if config.max_contributions is not None:
+        # Total-cap mode: a uniform without-replacement sample of M rows
+        # per privacy unit, across all its partitions (the fused twin of
+        # SamplingPerPrivacyIdContributionBounder). The sample must be
+        # uniform over the unit's ROWS, not follow the hpk segment order,
+        # so rank rows by an independent random key in a second sort and
+        # carry the keep bits back through the permutations.
+        tie_m = jax.random.bits(k_m, (n,), dtype=jnp.uint32)
+        order_m = jnp.lexsort((tie_m, big_pid))
+        mpid = big_pid[order_m]
+        new_pid_m = (idx == 0) | (mpid != jnp.roll(mpid, 1))
+        keep_sorted = seg_ops.rank_in_run(new_pid_m) < config.max_contributions
+        keep_m = jnp.zeros(n, bool).at[order_m].set(keep_sorted)
+        keep_row = svalid & keep_m[sort_idx]
+        # First KEPT row of each segment marks the (pid, pk) pair as
+        # contributing; fully-sampled-away segments must not count
+        # toward the privacy-id count or selection.
+        wk = jnp.cumsum(keep_row.astype(jnp.int32))
+        seg_start = seg_ops.run_starts(new_seg)
+        kept_before_seg = wk[seg_start] - keep_row[seg_start]
+        seg_marker = keep_row & (wk == kept_before_seg + 1)
+    else:
+        # Linf bound: keep the first linf (randomly ordered) rows per
+        # segment.
+        linf_cap = config.linf if config.linf is not None else n
+        row_keep = svalid & (seg_ops.rank_in_run(new_seg) < linf_cap)
+        # L0 bound: the segment's ordinal within its pid — uniform by the
+        # hpk sort key — must be < l0.
+        keep_l0 = seg_ops.run_ordinal_in_group(new_seg,
+                                               new_pid) < config.l0
+        keep_row = row_keep & keep_l0
+        # Kept-segment indicator on the segment's first row: the per-pk
+        # sum of these is the privacy-id count (row_count in the
+        # reference's compound accumulator, dp_engine.py:339).
+        seg_marker = new_seg & svalid & keep_l0
 
     clipped = _clip_values(config, svalues)
     masked = jnp.where(_expand(keep_row, clipped), clipped, 0.0)
     pk_safe = jnp.where(svalid, spk, 0)
-    # Kept-segment indicator on the segment's first row: the per-pk sum of
-    # these is the privacy-id count (row_count in the reference's compound
-    # accumulator, dp_engine.py:339).
-    seg_marker = new_seg & svalid & keep_l0
 
     if config.per_partition_bounds:
         # Clip each (pid, pk) segment's SUM, contributed once per segment.
@@ -910,18 +943,22 @@ def _noise_scales(config: FusedConfig,
 
     scales = []
     names = set(config.metrics)
-    l0 = config.l0
-    linf = config.linf
+    # Count-like (l0, linf): the ONE shared calculus with the host
+    # mechanisms (dp_computations.count_sensitivity_pair).
+    l0, linf = dp_computations.count_sensitivity_pair(
+        config.l0, config.linf, config.max_contributions)
 
-    def scale(eps, delta, linf_sens):
+    def scale(eps, delta, linf_sens, l0_sens=None):
         if linf_sens == 0:
             return 0.0
+        l0_eff = l0 if l0_sens is None else l0_sens
         if config.noise_kind == NoiseKind.LAPLACE:
             return noise_ops.laplace_scale(
-                eps, dp_computations.compute_l1_sensitivity(l0, linf_sens))
+                eps,
+                dp_computations.compute_l1_sensitivity(l0_eff, linf_sens))
         return noise_ops.gaussian_sigma(
             eps, delta, dp_computations.compute_l2_sensitivity(
-                l0, linf_sens))
+                l0_eff, linf_sens))
 
     if "VARIANCE" in names or "MEAN" in names:
         spec = specs["mean_var"]
@@ -956,16 +993,23 @@ def _noise_scales(config: FusedConfig,
             if config.per_partition_bounds:
                 linf_sum = max(abs(config.min_sum_per_partition),
                                abs(config.max_sum_per_partition))
+                # Per-partition bounds cap each partition's sum directly;
+                # in total-cap mode a unit touches <= M partitions.
+                scales.append(scale(spec.eps, spec.delta, linf_sum,
+                                    l0_sens=config.selection_l0))
             else:
                 linf_sum = linf * max(abs(config.min_value),
                                       abs(config.max_value))
-            scales.append(scale(spec.eps, spec.delta, linf_sum))
+                scales.append(scale(spec.eps, spec.delta, linf_sum))
     if "PRIVACY_ID_COUNT" in names:
-        # linf = max_contributions_per_partition for parity with the
-        # generic path and the reference (dp_computations.py:255-266 via
-        # PrivacyIdCountCombiner) — conservative, the true sensitivity is 1.
+        # The shared pid-count calculus (tight (M, 1) in total-cap mode,
+        # reference-parity (l0, linf) in pair mode) — matches
+        # compute_dp_privacy_id_count.
         spec = specs["privacy_id_count"]
-        scales.append(scale(spec.eps, spec.delta, linf))
+        pid_l0, pid_linf = dp_computations.pid_count_sensitivity_pair(
+            config.l0, config.linf, config.max_contributions)
+        scales.append(scale(spec.eps, spec.delta, pid_linf,
+                            l0_sens=pid_l0))
     if "VECTOR_SUM" in names:
         spec = specs["vector_sum"]
         eps_c = spec.eps / config.vector_size
@@ -995,7 +1039,7 @@ def selection_inputs(config: FusedConfig, eps: float, delta: float,
     if config.selection is None:
         return np.zeros(2, np.float32), 0.0, 1.0, 0.0
     strategy = ps_ops.create_partition_selection_strategy(
-        config.selection, eps, delta, config.l0, pre_threshold)
+        config.selection, eps, delta, config.selection_l0, pre_threshold)
     if isinstance(strategy, ps_ops.TruncatedGeometricPartitionStrategy):
         # probabilities() already folds in pre-thresholding; materialize
         # the effective table over [0, saturation + pre_threshold].
@@ -1308,15 +1352,22 @@ def build_fused_aggregation(col, params: AggregateParams, data_extractors,
             mechanism_type=MechanismType.GENERIC)
 
     if not config.bounds_already_enforced:
-        report_gen.add_stage(
-            f"Per-partition contribution bounding: for each privacy_id and "
-            f"each partition, randomly select "
-            f"max(actual_contributions_per_partition, {config.linf}) "
-            f"contributions (fused on device).")
-        report_gen.add_stage(
-            f"Cross-partition contribution bounding: for each privacy_id "
-            f"randomly select max(actual_partition_contributed, "
-            f"{config.l0}) partitions (fused on device).")
+        if config.max_contributions is not None:
+            report_gen.add_stage(
+                f"User contribution bounding: randomly selected not more "
+                f"than {config.max_contributions} contributions (fused on "
+                "device).")
+        else:
+            report_gen.add_stage(
+                f"Per-partition contribution bounding: for each privacy_id "
+                f"and each partition, randomly select "
+                f"max(actual_contributions_per_partition, {config.linf}) "
+                f"contributions (fused on device).")
+            report_gen.add_stage(
+                f"Cross-partition contribution bounding: for each "
+                f"privacy_id randomly select "
+                f"max(actual_partition_contributed, {config.l0}) "
+                "partitions (fused on device).")
     if public:
         report_gen.add_stage(
             "Public partition selection: dropped non public partitions; "

@@ -440,11 +440,13 @@ class TestMaxContributions:
                     [pdp.Metrics.PERCENTILE(50)], m=3,
                     min_value=0.0, max_value=1.0), extractors())
 
-    def test_jax_backend_falls_back_and_matches_local(self):
+    def test_fused_plane_matches_local(self):
+        from pipelinedp_tpu import jax_engine
         from pipelinedp_tpu.backends import JaxBackend
         noise_ops.seed_host_rng(0)
         data = dataset(n_users=30)
-        params = self._params([pdp.Metrics.COUNT, pdp.Metrics.SUM], m=10,
+        params = self._params([pdp.Metrics.COUNT, pdp.Metrics.SUM,
+                               pdp.Metrics.PRIVACY_ID_COUNT], m=10,
                               min_value=0.0, max_value=10.0)
         out = {}
         for name, backend in (("local", pdp.LocalBackend()),
@@ -453,10 +455,33 @@ class TestMaxContributions:
                                       backend=backend)
             result = engine.aggregate(data, params, extractors(),
                                       public_partitions=["a", "b", "c"])
+            if name == "jax":
+                assert isinstance(result, jax_engine.LazyFusedResult), (
+                    "total-cap mode must run on the fused plane")
             acc.compute_budgets()
-            out[name] = {k: (round(v.count), round(v.sum, 1))
-                         for k, v in dict(result).items()}
+            out[name] = {
+                k: (round(v.count), round(v.sum, 1),
+                    round(v.privacy_id_count))
+                for k, v in dict(result).items()
+            }
         assert out["local"] == out["jax"]
+
+    def test_fused_binding_cap_uniform_sample(self):
+        from pipelinedp_tpu.backends import JaxBackend
+        # One user, 90 rows over 3 partitions; M=30 keeps exactly 30
+        # rows total, spread uniformly (each partition expects ~10).
+        data = [(0, pk, 1.0) for pk in "abc" for _ in range(30)]
+        engine, acc = make_engine(eps=1e12, delta=1e-2,
+                                  backend=JaxBackend(rng_seed=3))
+        params = self._params([pdp.Metrics.COUNT], m=30)
+        result = engine.aggregate(data, params, extractors(),
+                                  public_partitions=["a", "b", "c"])
+        acc.compute_budgets()
+        out = {k: v.count for k, v in dict(result).items()}
+        assert sum(out.values()) == pytest.approx(30, abs=0.1)
+        # Uniform over rows, not over partitions: every partition keeps
+        # some rows with overwhelming probability.
+        assert all(v > 0.5 for v in out.values()), out
 
     def test_analysis_rejects_m(self):
         from pipelinedp_tpu import analysis
@@ -466,3 +491,18 @@ class TestMaxContributions:
         with pytest.raises(NotImplementedError, match="max_contributions"):
             analysis.perform_utility_analysis(
                 dataset(), pdp.LocalBackend(), options, extractors())
+
+    def test_custom_combiners_with_m_rejected(self):
+        engine, _ = make_engine()
+
+        class CC(pdp.CustomCombiner):
+            def create_accumulator(self, values): return 0
+            def merge_accumulators(self, a, b): return a + b
+            def compute_metrics(self, acc): return acc
+            def explain_computation(self): return "cc"
+            def request_budget(self, acc): pass
+
+        params = pdp.AggregateParams(metrics=None, max_contributions=3,
+                                     custom_combiners=[CC()])
+        with pytest.raises(NotImplementedError, match="custom"):
+            engine.aggregate(dataset(), params, extractors())

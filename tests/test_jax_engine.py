@@ -597,6 +597,23 @@ class TestShardedMultiChipBroad:
                                                      abs=0.5)
         assert sharded["p_empty"].count == pytest.approx(0.0, abs=0.5)
 
+    def test_max_contributions_on_mesh(self):
+        # Total-cap bounding on the mesh: per-pid sampling is shard-local
+        # (a pid's rows live on one shard), so sharded == single-device
+        # up to the independent sample draw; with a non-binding cap both
+        # equal the raw aggregates.
+        data = [(u, f"p{i}", 2.0) for u in range(60) for i in range(3)]
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            max_contributions=5, min_value=0.0, max_value=10.0)
+        single, sharded = self._both(data, params, seed=46,
+                                     public=[f"p{i}" for i in range(3)])
+        for k in single:
+            assert sharded[k].count == pytest.approx(single[k].count,
+                                                     abs=0.1)
+            assert sharded[k].sum == pytest.approx(single[k].sum, abs=0.5)
+            assert single[k].count == pytest.approx(60, abs=0.1)
+
     def test_uneven_shard_load(self):
         # One privacy id owns half the rows: hashing must still place all
         # its rows on one shard and results must match single-device.
