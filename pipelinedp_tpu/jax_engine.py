@@ -474,9 +474,12 @@ def _partials(config: FusedConfig, num_partitions: int, pid, pk, values,
     per-segment quantity in row space with cumsum/cummax (runs are
     contiguous after the sort). The hash key makes the within-pid segment
     order a fresh uniform permutation per run and per pid, so "ordinal
-    within pid < l0" IS the L0 cross-partition sample — no second sort, no
-    per-segment scatter. The only scatters left are the final per-pk
-    reductions (and, for per-partition-bound sums, one per-segment total)."""
+    within pid < l0" IS the L0 cross-partition sample — in (l0, linf)
+    mode no second sort and no per-segment scatter are needed; the only
+    scatters are the final per-pk reductions (and, for per-partition
+    -bound sums, one per-segment total). Total-cap mode
+    (``max_contributions``) pays one extra lexsort + row-space scatter
+    for its uniform per-pid row sample — see the branch below."""
     n = pid.shape[0]
     P = num_partitions
 

@@ -177,6 +177,38 @@ class TestDifferentialFuzz:
             total += v.count
         assert total <= spec["n_rows"] + 0.5, spec
 
+    @pytest.mark.parametrize("seed", range(50, 56))
+    def test_max_contributions_nonbinding_matches_oracle(self, seed):
+        # Total-cap mode with a cap no unit ever reaches: fused and local
+        # must agree exactly at huge eps.
+        spec = case_spec(seed)
+        rng = spec["rng"]
+        ds = make_dataset(rng, spec["n_rows"], spec["n_users"],
+                          spec["n_parts"])
+        rows_per_user = {}
+        for u in ds.privacy_ids.tolist():
+            rows_per_user[u] = rows_per_user.get(u, 0) + 1
+        metrics = [[pdp.Metrics.COUNT],
+                   [pdp.Metrics.COUNT, pdp.Metrics.SUM],
+                   [pdp.Metrics.PRIVACY_ID_COUNT],
+                   [pdp.Metrics.MEAN, pdp.Metrics.VARIANCE]][seed % 4]
+        kw = dict(metrics=metrics, noise_kind=spec["noise"],
+                  max_contributions=max(rows_per_user.values()) + 1)
+        if any(m.name != "COUNT" and m.name != "PRIVACY_ID_COUNT"
+               for m in metrics):
+            kw.update(min_value=0.0, max_value=10.0)
+        params = pdp.AggregateParams(**kw)
+        public = sorted(np.unique(ds.partition_keys).tolist())
+        fused = run_engine(JaxBackend(rng_seed=seed), ds, params, public)
+        local = run_engine(pdp.LocalBackend(), ds, params, public)
+        assert set(fused) == set(local) == set(public)
+        for k in public:
+            f, l = fused[k], local[k]
+            for field in f._fields:
+                assert getattr(f, field) == pytest.approx(
+                    getattr(l, field), rel=2e-3, abs=2e-2), (
+                        spec, k, field, f, l)
+
     @pytest.mark.parametrize("seed", [30, 31, 32])
     def test_bounds_already_enforced(self, seed):
         spec = case_spec(seed)

@@ -492,6 +492,48 @@ class TestMaxContributions:
             analysis.perform_utility_analysis(
                 dataset(), pdp.LocalBackend(), options, extractors())
 
+    def test_fused_binding_cap_excludes_sampled_away_segments(self):
+        from pipelinedp_tpu.backends import JaxBackend
+        # 3 users x 10 partitions x 4 rows each, M=6: most (pid, pk)
+        # segments are fully sampled away; the privacy-id count must
+        # reflect only segments that kept >= 1 row. With M=6 over 40 rows
+        # in 10 partitions, a user contributes to <= 6 partitions.
+        data = [(u, f"p{i}", 1.0) for u in range(3) for i in range(10)
+                for _ in range(4)]
+        engine, acc = make_engine(eps=1e12, delta=1e-2,
+                                  backend=JaxBackend(rng_seed=7))
+        params = self._params(
+            [pdp.Metrics.COUNT, pdp.Metrics.PRIVACY_ID_COUNT], m=6)
+        result = engine.aggregate(data, params, extractors(),
+                                  public_partitions=[f"p{i}"
+                                                     for i in range(10)])
+        acc.compute_budgets()
+        out = dict(result)
+        total_rows = sum(v.count for v in out.values())
+        total_pids = sum(v.privacy_id_count for v in out.values())
+        assert total_rows == pytest.approx(18, abs=0.2)  # 3 users x M
+        # Each user appears in at most 6 partitions (and at least 2,
+        # since a partition holds at most 4 of their rows).
+        assert 6 <= round(total_pids) <= 18, total_pids
+        for v in out.values():
+            # A partition's pid count never exceeds its kept-rows count.
+            assert v.privacy_id_count <= v.count + 0.2, v
+
+    def test_fused_binding_cap_with_private_selection(self):
+        from pipelinedp_tpu.backends import JaxBackend
+        # Binding cap + private selection: 200 users each with 6 rows in
+        # one hot partition (M=2 keeps 2), one lonely user elsewhere.
+        data = ([(u, "hot", 1.0) for u in range(200) for _ in range(6)] +
+                [(999, "tiny", 1.0)])
+        engine, acc = make_engine(eps=1e5, delta=1e-3,
+                                  backend=JaxBackend(rng_seed=9))
+        params = self._params([pdp.Metrics.COUNT], m=2)
+        result = engine.aggregate(data, params, extractors())
+        acc.compute_budgets()
+        out = dict(result)
+        assert "hot" in out and "tiny" not in out
+        assert out["hot"].count == pytest.approx(400, abs=1.0)
+
     def test_custom_combiners_with_m_rejected(self):
         engine, _ = make_engine()
 
