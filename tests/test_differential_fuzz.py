@@ -57,6 +57,18 @@ def run_engine(backend, ds, params, public, ext=None):
     return dict(res)
 
 
+def assert_fields_close(fused_row, local_row, context, skip=()):
+    """The per-field fused-vs-local comparison contract shared by the
+    fuzz tests; ``skip`` names fields checked separately (percentiles
+    get an order-statistic envelope instead of plane equality)."""
+    for field in fused_row._fields:
+        if field in skip:
+            continue
+        assert getattr(fused_row, field) == pytest.approx(
+            getattr(local_row, field), rel=2e-3, abs=2e-2), (
+                context, field, fused_row, local_row)
+
+
 def case_spec(seed):
     """Draws one random parameter-space point (deterministic per seed)."""
     rng = np.random.default_rng(seed)
@@ -125,30 +137,28 @@ class TestDifferentialFuzz:
             values_per_part.setdefault(p, []).append(v)
         for k in common:
             f, l = fused[k], local[k]
-            for field in f._fields:
-                if field.startswith("percentile_"):
-                    # At an exact rank boundary (e.g. the median of an
-                    # even count) the tree walk's child choice is decided
-                    # by vanishing noise, and ANY point between the two
-                    # adjacent order statistics is a valid quantile
-                    # estimate — the reference's C++ tree behaves the
-                    # same. Check both planes against the order-statistic
-                    # envelope instead of each other.
-                    q = float(field.split("_", 1)[1].replace("_", ".")) / 100
-                    s = sorted(values_per_part[k])
-                    m = len(s)
-                    kf = q * m
-                    lw = 10.0 / 16**4  # leaf width of the [0,10] tree
-                    lo = s[max(int(np.floor(kf)) - 1, 0)] - lw - 1e-3
-                    hi = s[min(int(np.ceil(kf)), m - 1)] + lw + 1e-3
-                    for plane, val in (("fused", getattr(f, field)),
-                                       ("local", getattr(l, field))):
-                        assert lo <= val <= hi, (
-                            spec, k, field, plane, val, (lo, hi))
-                else:
-                    assert getattr(f, field) == pytest.approx(
-                        getattr(l, field), rel=2e-3, abs=2e-2), (
-                            spec, k, field, f, l)
+            pct_fields = tuple(fl for fl in f._fields
+                               if fl.startswith("percentile_"))
+            assert_fields_close(f, l, (spec, k), skip=pct_fields)
+            for field in pct_fields:
+                # At an exact rank boundary (e.g. the median of an even
+                # count) the tree walk's child choice is decided by
+                # vanishing noise, and ANY point between the two adjacent
+                # order statistics is a valid quantile estimate — the
+                # reference's C++ tree behaves the same. Check both
+                # planes against the order-statistic envelope instead of
+                # each other.
+                q = float(field.split("_", 1)[1].replace("_", ".")) / 100
+                s = sorted(values_per_part[k])
+                m = len(s)
+                kf = q * m
+                lw = 10.0 / 16**4  # leaf width of the [0,10] tree
+                lo = s[max(int(np.floor(kf)) - 1, 0)] - lw - 1e-3
+                hi = s[min(int(np.ceil(kf)), m - 1)] + lw + 1e-3
+                for plane, val in (("fused", getattr(f, field)),
+                                   ("local", getattr(l, field))):
+                    assert lo <= val <= hi, (
+                        spec, k, field, plane, val, (lo, hi))
 
     @pytest.mark.parametrize("seed", range(14, 20))
     def test_binding_caps_invariants(self, seed):
@@ -203,11 +213,7 @@ class TestDifferentialFuzz:
         local = run_engine(pdp.LocalBackend(), ds, params, public)
         assert set(fused) == set(local) == set(public)
         for k in public:
-            f, l = fused[k], local[k]
-            for field in f._fields:
-                assert getattr(f, field) == pytest.approx(
-                    getattr(l, field), rel=2e-3, abs=2e-2), (
-                        spec, k, field, f, l)
+            assert_fields_close(fused[k], local[k], (spec, k))
 
     @pytest.mark.parametrize("seed", [30, 31, 32])
     def test_bounds_already_enforced(self, seed):

@@ -651,6 +651,36 @@ class TestFusedSelectPartitions:
         fused = self._run(JaxBackend(rng_seed=50), data)
         assert local == fused == ["p0", "p1", "p2", "p3"]
 
+    @pytest.mark.parametrize("seed", range(60, 66))
+    def test_fuzz_populated_partitions_kept_on_both_planes(self, seed):
+        # Random shapes: every partition with >= 40 distinct users must
+        # be kept by both planes at huge eps; 1-user partitions must be
+        # dropped by both at tiny delta.
+        rng = np.random.default_rng(seed)
+        n_parts = int(rng.integers(3, 12))
+        data = []
+        big = set()
+        uid = 0
+        for p in range(n_parts):
+            # The last partition is always a singleton so the must-drop
+            # branch below is exercised for every seed.
+            users = 1 if p == n_parts - 1 else int(rng.integers(2, 80))
+            if users >= 40:
+                big.add(f"p{p}")
+            for _ in range(users):
+                data.append((uid, f"p{p}"))
+                uid += 1
+        lone = [f"p{n_parts - 1}"]
+        noise_ops.seed_host_rng(seed)
+        local = set(self._run(pdp.LocalBackend(), data, l0=n_parts,
+                              delta=1e-6))
+        fused = set(self._run(JaxBackend(rng_seed=seed), data, l0=n_parts,
+                              delta=1e-6))
+        for k in big:
+            assert k in local and k in fused, (seed, k, local, fused)
+        for k in lone:
+            assert k not in local and k not in fused, (seed, k)
+
     def test_small_partition_dropped(self):
         data = [(u, "big") for u in range(2000)] + [(9999, "tiny")]
         fused = self._run(JaxBackend(rng_seed=51), data, eps=1.0,
