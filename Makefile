@@ -35,4 +35,4 @@ dev:
 clean:
 	rm -rf build *.egg-info .pytest_cache
 	find . -name __pycache__ -type d -exec rm -rf {} +
-	rm -f pipelinedp_tpu/native/_secure_noise.so
+	rm -f pipelinedp_tpu/native/_secure_noise.so pipelinedp_tpu/native/_encode.so
