@@ -346,14 +346,20 @@ class AdditiveVectorNoiseParams:
 
 def _clip_vector(vec: np.ndarray, max_norm: float,
                  norm_kind: NormKind) -> np.ndarray:
+    """Norm-clips ``vec``; batched over leading axes (the norm is taken
+    over the last axis), so one [D] vector and a [P, D] stack of
+    per-partition vectors share the implementation."""
     kind = norm_kind.value
     if kind == "linf":
         return np.clip(vec, -max_norm, max_norm)
     if kind in ("l1", "l2"):
-        vec_norm = np.linalg.norm(vec, ord=int(kind[-1]))
-        if vec_norm == 0:
-            return vec
-        return vec * min(1.0, max_norm / vec_norm)
+        norms = np.linalg.norm(vec, ord=int(kind[-1]), axis=-1,
+                               keepdims=True)
+        # Zero-norm rows pass through unscaled (factor 1), computed
+        # without dividing by ~0 (overflow warnings for huge max_norm).
+        factor = np.where(norms > max_norm, max_norm / np.where(
+            norms > 0, norms, 1.0), 1.0)
+        return vec * factor
     raise NotImplementedError(
         f"Vector norm of kind '{kind}' is not supported.")
 

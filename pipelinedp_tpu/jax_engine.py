@@ -13,8 +13,11 @@ noise) compiled into ONE XLA program over integer-encoded arrays:
             → L0 bound    = random rank of segment within pid < l0
             → per-pk accumulators (segment_sum)         [merge/combine]
             → batched partition selection over the pk axis
-            → one batched noise draw per mechanism
-    host:   decode pk vocabulary, wrap MetricsTuple rows
+            → batched percentile tree walk (when requested)
+    host:   float64 scalar release via the shared dp_computations
+            mechanisms (float32 device noise would quantize to a large
+            aggregate's ULP grid); decode pk vocabulary, wrap
+            MetricsTuple rows
 
 Two-phase budget protocol: noise scales, selection tables/thresholds and
 the PRNG key are *runtime inputs* to the compiled function — budgets are
@@ -445,8 +448,9 @@ def fused_aggregate_kernel(config: FusedConfig, num_partitions: int, pid,
 
     Runtime inputs:
       pid, pk: int32[N] (padded); values: f32[N] or f32[N, D]; valid:
-      bool[N] row mask; noise_scales: f32[K] per-mechanism noise scales in
-      metric order (see _noise_scales); keep_table: f32[T] truncated-
+      bool[N] row mask; noise_scales: f32[0 or 1] — only the percentile
+      tree's per-level scale (the scalar release runs on host, see
+      _host_release); keep_table: f32[T] truncated-
       geometric keep probabilities (unused for thresholding strategies);
       sel_threshold/sel_scale: f32 scalars for thresholding strategies;
       key: PRNG key.
@@ -682,19 +686,25 @@ def _selection_and_metrics(config: FusedConfig, num_partitions: int, part,
                 est_users >= sel_min_count)  # pre-threshold hard floor
         keep_pk = keep_pk & (part_nseg > 0)
 
-    # --- metrics + one batched noise draw per mechanism ---
-    metrics = _compute_metrics(config, part, part_nseg, noise_scales,
-                               k_noise, P)
+    # --- accumulator partials out; the scalar release happens on HOST in
+    # float64 (see LazyFusedResult._host_release): float32 noise on a
+    # large aggregate quantizes to the value's ULP grid, which both
+    # distorts the calibrated distribution and leaks through rounding
+    # (the reference's release path is float64 end-to-end). Percentiles
+    # stay on device: their noisy node counts are small integers where
+    # float32 granularity is irrelevant, and the walk needs the rows.
+    out = dict(part)
+    out["privacy_id_count_raw"] = part_nseg
     if config.percentiles:
         # Percentile noise scale is the last _noise_scales entry; the tree
-        # key is independent of the metric-noise key stream.
+        # key is independent of the selection key stream.
         k_tree = jax.random.fold_in(k_noise, 0x7ee)
         vals = _percentile_values(config, P, qrows, noise_scales[-1],
                                   k_tree, psum_axis)
         for qi, name in enumerate(_percentile_field_names(
                 config.percentiles)):
-            metrics[name] = vals[:, qi]
-    return keep_pk, metrics
+            out[name] = vals[:, qi]
+    return keep_pk, out
 
 
 def _percentile_field_names(percentiles) -> List[str]:
@@ -856,81 +866,78 @@ def _clip_values(config: FusedConfig, values):
 
 
 
-def _compute_metrics(config: FusedConfig, part, part_nseg, noise_scales,
-                     key, P):
-    """Vectorized mirror of dp_computations.compute_dp_* over the pk axis.
-    ``noise_scales`` is indexed in the order produced by _noise_scales."""
-    keys = jax.random.split(key, 8)
+def _release_noise_params(config: FusedConfig,
+                          spec) -> dp_computations.ScalarNoiseParams:
+    """The exact ScalarNoiseParams the generic combiners would build for
+    this metric's spec — one noise calculus for both planes."""
+    return dp_computations.ScalarNoiseParams(
+        eps=spec.eps, delta=spec.delta,
+        min_value=config.min_value, max_value=config.max_value,
+        min_sum_per_partition=config.min_sum_per_partition,
+        max_sum_per_partition=config.max_sum_per_partition,
+        max_partitions_contributed=config.l0,
+        max_contributions_per_partition=config.linf,
+        noise_kind=config.noise_kind,
+        max_contributions=config.max_contributions)
+
+
+def _host_release(config: FusedConfig, specs, part, nseg,
+                  rng: Optional[np.random.Generator]):
+    """The scalar DP release, on host in float64: literally the
+    ``dp_computations.compute_dp_*`` mechanisms the generic combiners
+    call, vectorized over the pk axis. Reusing them (instead of a
+    float32 device twin) keeps one release implementation for both
+    planes, draws noise at full precision — float32 noise quantizes to
+    a large aggregate's ULP grid — and inherits the hardened host noise
+    path when ``set_secure_host_noise(True)``. ``part`` holds float64
+    views of the fetched accumulator columns."""
     names = set(config.metrics)
     out = {}
-    si = 0
-
-    def draw(k, shape):
-        if config.noise_kind == NoiseKind.LAPLACE:
-            return jax.random.laplace(k, shape)
-        return jax.random.normal(k, shape)
-
     if "VARIANCE" in names or "MEAN" in names:
-        count = part["count"].astype(jnp.float32)
-        dp_count = count + draw(keys[0], (P,)) * noise_scales[si]
-        si += 1
-        dp_nmean = (part["nsum"] + draw(keys[1], (P,)) * noise_scales[si]
-                    ) / jnp.maximum(1.0, dp_count)
-        si += 1
-        middle = dp_computations.compute_middle(config.min_value,
-                                                config.max_value)
+        snp = _release_noise_params(config, specs["mean_var"])
         if "VARIANCE" in names:
-            dp_nmean_sq = (part["nsumsq"] +
-                           draw(keys[2], (P,)) * noise_scales[si]
-                           ) / jnp.maximum(1.0, dp_count)
-            si += 1
-            out["variance"] = dp_nmean_sq - dp_nmean**2
-        dp_mean = dp_nmean + middle
-        if config.min_value == config.max_value:
-            dp_mean = jnp.full((P,), config.min_value)
-        out["mean"] = dp_mean
+            dp_count, dp_sum, dp_mean, dp_var = (
+                dp_computations.compute_dp_var(part["count"], part["nsum"],
+                                               part["nsumsq"], snp, rng))
+            out["variance"] = dp_var
+        else:
+            dp_count, dp_sum, dp_mean = dp_computations.compute_dp_mean(
+                part["count"], part["nsum"], snp, rng)
+        if "MEAN" in names:
+            out["mean"] = dp_mean
         if "COUNT" in names:
             out["count"] = dp_count
         if "SUM" in names:
-            out["sum"] = dp_mean * dp_count
-        if "VARIANCE" not in names:
-            out.pop("variance", None)
-        if "MEAN" not in names:
-            out.pop("mean", None)
+            out["sum"] = dp_sum
     else:
         if "COUNT" in names:
-            out["count"] = part["count"].astype(jnp.float32) + draw(
-                keys[0], (P,)) * noise_scales[si]
-            si += 1
+            out["count"] = dp_computations.compute_dp_count(
+                part["count"], _release_noise_params(config,
+                                                     specs["count"]), rng)
         if "SUM" in names:
-            out["sum"] = part["sum"] + draw(keys[1],
-                                            (P,)) * noise_scales[si]
-            si += 1
+            out["sum"] = dp_computations.compute_dp_sum(
+                part["sum"], _release_noise_params(config, specs["sum"]),
+                rng)
     if "PRIVACY_ID_COUNT" in names:
-        out["privacy_id_count"] = part_nseg.astype(jnp.float32) + draw(
-            keys[3], (P,)) * noise_scales[si]
-        si += 1
+        out["privacy_id_count"] = dp_computations.compute_dp_privacy_id_count(
+            nseg, _release_noise_params(config, specs["privacy_id_count"]),
+            rng)
     if "VECTOR_SUM" in names:
-        vec = part["vector_sum"]
-        vec = _apply_vector_norm_clip(config, vec)
-        out["vector_sum"] = vec + draw(keys[4],
-                                       vec.shape) * noise_scales[si]
-        si += 1
+        spec = specs["vector_sum"]
+        # add_noise_vector is batched over leading axes: the whole
+        # [P, D] stack clips + noises in one call, exactly like the
+        # generic VectorSumCombiner's per-vector release.
+        out["vector_sum"] = dp_computations.add_noise_vector(
+            part["vector_sum"],
+            dp_computations.AdditiveVectorNoiseParams(
+                eps_per_coordinate=spec.eps / config.vector_size,
+                delta_per_coordinate=spec.delta / config.vector_size,
+                max_norm=config.vector_max_norm,
+                l0_sensitivity=config.l0,
+                linf_sensitivity=config.linf,
+                norm_kind=config.vector_norm_kind,
+                noise_kind=config.noise_kind), rng)
     return out
-
-
-def _apply_vector_norm_clip(config: FusedConfig, vec):
-    """Clips the per-pk vector by the configured norm before noising —
-    exactly where the reference clips (``dp_computations.py:189-222``:
-    ``add_noise_vector`` clips the queried vector, then noises)."""
-    max_norm = config.vector_max_norm
-    kind = config.vector_norm_kind
-    if kind == NormKind.Linf:
-        return jnp.clip(vec, -max_norm, max_norm)
-    ord_ = 1 if kind == NormKind.L1 else 2
-    norms = jnp.linalg.norm(vec, ord=ord_, axis=-1, keepdims=True)
-    factor = jnp.minimum(1.0, max_norm / jnp.maximum(norms, 1e-30))
-    return vec * factor
 
 
 # ---------------------------------------------------------------------------
@@ -940,98 +947,29 @@ def _apply_vector_norm_clip(config: FusedConfig, vec):
 
 def _noise_scales(config: FusedConfig,
                   specs: Dict[str, Any]) -> np.ndarray:
-    """Per-mechanism noise scales in the order _compute_metrics consumes
-    them. For Laplace the scale is b = L1/eps; for Gaussian it is sigma."""
+    """Device-side noise-scale inputs. Since the scalar release moved to
+    the host float64 path (_host_release), the only scale the kernel
+    still consumes is the percentile tree's per-level node-noise scale —
+    always the LAST entry (consumed as ``noise_scales[-1]``). The budget
+    is split evenly across tree levels, like the host tree
+    (ops/quantile_tree.py:159-171)."""
     from pipelinedp_tpu.ops import noise as noise_ops
 
-    scales = []
-    names = set(config.metrics)
-    # Count-like (l0, linf): the ONE shared calculus with the host
-    # mechanisms (dp_computations.count_sensitivity_pair).
+    if not config.percentiles:
+        return np.zeros(0, dtype=np.float32)
     l0, linf = dp_computations.count_sensitivity_pair(
         config.l0, config.linf, config.max_contributions)
-
-    def scale(eps, delta, linf_sens, l0_sens=None):
-        if linf_sens == 0:
-            return 0.0
-        l0_eff = l0 if l0_sens is None else l0_sens
-        if config.noise_kind == NoiseKind.LAPLACE:
-            return noise_ops.laplace_scale(
-                eps,
-                dp_computations.compute_l1_sensitivity(l0_eff, linf_sens))
-        return noise_ops.gaussian_sigma(
-            eps, delta, dp_computations.compute_l2_sensitivity(
-                l0_eff, linf_sens))
-
-    if "VARIANCE" in names or "MEAN" in names:
-        spec = specs["mean_var"]
-        n_mech = 3 if "VARIANCE" in names else 2
-        budgets = dp_computations.equally_split_budget(
-            spec.eps, spec.delta, n_mech)
-        scales.append(scale(budgets[0][0], budgets[0][1], linf))
-        middle = dp_computations.compute_middle(config.min_value,
-                                                config.max_value)
-        if config.min_value == config.max_value:
-            scales.append(0.0)
-        else:
-            scales.append(
-                scale(budgets[1][0], budgets[1][1],
-                      linf * abs(middle - config.min_value)))
-        if "VARIANCE" in names:
-            sq_lo, sq_hi = dp_computations.compute_squares_interval(
-                config.min_value, config.max_value)
-            sq_mid = dp_computations.compute_middle(sq_lo, sq_hi)
-            if sq_lo == sq_hi:
-                scales.append(0.0)
-            else:
-                scales.append(
-                    scale(budgets[2][0], budgets[2][1],
-                          linf * abs(sq_mid - sq_lo)))
+    spec = specs["percentile"]
+    height = quantile_tree_ops.DEFAULT_TREE_HEIGHT
+    eps_l = spec.eps / height
+    if config.noise_kind == NoiseKind.LAPLACE:
+        scale = noise_ops.laplace_scale(
+            eps_l, dp_computations.compute_l1_sensitivity(l0, linf))
     else:
-        if "COUNT" in names:
-            spec = specs["count"]
-            scales.append(scale(spec.eps, spec.delta, linf))
-        if "SUM" in names:
-            spec = specs["sum"]
-            if config.per_partition_bounds:
-                linf_sum = max(abs(config.min_sum_per_partition),
-                               abs(config.max_sum_per_partition))
-                # Per-partition bounds cap each partition's sum directly;
-                # in total-cap mode a unit touches <= M partitions.
-                scales.append(scale(spec.eps, spec.delta, linf_sum,
-                                    l0_sens=config.selection_l0))
-            else:
-                linf_sum = linf * max(abs(config.min_value),
-                                      abs(config.max_value))
-                scales.append(scale(spec.eps, spec.delta, linf_sum))
-    if "PRIVACY_ID_COUNT" in names:
-        # The shared pid-count calculus (tight (M, 1) in total-cap mode,
-        # reference-parity (l0, linf) in pair mode) — matches
-        # compute_dp_privacy_id_count.
-        spec = specs["privacy_id_count"]
-        pid_l0, pid_linf = dp_computations.pid_count_sensitivity_pair(
-            config.l0, config.linf, config.max_contributions)
-        scales.append(scale(spec.eps, spec.delta, pid_linf,
-                            l0_sens=pid_l0))
-    if "VECTOR_SUM" in names:
-        spec = specs["vector_sum"]
-        eps_c = spec.eps / config.vector_size
-        delta_c = spec.delta / config.vector_size
-        scales.append(scale(eps_c, delta_c, linf))
-    if config.percentiles:
-        # Budget split evenly across tree levels, like the host tree
-        # (ops/quantile_tree.py:159-171): one scale serves every level.
-        spec = specs["percentile"]
-        height = quantile_tree_ops.DEFAULT_TREE_HEIGHT
-        eps_l = spec.eps / height
-        if config.noise_kind == NoiseKind.LAPLACE:
-            scales.append(noise_ops.laplace_scale(
-                eps_l, dp_computations.compute_l1_sensitivity(l0, linf)))
-        else:
-            scales.append(noise_ops.gaussian_sigma(
-                eps_l, spec.delta / height,
-                dp_computations.compute_l2_sensitivity(l0, linf)))
-    return np.asarray(scales, dtype=np.float32)
+        scale = noise_ops.gaussian_sigma(
+            eps_l, spec.delta / height,
+            dp_computations.compute_l2_sensitivity(l0, linf))
+    return np.asarray([scale], dtype=np.float32)
 
 
 def selection_inputs(config: FusedConfig, eps: float, delta: float,
@@ -1197,27 +1135,55 @@ class LazyFusedResult:
                 config, 1.0, 1e-9, None)
 
         t1 = _time.perf_counter()
-        keep_pk, metrics = _run_fused_kernel(
+        keep_pk, raw = _run_fused_kernel(
             config, encoded, scales, keep_table, thr, s_scale, min_count,
             rows_per_uid, self._rng_seed, self._mesh)
 
         # Fetching the outputs forces device execution; the fetch is
-        # attributed to device_s, pure-Python row assembly to decode_s.
-        # All rank-1 outputs ride ONE stacked transfer — the tunneled
-        # host<->device link pays per round trip, not per byte here.
-        fields = _metric_field_order(config)
-        flat = [f for f in fields if metrics[f].ndim == 1]
-        stacked = np.asarray(jnp.stack(
-            [keep_pk.astype(jnp.float32)] +
-            [metrics[f].astype(jnp.float32) for f in flat]))
-        keep_np = stacked[0, :P] > 0.5
-        metric_arrays = {f: stacked[1 + i, :] for i, f in enumerate(flat)}
-        for f in fields:
-            if f not in metric_arrays:  # rank-2 (vector) outputs
-                metric_arrays[f] = np.asarray(metrics[f])
+        # attributed to device_s, the float64 release + row assembly to
+        # decode_s. All rank-1 outputs ride ONE stacked transfer — the
+        # tunneled host<->device link pays per round trip, not per byte
+        # here. The stack is int32 with float columns BITCAST into it:
+        # integer lanes move bit-exactly, whereas small ints bitcast to
+        # float32 become subnormals that TPUs flush to zero (and a
+        # float32 CAST would corrupt counts above 2^24).
+        flat = sorted(k for k, v in raw.items() if v.ndim == 1)
+        cols = [keep_pk.astype(jnp.int32)]
+        for name in flat:
+            arr = raw[name]
+            cols.append(arr if arr.dtype == jnp.int32 else
+                        jax.lax.bitcast_convert_type(
+                            arr.astype(jnp.float32), jnp.int32))
+        stacked = np.asarray(jnp.stack(cols))
+        keep_np = stacked[0, :P] > 0
+        fetched = {}
+        for i, name in enumerate(flat):
+            col = stacked[1 + i, :P]
+            fetched[name] = (col if raw[name].dtype == jnp.int32 else
+                             col.view(np.float32))
+        for name, arr in raw.items():  # rank-2 (vector) outputs
+            if arr.ndim != 1:
+                fetched[name] = np.asarray(arr)[:P]
         self.timings["device_s"] = _time.perf_counter() - t1
 
-        t2 = _time.perf_counter()
+        # The scalar DP release, in float64 via the shared mechanisms.
+        # Integer columns stay integral: the hardened noise path
+        # dispatches on dtype (discrete Laplace for counts — no float
+        # noise bits), exactly like the generic combiners' int
+        # accumulators.
+        t_rel = _time.perf_counter()
+        part64 = {
+            k: (v.astype(np.int64) if v.dtype.kind in "iu" else
+                v.astype(np.float64)) for k, v in fetched.items()
+        }
+        rng = (np.random.default_rng(self._rng_seed)
+               if self._rng_seed is not None else None)
+        metric_arrays = _host_release(config, self._specs, part64,
+                                      part64["privacy_id_count_raw"], rng)
+        for name in _percentile_field_names(config.percentiles):
+            metric_arrays[name] = fetched[name]
+        fields = _metric_field_order(config)
+
         # Only materialize kept partitions (with private selection the kept
         # fraction can be tiny — never walk the full pk axis in Python).
         kept_idx = (np.arange(P) if self._public is not None else
@@ -1238,7 +1204,7 @@ class LazyFusedResult:
                 "MetricsTuple", tuple_fields, vals))
             for i, vals in zip(kept_idx.tolist(), zip(*columns))
         ]
-        self.timings["host_decode_s"] = _time.perf_counter() - t2
+        self.timings["host_decode_s"] = _time.perf_counter() - t_rel
         return out
 
 

@@ -12,9 +12,12 @@ TPU-native equivalent implemented here:
   partition axis; the cross-shard exchange (the reference's shuffle 3 /
   ``CombinePerKey``) is a single ``psum`` over ICI — the collective rides
   the mesh instead of a datacenter shuffle.
-* Selection probabilities and metric noise are drawn with identical PRNG
-  keys on every device, so the final per-partition results are replicated
-  and any host can read them.
+* Selection probabilities (and percentile tree-node noise) are drawn
+  with identical PRNG keys on every device, so the keep decisions and
+  accumulator outputs are replicated and any host can read them. The
+  scalar DP release itself happens later, on host in float64
+  (``jax_engine.LazyFusedResult._host_release``) — the arrays returned
+  here are raw (un-noised) accumulators.
 
 The same code runs on a virtual CPU mesh
 (``--xla_force_host_platform_device_count``) for tests and on real
@@ -103,8 +106,9 @@ def sharded_fused_aggregate(mesh: Mesh, config, num_partitions: int,
                             key):
     """Host entry: re-shards rows by hash(pid), pads each shard to a
     common length, places arrays over the mesh and runs the sharded
-    kernel. Returns (keep_pk[P], metrics dict) — replicated, so values
-    are addressable from the host."""
+    kernel. Returns (keep_pk[P], accumulator dict) — replicated, so
+    values are addressable from the host; the scalar release happens
+    downstream on host."""
     n_dev = mesh.devices.size
     # Hash before the modulo: raw ids pass through the encode step
     # unchanged, and id families sharing a residue class (all-even user

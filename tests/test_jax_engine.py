@@ -126,6 +126,24 @@ class TestFusedEdgeCases:
         assert got[0].count == pytest.approx(5.0, abs=1e-2)
         assert got[0].sum == pytest.approx(5.0, abs=1e-2)
 
+    def test_count_without_values_column(self):
+        # values=None COUNT: the int32 count column must survive the
+        # stacked transfer bit-exactly (on real TPUs, small ints bitcast
+        # to float32 are subnormals and get flushed to zero).
+        ds = pdp.ArrayDataset(privacy_ids=np.arange(500),
+                              partition_keys=np.zeros(500, np.int64),
+                              values=None)
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1)
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=1e12,
+                                        total_delta=1e-2)
+        engine = pdp.DPEngine(acc, JaxBackend(rng_seed=0))
+        res = engine.aggregate(ds, params, pdp.DataExtractors(),
+                               public_partitions=[0])
+        acc.compute_budgets()
+        assert dict(res)[0].count == pytest.approx(500, abs=0.01)
+
     def test_negative_keys_roundtrip(self):
         got = self._run(
             pdp.ArrayDataset(privacy_ids=np.array([-5, -5, 7]),
