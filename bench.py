@@ -68,17 +68,21 @@ def run_once(backend, dataset, params, eps=1.0, delta=1e-6):
     return len(out), dt, getattr(result, "timings", None)
 
 
-def bench_config(name, params, fused_ds, local_rows, repeats=3):
-    """One BASELINE config: local prefix baseline + best-of-N fused run."""
+def bench_config(name, params, fused_ds, local_rows, repeats=5):
+    """One BASELINE config: local prefix baseline + best-of-N fused run.
+    Best-of-5 because the tunneled host link's throughput swings ~4x
+    between quiet and busy windows; the best run reflects the pipeline,
+    not the link's worst moment."""
     import pipelinedp_tpu as pdp
     from pipelinedp_tpu.backends import JaxBackend
 
     local_ds = slice_dataset(fused_ds, local_rows)
-    # Best-of-2, mirroring the fused side's best-of-N: both sides of the
-    # ratio suffer run-to-run host noise, so neither gets a lucky draw.
+    # Same best-of-N on both sides of the ratio: each side reports its
+    # quietest window (host load for local, link load for fused), so the
+    # sampling quantile is symmetric and neither gets a luckier draw.
     n_local, local_dt, _ = min(
-        (run_once(pdp.LocalBackend(), local_ds, params) for _ in range(2)),
-        key=lambda r: r[1])
+        (run_once(pdp.LocalBackend(), local_ds, params)
+         for _ in range(repeats)), key=lambda r: r[1])
     local_rps = local_rows / local_dt
 
     backend = JaxBackend(rng_seed=0)
@@ -279,7 +283,7 @@ def main():
                 max_partitions_contributed=4,
                 max_contributions_per_partition=2,
                 min_value=0.0, max_value=10.0),
-            ds_q, min(local_rows, 50_000))
+            ds_q, min(local_rows, 50_000), repeats=3)  # 10M rows: 3 is enough
 
         # Config 5: the analysis epsilon-sweep.
         bench_analysis_sweep(a_rows, max(1000, a_rows // 25),
