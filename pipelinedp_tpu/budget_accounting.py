@@ -195,6 +195,13 @@ class BudgetAccountant(abc.ABC):
             scope._mechanisms.append(mechanism)
         return mechanism
 
+    def _check_not_finalized(self):
+        """A second compute_budgets() would silently re-split the budget
+        (possibly after more requests slipped in) — the reference raises
+        (``budget_accounting.py:368-372``)."""
+        if self._finalized:
+            raise Exception("compute_budgets can not be called twice.")
+
     def _check_not_in_scope(self):
         """compute_budgets inside an open scope would see un-normalised
         weights (normalisation happens on scope exit) — the reference raises
@@ -258,10 +265,24 @@ class BudgetAccountant(abc.ABC):
                        ) -> MechanismSpec:
         """Registers a mechanism; returns a lazy spec."""
 
-    @abc.abstractmethod
     def compute_budgets(self) -> None:
         """Distributes the total budget over all registered mechanisms,
-        mutating every MechanismSpec in place."""
+        mutating every MechanismSpec in place. Template method: runs the
+        shared finalize checks once, so no subclass can forget them, then
+        dispatches to the accountant's ``_compute_budgets``."""
+        self._check_not_finalized()
+        self._check_not_in_scope()
+        self._check_aggregation_restrictions()
+        self._finalized = True
+        if not self._mechanisms:
+            logging.warning("No budgets were requested.")
+            return
+        self._compute_budgets()
+
+    @abc.abstractmethod
+    def _compute_budgets(self) -> None:
+        """The accountant-specific budget split; mechanisms are
+        non-empty and the accountant is already finalized."""
 
 
 class NaiveBudgetAccountant(BudgetAccountant):
@@ -291,13 +312,7 @@ class NaiveBudgetAccountant(BudgetAccountant):
                                   mechanism_spec=spec))
         return spec
 
-    def compute_budgets(self) -> None:
-        self._check_not_in_scope()
-        self._check_aggregation_restrictions()
-        self._finalized = True
-        if not self._mechanisms:
-            logging.warning("No budgets were requested.")
-            return
+    def _compute_budgets(self) -> None:
         total_weight_eps = 0.0
         total_weight_delta = 0.0
         for m in self._mechanisms:
@@ -365,13 +380,7 @@ class PLDBudgetAccountant(BudgetAccountant):
                                   mechanism_spec=spec))
         return spec
 
-    def compute_budgets(self) -> None:
-        self._check_not_in_scope()
-        self._check_aggregation_restrictions()
-        self._finalized = True
-        if not self._mechanisms:
-            logging.warning("No budgets were requested.")
-            return
+    def _compute_budgets(self) -> None:
         from pipelinedp_tpu import pld as pld_lib
         sum_weights = sum(m.weight for m in self._mechanisms)
         if self._total_delta == 0:

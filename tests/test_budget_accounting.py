@@ -4,7 +4,8 @@ import pytest
 
 from pipelinedp_tpu.aggregate_params import MechanismType
 from pipelinedp_tpu.budget_accounting import (MechanismSpec,
-                                              NaiveBudgetAccountant)
+                                              NaiveBudgetAccountant,
+                                              PLDBudgetAccountant)
 
 
 class TestMechanismSpec:
@@ -137,3 +138,34 @@ class TestNaiveBudgetAccountant:
         assert budget2.epsilon == pytest.approx(0.5)
         acc3 = NaiveBudgetAccountant(total_epsilon=2.0, total_delta=2e-6)
         assert acc3._compute_budget_for_aggregation(1) is None
+
+
+class TestCountAndDoubleCompute:
+
+    def test_count_divides_budget_per_use(self):
+        # count=4 declares four uses of one mechanism: each use receives
+        # a quarter of the (single-mechanism) budget.
+        acc = NaiveBudgetAccountant(total_epsilon=1.0, total_delta=0.0)
+        spec = acc.request_budget(MechanismType.LAPLACE, count=4)
+        acc.compute_budgets()
+        assert spec.eps == pytest.approx(0.25)
+
+    def test_count_composes_with_other_mechanisms(self):
+        acc = NaiveBudgetAccountant(total_epsilon=1.0, total_delta=0.0)
+        four = acc.request_budget(MechanismType.LAPLACE, count=4)
+        one = acc.request_budget(MechanismType.LAPLACE)
+        acc.compute_budgets()
+        # Weights: 4 uses + 1 use = 5 shares of eps.
+        assert four.eps == pytest.approx(0.2)
+        assert one.eps == pytest.approx(0.2)
+
+    @pytest.mark.parametrize("make", [
+        lambda: NaiveBudgetAccountant(total_epsilon=1.0, total_delta=1e-6),
+        lambda: PLDBudgetAccountant(total_epsilon=1.0, total_delta=1e-6),
+    ])
+    def test_compute_budgets_twice_raises(self, make):
+        acc = make()
+        acc.request_budget(MechanismType.GAUSSIAN)
+        acc.compute_budgets()
+        with pytest.raises(Exception, match="twice"):
+            acc.compute_budgets()
