@@ -299,8 +299,8 @@ class NaiveBudgetAccountant(BudgetAccountant):
                        ) -> MechanismSpec:
         if noise_standard_deviation is not None:
             raise NotImplementedError(
-                "Count and noise standard deviation have not been "
-                "implemented yet for NaiveBudgetAccountant.")
+                "noise_standard_deviation is not implemented for "
+                "NaiveBudgetAccountant (count IS supported).")
         if mechanism_type == MechanismType.GAUSSIAN and (
                 self._total_delta == 0):
             raise AssertionError(
