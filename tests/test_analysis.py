@@ -750,7 +750,7 @@ class TestFusedSweepFuzz:
     _run_both = staticmethod(TestFusedSweep._run_both)
     _assert_metrics_close = staticmethod(TestFusedSweep._assert_metrics_close)
 
-    @pytest.mark.parametrize("seed", range(6))
+    @pytest.mark.parametrize("seed", range(9))
     def test_random_config(self, seed):
         from pipelinedp_tpu.ops import noise as noise_ops
         # The host oracle Monte-Carlos its Laplace error quantiles from

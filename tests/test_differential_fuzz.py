@@ -91,7 +91,7 @@ def case_spec(seed):
 
 class TestDifferentialFuzz:
 
-    @pytest.mark.parametrize("seed", range(14))
+    @pytest.mark.parametrize("seed", range(20))
     def test_nonbinding_caps_match_oracle(self, seed):
         spec = case_spec(seed)
         rng = spec["rng"]
@@ -160,7 +160,7 @@ class TestDifferentialFuzz:
                     assert lo <= val <= hi, (
                         spec, k, field, plane, val, (lo, hi))
 
-    @pytest.mark.parametrize("seed", range(14, 20))
+    @pytest.mark.parametrize("seed", range(20, 28))
     def test_binding_caps_invariants(self, seed):
         spec = case_spec(seed)
         rng = spec["rng"]
