@@ -217,7 +217,9 @@ def secure_host_noise_enabled() -> bool:
 
 
 # ---------------------------------------------------------------------------
-# Device (JAX) sampling — one batched draw over all partitions
+# Device (JAX) sampling — utilities for device-side noise (the scalar
+# release itself runs on host in float64, see jax_engine._host_release;
+# on-device draws remain for the percentile tree walk and custom kernels)
 # ---------------------------------------------------------------------------
 
 
