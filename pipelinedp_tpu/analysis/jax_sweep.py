@@ -79,8 +79,6 @@ def sweep_is_supported(options: data_structures.UtilityAnalysisOptions,
     graph (which remains the oracle)."""
     if return_per_partition or options.pre_aggregated_data:
         return False
-    if options.partitions_sampling_prob < 1:
-        return False
     params = options.aggregate_params
     if (params.max_partitions_contributed is None or
             params.max_contributions_per_partition is None):
@@ -736,6 +734,27 @@ class LazySweepResult:
             encoded, None, with_values=Metrics.SUM in params.metrics)
         marker, pk_safe, count_u, sum_u, npart_u = _preagg_kernel(
             pid, pk, values, valid)
+        if options.partitions_sampling_prob < 1:
+            # Deterministic partition sampling, identical to the host
+            # bounder's ValueSampler (SHA1 of the ORIGINAL key): drop the
+            # sampled-out partitions' user records after stage A, so
+            # npart_u still reflects each privacy id's pre-sampling
+            # spread (reference analysis/contribution_bounders.py:38-75).
+            # A sampled-out partition then looks empty downstream: it is
+            # excluded privately, or pseudo-filled like a missing public
+            # partition — both matching the host graph.
+            from pipelinedp_tpu.sampling_utils import ValueSampler
+            sampler = ValueSampler(options.partitions_sampling_prob)
+            sampled_np = np.zeros(P_pad, bool)
+            for i, k in enumerate(encoded.pk_vocab):
+                # The host sampler hashes the ROW-extracted key (a Python
+                # scalar); a public_partitions list can put numpy scalars
+                # in the vocab, whose repr differs — normalize so both
+                # planes sample the same subset.
+                if isinstance(k, np.generic):
+                    k = k.item()
+                sampled_np[i] = sampler.keep(k)
+            marker = marker & jnp.asarray(sampled_np)[pk_safe]
         users_pk = jax.ops.segment_sum(marker.astype(jnp.int32), pk_safe,
                                        num_segments=P_pad)
         # Partitions beyond the real vocab must not count as public.

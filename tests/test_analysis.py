@@ -570,18 +570,24 @@ class TestFusedSweep:
                 a.count_metrics.error_variance, rel=1e-5)
 
     def test_host_fallback_paths(self):
-        # Pre-aggregated data and per-partition results use the host graph.
+        # Pre-aggregated data and per-partition results use the host
+        # graph; partition sampling is fused (TestFusedSweepSampling).
         from pipelinedp_tpu.analysis import jax_sweep
         options = analysis.UtilityAnalysisOptions(
             epsilon=1.0, delta=1e-6,
             aggregate_params=count_params(l0=2, linf=1),
             partitions_sampling_prob=0.5)
-        assert not jax_sweep.sweep_is_supported(options, None, False)
+        assert jax_sweep.sweep_is_supported(options, None, False)
         options2 = analysis.UtilityAnalysisOptions(
             epsilon=1.0, delta=1e-6,
             aggregate_params=count_params(l0=2, linf=1))
         assert not jax_sweep.sweep_is_supported(options2, None, True)
         assert jax_sweep.sweep_is_supported(options2, None, False)
+        pre = analysis.UtilityAnalysisOptions(
+            epsilon=1.0, delta=1e-6,
+            aggregate_params=count_params(l0=2, linf=1),
+            pre_aggregated_data=True)
+        assert not jax_sweep.sweep_is_supported(pre, None, False)
 
 
 class TestAnalysisErrorModelClosedForm:
@@ -739,6 +745,42 @@ class TestAnalysisErrorModelClosedForm:
         assert accs[1].kept_partitions_expected == 1.0
         assert accs[3].kept_partitions_expected == 0.25
         assert accs[3].error_l0_expected == pytest.approx(0.25 * -4.0)
+
+
+class TestFusedSweepSampling:
+    """partitions_sampling_prob on the device sweep: both planes use the
+    same deterministic SHA1 sampler, so they analyze the same subset."""
+
+    @pytest.mark.parametrize("public", [False, True])
+    def test_sampling_matches_host(self, public):
+        from pipelinedp_tpu.ops import noise as noise_ops
+        noise_ops.seed_host_rng(0)
+        ds = TestFusedSweep._dataset(n=3000, users=200, parts=30, seed=9)
+        options = analysis.UtilityAnalysisOptions(
+            epsilon=1.0, delta=1e-6,
+            aggregate_params=count_params(l0=3, linf=2),
+            partitions_sampling_prob=0.5)
+        pub = (sorted(np.unique(ds.partition_keys).tolist())
+               if public else None)
+        host, fused = TestFusedSweep._run_both(ds, options, public=pub)
+        h, f = host[0], fused[0]
+        TestFusedSweep._assert_metrics_close(h.count_metrics,
+                                             f.count_metrics)
+        if not public:
+            assert (f.partition_selection_metrics.num_partitions ==
+                    h.partition_selection_metrics.num_partitions)
+            # Sampling at 0.5 must actually have dropped partitions.
+            assert h.partition_selection_metrics.num_partitions < 30
+
+    def test_sampling_prob_one_unchanged(self):
+        ds = TestFusedSweep._dataset(n=1000, users=100, parts=10, seed=10)
+        options = analysis.UtilityAnalysisOptions(
+            epsilon=1.0, delta=1e-6,
+            aggregate_params=count_params(l0=2, linf=2),
+            partitions_sampling_prob=1)
+        host, fused = TestFusedSweep._run_both(ds, options)
+        assert (fused[0].partition_selection_metrics.num_partitions ==
+                host[0].partition_selection_metrics.num_partitions == 10)
 
 
 class TestFusedSweepFuzz:
