@@ -829,7 +829,9 @@ class TestFusedSweepFuzz:
             epsilon=float(rng.uniform(0.3, 5.0)),
             delta=float(10.0**-rng.integers(4, 9)),
             aggregate_params=params,
-            multi_param_configuration=multi)
+            multi_param_configuration=multi,
+            partitions_sampling_prob=(
+                1 if rng.random() < 0.5 else float(rng.uniform(0.3, 0.9))))
         public = (sorted(np.unique(ds.partition_keys).tolist())
                   if rng.random() < 0.4 else None)
         host, fused = self._run_both(ds, options, public=public)
