@@ -747,6 +747,35 @@ class TestAnalysisErrorModelClosedForm:
         assert accs[3].error_l0_expected == pytest.approx(0.25 * -4.0)
 
 
+class TestFusedSweepMultiSumBounds:
+    """Per-configuration sum-bound VECTORS (MultiParameterConfiguration
+    .min/max_sum_per_partition) through the device sweep."""
+
+    def test_sum_bound_vectors_match_host(self):
+        from pipelinedp_tpu.ops import noise as noise_ops
+        noise_ops.seed_host_rng(0)  # host MC quantiles: reproducible draws
+        ds = TestFusedSweep._dataset(n=3000, users=150, parts=20, seed=11)
+        multi = data_structures.MultiParameterConfiguration(
+            min_sum_per_partition=[0.0, 0.0, 0.0],
+            max_sum_per_partition=[2.0, 10.0, 60.0])
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.SUM], max_partitions_contributed=3,
+            max_contributions_per_partition=2,
+            min_sum_per_partition=0.0, max_sum_per_partition=5.0)
+        options = analysis.UtilityAnalysisOptions(
+            epsilon=1.0, delta=1e-6, aggregate_params=params,
+            multi_param_configuration=multi)
+        host, fused = TestFusedSweep._run_both(ds, options)
+        assert len(host) == len(fused) == 3
+        for h, f in zip(host, fused):
+            TestFusedSweep._assert_metrics_close(h.sum_metrics,
+                                                 f.sum_metrics)
+        # Tighter clip bounds must produce larger (more negative)
+        # expected clipping error.
+        errs = [f.sum_metrics.error_linf_max_expected for f in fused]
+        assert errs[0] <= errs[1] <= errs[2] <= 0.0
+
+
 class TestFusedSweepSampling:
     """partitions_sampling_prob on the device sweep: both planes use the
     same deterministic SHA1 sampler, so they analyze the same subset."""
