@@ -86,7 +86,17 @@ def bench_config(name, params, fused_ds, local_rows, repeats=5):
     local_rps = local_rows / local_dt
 
     backend = JaxBackend(rng_seed=0)
+    # First run pays compilation + the host->device transfer of the
+    # dataset; it also populates the dataset's device cache, so the
+    # timed repeats measure aggregation over device-resident columns —
+    # the recurring cost of the multi-aggregation workloads (tuning,
+    # multi-metric pipelines) this plane exists for. A second cold run
+    # (fresh ArrayDataset, warm compile cache) captures the one-time
+    # ingest cost: host encode + link transfer + kernel + release.
     run_once(backend, fused_ds, params)  # compile warm-up
+    cold_ds = slice_dataset(fused_ds, len(fused_ds))
+    _, cold_dt, _ = run_once(backend, cold_ds, params)
+    del cold_ds
     best = None
     for _ in range(repeats):
         n_fused, dt, timings = run_once(backend, fused_ds, params)
@@ -105,6 +115,7 @@ def bench_config(name, params, fused_ds, local_rows, repeats=5):
         "partitions_populated": populated,
         "partitions_kept": n_fused,
         "fused_s": round(fused_dt, 3),
+        "cold_s": round(cold_dt, 3),
         "local_rows_per_s": round(local_rps),
     }
     if timings:
@@ -178,6 +189,76 @@ def bench_analysis_sweep(n_rows, n_users, n_partitions, n_configs):
     return rec
 
 
+def roofline_probe(ds):
+    """Roofline numbers for the fused kernel's dominant device ops on this
+    chip: the 3-key lexsort and one per-pk segment_sum, reported as
+    achieved bytes/s against the v5e HBM peak (~810 GB/s). The sort's
+    traffic model is a bitonic network: ~log2(n)(log2(n)+1)/2 stages,
+    each reading+writing 4 operands (3 sort keys + the index payload) of
+    4 bytes."""
+    import math
+
+    import jax
+    import jax.numpy as jnp
+
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu import jax_engine
+    from pipelinedp_tpu.ops import segment as seg_ops
+
+    enc = jax_engine.encode(ds, pdp.DataExtractors(), None)
+    pid, pk, _, _ = jax_engine.pad_and_put(enc, None, with_values=False)
+    n = int(pid.shape[0])
+    key = jax.random.PRNGKey(0)
+
+    @jax.jit
+    def sort_only(pid, pk, key):
+        k_tie, k_salt = jax.random.split(key)
+        salt = jax.random.bits(k_salt, (), dtype=jnp.uint32)
+        tie = jax.random.bits(k_tie, (n,), dtype=jnp.uint32)
+        hpk = seg_ops.fmix32(
+            seg_ops.fmix32(pid.astype(jnp.uint32) ^ salt) ^
+            pk.astype(jnp.uint32))
+        return jnp.lexsort((tie, hpk, pid))[0]
+
+    @jax.jit
+    def segsum_only(pk):
+        return jax.ops.segment_sum(jnp.ones_like(pk), pk,
+                                   num_segments=65536)[0]
+
+    def timed(fn, *args):
+        best = 1e9
+        for _ in range(3):
+            t0 = time.perf_counter()
+            # np.asarray forces execution + flush (block_until_ready does
+            # not flush on the tunneled platform).
+            np.asarray(fn(*args))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    sort_only(pid, pk, key)
+    segsum_only(pk)
+    sort_s = timed(sort_only, pid, pk, key)
+    seg_s = timed(segsum_only, pk)
+    stages = math.log2(n) * (math.log2(n) + 1) / 2
+    sort_bytes = stages * n * 16 * 2
+    hbm_peak = 810e9
+    rec = {
+        "metric": "roofline",
+        "rows": n,
+        "sort_s": round(sort_s, 4),
+        "sort_model_gb": round(sort_bytes / 1e9, 1),
+        "sort_gb_per_s": round(sort_bytes / sort_s / 1e9, 1),
+        "sort_hbm_frac": round(sort_bytes / sort_s / hbm_peak, 3),
+        "segment_sum_s": round(seg_s, 4),
+        "segment_sum_gb_per_s": round(n * 8 * 2 / seg_s / 1e9, 1),
+    }
+    log(f"## roofline: sort {sort_s:.3f}s ({rec['sort_gb_per_s']} GB/s, "
+        f"{rec['sort_hbm_frac']:.0%} of HBM peak), segment_sum "
+        f"{seg_s:.3f}s")
+    log(json.dumps(rec))
+    return rec
+
+
 def _check_device_reachable(timeout_s: int = 300) -> None:
     """Fail fast (with a diagnostic) when the accelerator is unreachable:
     jax backend initialization can block indefinitely on a wedged TPU
@@ -233,6 +314,7 @@ def main():
     ds_60k = zipf_dataset(n_rows, n_users, 2_000 if args.smoke else 60_000)
     flagship = bench_config("dp_count_sum_mean_rows_per_sec",
                             flagship_params(), ds_60k, local_rows)
+    roofline_probe(ds_60k)
 
     if not args.flagship_only:
         # Config 1: COUNT over ~1k partitions.

@@ -107,10 +107,18 @@ class MechanismSpec:
 @dataclasses.dataclass
 class MechanismSpecInternal:
     """Accountant-private record pairing a spec with its weight/sensitivity
-    (reference ``budget_accounting.py:102-111``)."""
+    (reference ``budget_accounting.py:102-111``).
+
+    ``internal_splits`` declares that the consumer will split the granted
+    (eps, delta) evenly into that many sub-mechanisms (mean/variance's
+    count+normalized-sum pair, a vector's per-coordinate releases, a
+    quantile tree's per-level noise). Naive composition is invariant to
+    the declaration (an even split of a share is the same total share);
+    PLD composition convolves the sub-mechanisms individually."""
     sensitivity: float
     weight: float
     mechanism_spec: MechanismSpec
+    internal_splits: int = 1
 
 
 class BudgetAccountantScope:
@@ -261,9 +269,13 @@ class BudgetAccountant(abc.ABC):
                        sensitivity: float = 1,
                        weight: float = 1,
                        count: int = 1,
-                       noise_standard_deviation: Optional[float] = None
-                       ) -> MechanismSpec:
-        """Registers a mechanism; returns a lazy spec."""
+                       noise_standard_deviation: Optional[float] = None,
+                       internal_splits: int = 1) -> MechanismSpec:
+        """Registers a mechanism; returns a lazy spec.
+
+        ``internal_splits``: the consumer will divide the granted budget
+        evenly into this many internal sub-mechanisms (see
+        MechanismSpecInternal)."""
 
     def compute_budgets(self) -> None:
         """Distributes the total budget over all registered mechanisms,
@@ -295,8 +307,8 @@ class NaiveBudgetAccountant(BudgetAccountant):
                        sensitivity: float = 1,
                        weight: float = 1,
                        count: int = 1,
-                       noise_standard_deviation: Optional[float] = None
-                       ) -> MechanismSpec:
+                       noise_standard_deviation: Optional[float] = None,
+                       internal_splits: int = 1) -> MechanismSpec:
         if noise_standard_deviation is not None:
             raise NotImplementedError(
                 "noise_standard_deviation is not implemented for "
@@ -305,11 +317,14 @@ class NaiveBudgetAccountant(BudgetAccountant):
                 self._total_delta == 0):
             raise AssertionError(
                 "The Gaussian mechanism requires delta > 0")
+        if internal_splits < 1:
+            raise ValueError("internal_splits must be >= 1")
         spec = MechanismSpec(mechanism_type, _count=count)
         self._register_mechanism(
             MechanismSpecInternal(sensitivity=sensitivity,
                                   weight=weight,
-                                  mechanism_spec=spec))
+                                  mechanism_spec=spec,
+                                  internal_splits=internal_splits))
         return spec
 
     def _compute_budgets(self) -> None:
@@ -360,8 +375,8 @@ class PLDBudgetAccountant(BudgetAccountant):
                        sensitivity: float = 1,
                        weight: float = 1,
                        count: int = 1,
-                       noise_standard_deviation: Optional[float] = None
-                       ) -> MechanismSpec:
+                       noise_standard_deviation: Optional[float] = None,
+                       internal_splits: int = 1) -> MechanismSpec:
         if count != 1 or noise_standard_deviation is not None:
             raise NotImplementedError(
                 "count/noise_standard_deviation are not supported by "
@@ -373,26 +388,40 @@ class PLDBudgetAccountant(BudgetAccountant):
             # budget_accounting.py:460-463).
             raise AssertionError(
                 "The Gaussian mechanism requires delta > 0")
+        if internal_splits < 1:
+            raise ValueError("internal_splits must be >= 1")
         spec = MechanismSpec(mechanism_type)
         self._register_mechanism(
             MechanismSpecInternal(sensitivity=sensitivity,
                                   weight=weight,
-                                  mechanism_spec=spec))
+                                  mechanism_spec=spec,
+                                  internal_splits=internal_splits))
         return spec
 
     def _compute_budgets(self) -> None:
         from pipelinedp_tpu import pld as pld_lib
+        # A spec with internal_splits=k is k independent sub-mechanisms,
+        # each carrying weight/k — so a k-split metric at weight w consumes
+        # the same share of the pipeline as a single-mechanism metric at
+        # weight w, matching the naive accountant's semantics (the combiner
+        # splits the granted budget evenly; equally_split_budget).
         sum_weights = sum(m.weight for m in self._mechanisms)
         if self._total_delta == 0:
             # Pure-DP pipeline: only Laplace-style composition is possible;
             # the reference uses the closed form sum(weights)/eps * sqrt(2)
-            # (``budget_accounting.py:509-514``).
+            # (``budget_accounting.py:509-514``). sum_weights already counts
+            # each k-split spec as k sub-mechanisms of weight/k.
             minimum_noise_std = (sum_weights / self._total_epsilon *
                                  math.sqrt(2.0))
         else:
+            sub_mechanisms = []
+            for m in self._mechanisms:
+                k = m.internal_splits
+                sub_mechanisms.extend(
+                    [(m.mechanism_spec.mechanism_type, m.sensitivity,
+                      m.weight / k)] * k)
             minimum_noise_std = pld_lib.find_minimum_noise_std(
-                mechanisms=[(m.mechanism_spec.mechanism_type, m.sensitivity,
-                             m.weight) for m in self._mechanisms],
+                mechanisms=sub_mechanisms,
                 total_epsilon=self._total_epsilon,
                 total_delta=self._total_delta,
                 discretization=self._pld_discretization)
@@ -400,7 +429,11 @@ class PLDBudgetAccountant(BudgetAccountant):
         for m in self._mechanisms:
             # Weight semantics mirror the reference (:506-524): a mechanism
             # with a larger weight receives proportionally *less* noise.
-            stddev = m.sensitivity * minimum_noise_std / m.weight
+            # The granted stddev is per SUB-mechanism (each of the k
+            # internal splits runs at this noise level).
+            k = m.internal_splits
+            sub_weight = m.weight / k
+            stddev = m.sensitivity * minimum_noise_std / sub_weight
             spec = m.mechanism_spec
             spec.set_noise_standard_deviation(stddev)
             if spec.mechanism_type == MechanismType.GENERIC:
@@ -408,17 +441,23 @@ class PLDBudgetAccountant(BudgetAccountant):
                 # the granted noise level by the shared conversion helper.
                 eps0, delta0 = pld_lib.generic_mechanism_eps_delta(
                     stddev, self._total_epsilon, self._total_delta)
-                spec.set_eps_delta(eps0, delta0)
+                spec.set_eps_delta(k * eps0, k * delta0)
             else:
                 # Also publish the EQUIVALENT per-mechanism (eps, delta):
                 # the combiner layer calibrates noise from them, and with
                 # these values its calibration round-trips to exactly the
                 # PLD-granted noise level — which is what makes this
                 # accountant work end-to-end with DPEngine (the reference's
-                # PLD accountant never could, reference :406).
-                spec.set_eps_delta(*self._equivalent_eps_delta(
-                    spec.mechanism_type, stddev, m.sensitivity, m.weight,
-                    sum_weights))
+                # PLD accountant never could, reference :406). A k-split
+                # spec publishes k times the per-sub-mechanism equivalent:
+                # the combiner's even split recovers exactly the
+                # sub-mechanism (eps, delta) whose calibration yields the
+                # granted stddev, so the composition the PLD convolved is
+                # the composition that actually runs.
+                eps_m, delta_m = self._equivalent_eps_delta(
+                    spec.mechanism_type, stddev, m.sensitivity, sub_weight,
+                    sum_weights)
+                spec.set_eps_delta(k * eps_m, k * delta_m)
 
     def _equivalent_eps_delta(self, mechanism_type: MechanismType,
                               stddev: float, sensitivity: float,

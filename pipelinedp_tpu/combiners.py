@@ -511,9 +511,14 @@ def create_compound_combiner(
     metrics = aggregate_params.metrics
     weight = aggregate_params.budget_weight
 
-    def request():
-        return budget_accountant.request_budget(mechanism_type,
-                                                weight=weight)
+    def request(internal_splits: int = 1):
+        # internal_splits declares how many sub-mechanisms the combiner
+        # will evenly split the granted budget into (mean = count +
+        # normalized sum, variance adds the normalized sum of squares,
+        # vectors release per coordinate, quantile trees per level) — the
+        # PLD accountant composes them individually.
+        return budget_accountant.request_budget(
+            mechanism_type, weight=weight, internal_splits=internal_splits)
 
     if Metrics.VARIANCE in metrics:
         metrics_to_compute = ["variance"]
@@ -524,8 +529,9 @@ def create_compound_combiner(
         if Metrics.SUM in metrics:
             metrics_to_compute.append("sum")
         combiners.append(
-            VarianceCombiner(CombinerParams(request(), aggregate_params),
-                             metrics_to_compute))
+            VarianceCombiner(
+                CombinerParams(request(internal_splits=3),
+                               aggregate_params), metrics_to_compute))
     elif Metrics.MEAN in metrics:
         metrics_to_compute = ["mean"]
         if Metrics.COUNT in metrics:
@@ -533,8 +539,9 @@ def create_compound_combiner(
         if Metrics.SUM in metrics:
             metrics_to_compute.append("sum")
         combiners.append(
-            MeanCombiner(CombinerParams(request(), aggregate_params),
-                         metrics_to_compute))
+            MeanCombiner(
+                CombinerParams(request(internal_splits=2),
+                               aggregate_params), metrics_to_compute))
     else:
         if Metrics.COUNT in metrics:
             combiners.append(
@@ -548,14 +555,20 @@ def create_compound_combiner(
                 CombinerParams(request(), aggregate_params)))
     if Metrics.VECTOR_SUM in metrics:
         combiners.append(
-            VectorSumCombiner(CombinerParams(request(), aggregate_params)))
+            VectorSumCombiner(
+                CombinerParams(
+                    request(internal_splits=aggregate_params.vector_size),
+                    aggregate_params)))
     percentiles_to_compute = [
         m.parameter for m in metrics if m.is_percentile
     ]
     if percentiles_to_compute:
         combiners.append(
-            QuantileCombiner(CombinerParams(request(), aggregate_params),
-                             percentiles_to_compute))
+            QuantileCombiner(
+                CombinerParams(
+                    request(internal_splits=(
+                        quantile_tree_ops.DEFAULT_TREE_HEIGHT)),
+                    aggregate_params), percentiles_to_compute))
     return CompoundCombiner(combiners, return_named_tuple=True)
 
 

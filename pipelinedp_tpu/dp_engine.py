@@ -361,27 +361,10 @@ class DPEngine:
             raise ValueError("params must be set to a valid AggregateParams")
         if not isinstance(params, AggregateParams):
             raise TypeError("params must be set to a valid AggregateParams")
-        from pipelinedp_tpu import budget_accounting
-        if isinstance(
-                self._budget_accountant,
-                budget_accounting.PLDBudgetAccountant):
-            # The PLD accountant publishes per-spec equivalent (eps,
-            # delta); metrics whose combiners RE-SPLIT that budget into
-            # several internal mechanisms (normalized-sum mean/variance,
-            # per-coordinate vectors, per-level trees) would realize a
-            # composition the PLD accounting never convolved — reject
-            # rather than silently void the certificate.
-            resplit = [m for m in (params.metrics or [])
-                       if m.is_percentile or m in (
-                           Metrics.MEAN, Metrics.VARIANCE,
-                           Metrics.VECTOR_SUM)]
-            if resplit:
-                raise NotImplementedError(
-                    f"PLDBudgetAccountant supports single-mechanism "
-                    f"metrics (COUNT, PRIVACY_ID_COUNT, SUM); "
-                    f"{[str(m) for m in resplit]} split their budget "
-                    "into several internal mechanisms, which the PLD "
-                    "composition does not model yet.")
+        # (All metrics run under PLDBudgetAccountant: combiners declare
+        # their internal budget splits via request_budget(internal_splits=k)
+        # and the accountant composes the k sub-mechanisms individually —
+        # see budget_accounting.PLDBudgetAccountant._compute_budgets.)
         if check_data_extractors:
             if data_extractors is None:
                 raise ValueError(

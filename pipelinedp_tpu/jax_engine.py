@@ -174,6 +174,13 @@ class ArrayDataset:
     per-row Python extractor loop entirely — encoding becomes a
     vectorized ``np.unique``. ``values`` may be [N] scalars or [N, D]
     vectors. ``DataExtractors`` are not needed (pass an empty one).
+
+    Aggregating the same dataset repeatedly (multiple metrics, parameter
+    tuning, utility-analysis sweeps) reuses the integer-encoded columns
+    AND their on-device placement: the slow host<->device link is paid
+    once, not per aggregation. The columns are therefore treated as
+    immutable once the first aggregation runs — call
+    ``invalidate_cache()`` after mutating them in place.
     """
     privacy_ids: Optional[np.ndarray]
     partition_keys: np.ndarray
@@ -181,6 +188,16 @@ class ArrayDataset:
 
     def __len__(self):
         return len(self.partition_keys)
+
+    def invalidate_cache(self) -> None:
+        """Drops cached encodings/device buffers (after in-place edits)."""
+        self.__dict__.pop("_encode_cache", None)
+
+    def _cached_encode(self, key, build):
+        cache = self.__dict__.setdefault("_encode_cache", {})
+        if key not in cache:
+            cache[key] = build()
+        return cache[key]
 
     def to_rows(self):
         """Row-tuple view for the generic (non-fused) backends."""
@@ -298,7 +315,23 @@ def pad_and_put(encoded: EncodedData, vector_size: Optional[int],
     routinely land there — widened back to int32 on device.
     ``with_values=False`` skips the value column entirely (COUNT-style
     aggregations never read it). Returns (pid, pk, values, valid) padded
-    to a power of two."""
+    to a power of two.
+
+    The placed arrays are cached on the EncodedData: repeated
+    aggregations of the same dataset (tuning sweeps, multi-metric
+    pipelines) pay the tunnel transfer once."""
+    cache = encoded.__dict__.setdefault("_device_cache", {})
+    cache_key = (vector_size, with_values)
+    if cache_key in cache:
+        return cache[cache_key]
+    out = _pad_and_put_uncached(encoded, vector_size, with_values)
+    cache[cache_key] = out
+    return out
+
+
+def _pad_and_put_uncached(encoded: EncodedData,
+                          vector_size: Optional[int],
+                          with_values: bool):
     n = encoded.n_rows
     n_pad = _pad_pow2(max(n, 1))
 
@@ -392,6 +425,13 @@ def encode(rows, data_extractors, vector_size: Optional[int],
     vocabulary IS the public list — non-public rows are dropped and missing
     public partitions appear as all-zero accumulator rows for free."""
     if isinstance(rows, ArrayDataset):
+        if public_partitions is None:
+            # Cacheable: the encode is a pure function of the columns.
+            # (Public-partition encodes depend on the passed list and are
+            # not cached — the list has no cheap identity.)
+            return rows._cached_encode(
+                ("encode", vector_size, require_pid),
+                lambda: _encode_arrays(rows, vector_size, None, require_pid))
         return _encode_arrays(rows, vector_size, public_partitions,
                               require_pid)
     pids, pks, vals = [], [], []
@@ -474,9 +514,11 @@ def _partials(config: FusedConfig, num_partitions: int, pid, pk, values,
 
     Scatter-minimal design: on TPU a segment_sum/scatter over the row axis
     costs ~10x an elementwise op, so the kernel sorts ONCE by
-    (pid, hash(pid, pk, salt), pk, random) and then derives every
-    per-segment quantity in row space with cumsum/cummax (runs are
-    contiguous after the sort). The hash key makes the within-pid segment
+    (pid, hash(pid, pk, salt), random) — pk itself is not a key: for a
+    fixed pid the hash is injective in pk, so segments are contiguous
+    already — and then derives every per-segment quantity in row space
+    with cumsum/cummax (runs are contiguous after the sort). The hash key
+    makes the within-pid segment
     order a fresh uniform permutation per run and per pid, so "ordinal
     within pid < l0" IS the L0 cross-partition sample — in (l0, linf)
     mode no second sort and no per-segment scatter are needed; the only
@@ -501,7 +543,8 @@ def _partials(config: FusedConfig, num_partitions: int, pid, pk, values,
                          config.max_sum_per_partition), 0.0)
         qrows = (_qrows(config, pk_safe, values, row_keep)
                  if config.percentiles else None)
-        part = _reduce_per_pk(config, pk_safe, masked, row_keep, masked, P)
+        part, _ = _reduce_per_pk(config, pk_safe, masked, row_keep, masked,
+                                 P)
         # Without pids every row counts as its own privacy unit
         # (reference dp_engine.py:341-348 works off row counts).
         part_nseg = part["count"]
@@ -517,10 +560,16 @@ def _partials(config: FusedConfig, num_partitions: int, pid, pk, values,
     hpk = seg_ops.fmix32(
         seg_ops.fmix32(big_pid.astype(jnp.uint32) ^ salt) ^
         big_pk.astype(jnp.uint32))
-    sort_idx = jnp.lexsort((tiebreak, big_pk, hpk, big_pid))
+    # For fixed (pid, salt), pk -> hpk is injective (fmix32 is a bijection
+    # on uint32 composed with an xor by a per-pid constant), so (pid, hpk)
+    # already identifies the (pid, pk) segment — pk itself is redundant as
+    # a sort key, cutting one operand from the sort network.
+    sort_idx = jnp.lexsort((tiebreak, hpk, big_pid))
     spid = big_pid[sort_idx]
     spk = big_pk[sort_idx]
-    svalues = values[sort_idx]
+    # COUNT-style metrics never read the value column: skip the gather of
+    # the (all-zero) values array entirely.
+    svalues = values[sort_idx] if config.needs_values else values
     idx = jnp.arange(n)
     # Valid rows sort before padding (PAD_ID keys): no gather needed.
     svalid = idx < jnp.sum(valid.astype(jnp.int32))
@@ -578,38 +627,62 @@ def _partials(config: FusedConfig, num_partitions: int, pid, pk, values,
             seg_marker,
             jnp.clip(tot_row, config.min_sum_per_partition,
                      config.max_sum_per_partition), 0.0)
-        part = _reduce_per_pk(config, pk_safe, masked, keep_row, contrib, P)
+        part, part_nseg = _reduce_per_pk(config, pk_safe, masked, keep_row,
+                                         contrib, P, seg_marker=seg_marker)
     else:
-        part = _reduce_per_pk(config, pk_safe, masked, keep_row, None, P)
+        part, part_nseg = _reduce_per_pk(config, pk_safe, masked, keep_row,
+                                         None, P, seg_marker=seg_marker)
 
-    part_nseg = jax.ops.segment_sum(seg_marker.astype(jnp.int32), pk_safe,
-                                    num_segments=P)
     qrows = (_qrows(config, spk, svalues, keep_row)
              if config.percentiles else None)
     return part, part_nseg, qrows
 
 
 def _reduce_per_pk(config: FusedConfig, pk_safe, masked, keep_row,
-                   per_partition_sum_contrib, P) -> Dict[str, jnp.ndarray]:
+                   per_partition_sum_contrib, P, seg_marker=None):
     """The fused shuffle 3: per-pk accumulator columns straight from row
-    space. Counts accumulate as int32 — float32 addition saturates at 2^24
+    space, returned as (columns dict, privacy-id-count column). Counts
+    accumulate as int32 — float32 addition saturates at 2^24
     (1.0 + 16777216.0 == 16777216.0), silently under-counting huge
-    partitions; int32 is exact to 2^31."""
-    part = {"count": jax.ops.segment_sum(keep_row.astype(jnp.int32),
-                                         pk_safe, num_segments=P)}
+    partitions; int32 is exact to 2^31.
+
+    Scatters over the row axis are the kernel's dominant cost after the
+    sort, so columns sharing a dtype ride ONE multi-feature segment_sum
+    (the scatter's addressing pass is shared; only the payload widens):
+    row count + kept-segment marker as int32[N, 2], value sum + sum of
+    squares as f32[N, <=2]."""
     names = set(config.metrics)
+    if seg_marker is None:
+        part = {"count": jax.ops.segment_sum(keep_row.astype(jnp.int32),
+                                             pk_safe, num_segments=P)}
+        nseg = None
+    else:
+        ints = jax.ops.segment_sum(
+            jnp.stack([keep_row.astype(jnp.int32),
+                       seg_marker.astype(jnp.int32)], axis=1),
+            pk_safe, num_segments=P)
+        part = {"count": ints[:, 0]}
+        nseg = ints[:, 1]
     if "VECTOR_SUM" in names:
         part["vector_sum"] = jax.ops.segment_sum(masked, pk_safe,
                                                  num_segments=P)
-        return part
+        return part, nseg
     if "SUM" in names and config.per_partition_bounds:
         part["sum"] = jax.ops.segment_sum(per_partition_sum_contrib,
                                           pk_safe, num_segments=P)
-        return part
+        return part, nseg
     need_sum = "SUM" in names
     need_norm = "MEAN" in names or "VARIANCE" in names
+    need_sumsq = "VARIANCE" in names
     if need_sum or need_norm:
-        raw_sum = jax.ops.segment_sum(masked, pk_safe, num_segments=P)
+        if need_sumsq:
+            sums = jax.ops.segment_sum(
+                jnp.stack([masked, masked * masked], axis=1), pk_safe,
+                num_segments=P)
+            raw_sum = sums[:, 0]
+            raw_sumsq = sums[:, 1]
+        else:
+            raw_sum = jax.ops.segment_sum(masked, pk_safe, num_segments=P)
         if need_sum:
             part["sum"] = raw_sum
     if need_norm:
@@ -619,12 +692,10 @@ def _reduce_per_pk(config: FusedConfig, pk_safe, masked, keep_row,
                                                 config.max_value)
         cf = part["count"].astype(raw_sum.dtype)
         part["nsum"] = raw_sum - middle * cf
-        if "VARIANCE" in names:
-            raw_sumsq = jax.ops.segment_sum(masked * masked, pk_safe,
-                                            num_segments=P)
+        if need_sumsq:
             part["nsumsq"] = (raw_sumsq - 2.0 * middle * raw_sum +
                               cf * middle * middle)
-    return part
+    return part, nseg
 
 
 def _qrows(config: FusedConfig, pk, values, kept):
@@ -1050,12 +1121,17 @@ def request_budgets(config: FusedConfig, params: AggregateParams,
     names = set(config.metrics)
     specs: Dict[str, Any] = {}
 
-    def request():
-        return budget_accountant.request_budget(mechanism_type,
-                                                weight=weight)
+    def request(internal_splits: int = 1):
+        # Same split declarations as the generic factory: the release path
+        # divides the granted budget evenly into this many sub-mechanisms,
+        # which the PLD accountant composes individually.
+        return budget_accountant.request_budget(
+            mechanism_type, weight=weight, internal_splits=internal_splits)
 
-    if "VARIANCE" in names or "MEAN" in names:
-        specs["mean_var"] = request()
+    if "VARIANCE" in names:
+        specs["mean_var"] = request(internal_splits=3)
+    elif "MEAN" in names:
+        specs["mean_var"] = request(internal_splits=2)
     else:
         if "COUNT" in names:
             specs["count"] = request()
@@ -1064,12 +1140,35 @@ def request_budgets(config: FusedConfig, params: AggregateParams,
     if "PRIVACY_ID_COUNT" in names:
         specs["privacy_id_count"] = request()
     if "VECTOR_SUM" in names:
-        specs["vector_sum"] = request()
+        specs["vector_sum"] = request(
+            internal_splits=int(config.vector_size))
     if config.percentiles:
         # One budget for all percentiles, requested last — same order as
         # the generic factory (combiners.py:552-558).
-        specs["percentile"] = request()
+        specs["percentile"] = request(
+            internal_splits=quantile_tree_ops.DEFAULT_TREE_HEIGHT)
     return specs
+
+
+# Kept partitions fetched via the packed compact block; beyond this the
+# (rare) full fetch runs instead. 8192 rows x ~10 columns x 4B = 320KB.
+_COMPACT_FETCH_CAP = 8192
+
+
+@functools.partial(jax.jit, static_argnames=("num_partitions", "cap"))
+def _compact_fetch_kernel(keep_pk, cols, num_partitions, cap):
+    """Device-side output compaction: stable-sorts kept partitions first
+    (ascending pk index), gathers the first ``cap`` of every column and
+    packs [meta; kept indices; columns...] into one int32 block — the
+    kept count, the index map and all metric columns cross the
+    high-latency host link in a single transfer."""
+    keep = keep_pk[:num_partitions].astype(jnp.int32)
+    order = jnp.argsort(1 - keep, stable=True)
+    sel = order[:cap]
+    width = sel.shape[0]
+    meta = jnp.zeros(width, jnp.int32).at[0].set(jnp.sum(keep))
+    gathered = [c[:num_partitions][sel] for c in cols]
+    return jnp.stack([meta, sel.astype(jnp.int32)] + gathered)
 
 
 class LazyFusedResult:
@@ -1148,22 +1247,52 @@ class LazyFusedResult:
         # float32 become subnormals that TPUs flush to zero (and a
         # float32 CAST would corrupt counts above 2^24).
         flat = sorted(k for k, v in raw.items() if v.ndim == 1)
-        cols = [keep_pk.astype(jnp.int32)]
+        cols = []
         for name in flat:
             arr = raw[name]
             cols.append(arr if arr.dtype == jnp.int32 else
                         jax.lax.bitcast_convert_type(
                             arr.astype(jnp.float32), jnp.int32))
-        stacked = np.asarray(jnp.stack(cols))
-        keep_np = stacked[0, :P] > 0
+        # With private selection most partitions are usually dropped:
+        # compact ON DEVICE and fetch kept count + kept indices + kept
+        # columns as ONE packed block — a single transfer over the
+        # high-latency link instead of a full [K, P] fetch plus extra
+        # round trips. Partitions kept beyond the static cap (rare: a
+        # huge keyspace where selection keeps >8192 keys) fall back to
+        # the full fetch.
+        compact = self._public is None
+        if compact:
+            cap = min(P, _COMPACT_FETCH_CAP)
+            packed = np.asarray(_compact_fetch_kernel(
+                keep_pk, tuple(cols), P, cap))
+            n_keep = int(packed[0, 0])
+            if n_keep > cap:  # fallback: fetch everything
+                stacked = np.asarray(
+                    jnp.stack([keep_pk.astype(jnp.int32)] + cols))[:, :P]
+                kept_idx = np.flatnonzero(stacked[0] > 0)
+                n_rel = P
+                compact = False
+            else:
+                stacked = packed[1:, :n_keep]
+                kept_idx = stacked[0]
+                n_rel = n_keep  # release only kept rows
+                kept_order = jnp.asarray(kept_idx)  # for rank-2 gathers
+        else:
+            stacked = np.asarray(jnp.stack([keep_pk.astype(jnp.int32)] +
+                                           cols))[:, :P]
+            kept_idx = np.flatnonzero(stacked[0] > 0)
+            n_rel = P  # release all rows, select kept at the end
         fetched = {}
         for i, name in enumerate(flat):
-            col = stacked[1 + i, :P]
+            col = stacked[1 + i]
             fetched[name] = (col if raw[name].dtype == jnp.int32 else
                              col.view(np.float32))
         for name, arr in raw.items():  # rank-2 (vector) outputs
             if arr.ndim != 1:
-                fetched[name] = np.asarray(arr)[:P]
+                if compact:
+                    fetched[name] = np.asarray(arr[kept_order])
+                else:
+                    fetched[name] = np.asarray(arr)[:P]
         self.timings["device_s"] = _time.perf_counter() - t1
 
         # The scalar DP release, in float64 via the shared mechanisms.
@@ -1186,8 +1315,14 @@ class LazyFusedResult:
 
         # Only materialize kept partitions (with private selection the kept
         # fraction can be tiny — never walk the full pk axis in Python).
-        kept_idx = (np.arange(P) if self._public is not None else
-                    np.flatnonzero(keep_np))
+        # In compact mode the released arrays already hold only kept rows.
+        if self._public is not None:
+            rel_sel = vocab_idx = np.arange(P)
+        elif compact:
+            rel_sel = np.arange(n_rel)
+            vocab_idx = kept_idx
+        else:
+            rel_sel = vocab_idx = kept_idx
         vocab = encoded.pk_vocab
         # Column-wise conversion: one C-level tolist() per metric instead
         # of a Python float() call per (partition, metric).
@@ -1195,14 +1330,14 @@ class LazyFusedResult:
         for f in fields:
             arr = metric_arrays[f]
             if arr.ndim == 1:
-                columns.append(arr[kept_idx].tolist())
+                columns.append(arr[rel_sel].tolist())
             else:
-                columns.append(list(arr[kept_idx, :]))
+                columns.append(list(arr[rel_sel, :]))
         tuple_fields = tuple(fields)
         out = [
             (vocab[i], _create_named_tuple_instance(
                 "MetricsTuple", tuple_fields, vals))
-            for i, vals in zip(kept_idx.tolist(), zip(*columns))
+            for i, vals in zip(vocab_idx.tolist(), zip(*columns))
         ]
         self.timings["host_decode_s"] = _time.perf_counter() - t_rel
         return out

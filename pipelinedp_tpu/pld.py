@@ -236,7 +236,8 @@ def pure_dp_pld(epsilon: float,
     hi_idx = math.ceil(epsilon / h) if epsilon > 0 else 0
     lo_idx = -(math.floor(epsilon / h) if epsilon > 0 else 0)
     probs = np.zeros(hi_idx - lo_idx + 1)
-    p_up = math.exp(epsilon) / (1.0 + math.exp(epsilon))
+    # Stable sigmoid: exp(eps) overflows float64 past ~709.
+    p_up = 1.0 / (1.0 + math.exp(-epsilon))
     probs[-1] = (1.0 - delta) * p_up
     probs[0] += (1.0 - delta) * (1.0 - p_up)
     return DiscretePLD(discretization=h,
@@ -287,6 +288,37 @@ def generic_mechanism_eps_delta(noise_std: float, total_epsilon: float,
     return eps0, delta0
 
 
+# Cap on per-mechanism loss-grid buckets: past this the grid coarsens
+# (losses still round UP — pessimistic), keeping huge-epsilon pipelines
+# (tiny noise => losses of 1e4+) at bounded memory instead of allocating
+# multi-GB pmf arrays.
+_MAX_GRID_BUCKETS = 1 << 20
+
+
+def _effective_discretization(mechanisms: Sequence[Mechanism],
+                              noise_std: float, total_epsilon: float,
+                              total_delta: float, h: float) -> float:
+    """Discretization to use at this noise level: the requested ``h``
+    unless some mechanism's loss range would need more than
+    ``_MAX_GRID_BUCKETS`` buckets (all PLDs in one composition must share
+    a grid, so the widest mechanism sets it)."""
+    max_loss = 0.0
+    for mech_type, sensitivity, weight in mechanisms:
+        stddev = sensitivity * noise_std / weight
+        if mech_type == MechanismType.LAPLACE:
+            loss = sensitivity / (stddev / math.sqrt(2.0))  # s/b
+        elif mech_type == MechanismType.GAUSSIAN:
+            mu = sensitivity**2 / (2.0 * stddev**2)
+            loss = mu + _GAUSSIAN_TAIL_SIGMAS * sensitivity / stddev
+        else:
+            loss = generic_mechanism_eps_delta(noise_std, total_epsilon,
+                                               total_delta)[0]
+        max_loss = max(max_loss, loss)
+    if max_loss / h > _MAX_GRID_BUCKETS:
+        return max_loss / _MAX_GRID_BUCKETS
+    return h
+
+
 def _compose_for_noise_std(mechanisms: Iterable[Mechanism],
                            noise_std: float,
                            total_epsilon: float,
@@ -295,6 +327,9 @@ def _compose_for_noise_std(mechanisms: Iterable[Mechanism],
     """Builds the composed PLD when every mechanism uses the common noise
     multiplier ``noise_std`` (per-mechanism std = sensitivity*noise_std/weight
     — larger weight => less noise, reference :506-524)."""
+    mechanisms = list(mechanisms)
+    discretization = _effective_discretization(
+        mechanisms, noise_std, total_epsilon, total_delta, discretization)
     plds: List[DiscretePLD] = []
     for mech_type, sensitivity, weight in mechanisms:
         stddev = sensitivity * noise_std / weight
