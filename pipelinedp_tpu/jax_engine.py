@@ -319,61 +319,72 @@ def pad_and_put(encoded: EncodedData, vector_size: Optional[int],
 
     The placed arrays are cached on the EncodedData: repeated
     aggregations of the same dataset (tuning sweeps, multi-metric
-    pipelines) pay the tunnel transfer once."""
-    cache = encoded.__dict__.setdefault("_device_cache", {})
-    cache_key = (vector_size, with_values)
-    if cache_key in cache:
-        return cache[cache_key]
-    out = _pad_and_put_uncached(encoded, vector_size, with_values)
-    cache[cache_key] = out
-    return out
-
-
-def _pad_and_put_uncached(encoded: EncodedData,
-                          vector_size: Optional[int],
-                          with_values: bool):
+    pipelines) pay the tunnel transfer once. Id columns and the value
+    column cache INDEPENDENTLY — a COUNT pass followed by a SUM pass
+    ships the ids once and then only adds the value transfer (still one
+    batched device_put per call for whatever is missing)."""
     n = encoded.n_rows
     n_pad = _pad_pow2(max(n, 1))
+    cache = encoded.__dict__.setdefault("_device_cache", {})
+    vals_key = ("values", vector_size)
+    need_ids = "ids" not in cache
+    need_vals = with_values and vals_key not in cache
 
-    def narrow(arr):
-        # encode() guarantees non-negative ids.
-        if not arr.size:
-            return (arr,)
-        mx = int(arr.max())
-        if mx < (1 << 16):
-            return (arr.astype(np.uint16),)
-        if mx < (1 << 24):
-            a32 = arr.astype(np.uint32)
-            return (a32.astype(np.uint8), (a32 >> 8).astype(np.uint8),
-                    (a32 >> 16).astype(np.uint8))
-        return (arr,)
+    if need_ids or need_vals:
+        host = []
+        pid_planes = pk_planes = ()
+        if need_ids:
+            pid_planes = _narrow_ids(encoded.pid)
+            pk_planes = _narrow_ids(encoded.pk)
+            host += list(pid_planes) + list(pk_planes)
+        if need_vals:
+            host.append(encoded.values)
+        dev = jax.device_put(tuple(host))
+        if need_ids:
+            n_pid = len(pid_planes)
+            pid = jnp.zeros(n_pad, jnp.int32).at[:n].set(
+                _widen_ids(dev[:n_pid]))
+            pk = jnp.zeros(n_pad, jnp.int32).at[:n].set(
+                _widen_ids(dev[n_pid:n_pid + len(pk_planes)]))
+            valid = jnp.arange(n_pad) < n
+            cache["ids"] = (pid, pk, valid)
+        if need_vals:
+            shape = (n_pad, vector_size) if vector_size else (n_pad,)
+            cache[vals_key] = jnp.zeros(shape, jnp.float32).at[:n].set(
+                dev[-1])
 
-    def widen(planes) -> jnp.ndarray:
-        if len(planes) == 1:
-            return planes[0].astype(jnp.int32)
-        b0, b1, b2 = (p.astype(jnp.int32) for p in planes)
-        return b0 | (b1 << 8) | (b2 << 16)
-
-    pid_planes = narrow(encoded.pid)
-    pk_planes = narrow(encoded.pk)
-    host = list(pid_planes) + list(pk_planes)
+    pid, pk, valid = cache["ids"]
     if with_values:
-        host.append(encoded.values)
-    dev = jax.device_put(tuple(host))
-    n_pid = len(pid_planes)
-    pid = jnp.zeros(n_pad, jnp.int32).at[:n].set(widen(dev[:n_pid]))
-    pk = jnp.zeros(n_pad, jnp.int32).at[:n].set(
-        widen(dev[n_pid:n_pid + len(pk_planes)]))
-    if vector_size:
-        values = jnp.zeros((n_pad, vector_size), jnp.float32)
-        if with_values:
-            values = values.at[:n].set(dev[-1])
+        values = cache[vals_key]
     else:
-        values = jnp.zeros(n_pad, jnp.float32)
-        if with_values:
-            values = values.at[:n].set(dev[-1])
-    valid = jnp.arange(n_pad) < n
+        zeros_key = ("zeros", vector_size)
+        if zeros_key not in cache:
+            shape = (n_pad, vector_size) if vector_size else (n_pad,)
+            cache[zeros_key] = jnp.zeros(shape, jnp.float32)
+        values = cache[zeros_key]
     return pid, pk, values, valid
+
+
+def _narrow_ids(arr):
+    """Minimal-byte-width host planes of a non-negative id column
+    (encode() guarantees non-negative ids)."""
+    if not arr.size:
+        return (arr,)
+    mx = int(arr.max())
+    if mx < (1 << 16):
+        return (arr.astype(np.uint16),)
+    if mx < (1 << 24):
+        a32 = arr.astype(np.uint32)
+        return (a32.astype(np.uint8), (a32 >> 8).astype(np.uint8),
+                (a32 >> 16).astype(np.uint8))
+    return (arr,)
+
+
+def _widen_ids(planes) -> jnp.ndarray:
+    if len(planes) == 1:
+        return planes[0].astype(jnp.int32)
+    b0, b1, b2 = (p.astype(jnp.int32) for p in planes)
+    return b0 | (b1 << 8) | (b2 << 16)
 
 
 def _encode_arrays(ds: ArrayDataset, vector_size: Optional[int],
@@ -638,64 +649,143 @@ def _partials(config: FusedConfig, num_partitions: int, pid, pk, values,
     return part, part_nseg, qrows
 
 
+# Fixed-point value accumulation: quantization grid (2^23 steps over the
+# clip bound), lane width and count. 7-bit lanes keep every int32 lane
+# accumulator exact for up to 2^24 rows (2^24 * 127 < 2^31); four lanes
+# span the 25-bit offset-shifted payload.
+_FX_STEPS = 1 << 23
+_FX_OFFSET = 1 << 23
+_FX_LANE_BITS = 7
+_FX_LANES = 4
+_FX_MAX_ROWS = 1 << 24
+
+
+@dataclasses.dataclass(frozen=True)
+class _FxSpec:
+    """One fixed-point accumulated value column."""
+    name: str
+    bound: float  # |y| <= bound
+    signed: bool  # signed columns ship offset by _FX_OFFSET
+    count_col: str  # column holding the number of contributing entries
+
+    @property
+    def scale(self) -> float:
+        return (_FX_STEPS - 1) / self.bound if self.bound > 0 else 1.0
+
+
+def _fixedpoint_layout(config: FusedConfig) -> List[_FxSpec]:
+    """The value columns the kernel accumulates in fixed point. Static in
+    the config, so kernel and host release agree on the encoding."""
+    names = set(config.metrics)
+    if "VECTOR_SUM" in names or not (names & {"SUM", "MEAN", "VARIANCE"}):
+        return []
+    if config.per_partition_bounds:
+        bound = max(abs(config.min_sum_per_partition),
+                    abs(config.max_sum_per_partition))
+        # One contribution per kept (pid, pk) segment.
+        return [_FxSpec("sum", bound, True, "privacy_id_count_raw")]
+    r = (config.max_value - config.min_value) / 2.0
+    specs = [_FxSpec("nsum", r, True, "count")]
+    if "VARIANCE" in names:
+        specs.append(_FxSpec("nsumsq", r * r, False, "count"))
+    return specs
+
+
 def _reduce_per_pk(config: FusedConfig, pk_safe, masked, keep_row,
                    per_partition_sum_contrib, P, seg_marker=None):
     """The fused shuffle 3: per-pk accumulator columns straight from row
-    space, returned as (columns dict, privacy-id-count column). Counts
-    accumulate as int32 — float32 addition saturates at 2^24
-    (1.0 + 16777216.0 == 16777216.0), silently under-counting huge
-    partitions; int32 is exact to 2^31.
+    space, returned as (columns dict, privacy-id-count column).
 
-    Scatters over the row axis are the kernel's dominant cost after the
-    sort, so columns sharing a dtype ride ONE multi-feature segment_sum
+    Everything accumulates in int32, in ONE multi-feature segment_sum
     (the scatter's addressing pass is shared; only the payload widens):
-    row count + kept-segment marker as int32[N, 2], value sum + sum of
-    squares as f32[N, <=2]."""
+
+    * counts + kept-segment markers directly — float32 addition saturates
+      at 2^24 (1.0 + 16777216.0 == 16777216.0), silently under-counting
+      huge partitions; int32 is exact to 2^31;
+    * value columns in FIXED POINT: the normalized value
+      (x - midpoint, and its square for variance — normalizing on device
+      also kills the f32 cancellation of the sumsq recombination) is
+      quantized to a 2^23-step grid over its static clip bound and split
+      into four 7-bit lanes, each an exact int32 segment sum; the host
+      release reassembles lanes in float64 (``_fold_fixedpoint``). Unlike
+      a monolithic f32 segment_sum — whose sequential rounding drifts
+      unboundedly with partition size (saturating outright at 2^24 equal
+      values) — the only error is the per-row quantization, bounded by
+      bound/2^23 per row independent of partition size, far below the
+      f32 representation error of the inputs themselves.
+
+    TPU-first rationale: the chip has no fast f64; exact integer lanes +
+    one wide scatter beat both emulated f64 (x64 flag, 2x sort payload)
+    and compensated-float scans (sequential chunk loop, still drifts on
+    adversarial equal-value streams).
+    """
     names = set(config.metrics)
-    if seg_marker is None:
-        part = {"count": jax.ops.segment_sum(keep_row.astype(jnp.int32),
-                                             pk_safe, num_segments=P)}
-        nseg = None
+    int_cols = [keep_row.astype(jnp.int32)]
+    lane_names: List[str] = []
+    if seg_marker is not None:
+        int_cols.append(seg_marker.astype(jnp.int32))
+
+    layout = _fixedpoint_layout(config)
+    if layout and pk_safe.shape[0] > _FX_MAX_ROWS:
+        raise NotImplementedError(
+            f"fixed-point lanes support up to {_FX_MAX_ROWS} rows per "
+            "device; shard the rows over a mesh")
+    for spec in layout:
+        if spec.name == "sum":  # per-partition-bound mode
+            y = per_partition_sum_contrib
+            mask = seg_marker if seg_marker is not None else keep_row
+        elif spec.name == "nsum":
+            middle = dp_computations.compute_middle(config.min_value,
+                                                    config.max_value)
+            y = masked - middle
+            mask = keep_row
+        else:  # nsumsq
+            middle = dp_computations.compute_middle(config.min_value,
+                                                    config.max_value)
+            y = (masked - middle) * (masked - middle)
+            mask = keep_row
+        q = jnp.round(y * spec.scale).astype(jnp.int32)
+        u = jnp.where(mask, q + (_FX_OFFSET if spec.signed else 0), 0)
+        for k in range(_FX_LANES):
+            int_cols.append((u >> (k * _FX_LANE_BITS)) &
+                            ((1 << _FX_LANE_BITS) - 1))
+            lane_names.append(f"{spec.name}_fx{k}")
+
+    if len(int_cols) == 1:
+        ints = jax.ops.segment_sum(int_cols[0], pk_safe,
+                                   num_segments=P)[:, None]
     else:
-        ints = jax.ops.segment_sum(
-            jnp.stack([keep_row.astype(jnp.int32),
-                       seg_marker.astype(jnp.int32)], axis=1),
-            pk_safe, num_segments=P)
-        part = {"count": ints[:, 0]}
-        nseg = ints[:, 1]
+        ints = jax.ops.segment_sum(jnp.stack(int_cols, axis=1), pk_safe,
+                                   num_segments=P)
+    part = {"count": ints[:, 0]}
+    col = 1
+    if seg_marker is not None:
+        nseg = ints[:, col]
+        col += 1
+    else:
+        nseg = None
+    for i, name in enumerate(lane_names):
+        part[name] = ints[:, col + i]
+
     if "VECTOR_SUM" in names:
         part["vector_sum"] = jax.ops.segment_sum(masked, pk_safe,
                                                  num_segments=P)
-        return part, nseg
-    if "SUM" in names and config.per_partition_bounds:
-        part["sum"] = jax.ops.segment_sum(per_partition_sum_contrib,
-                                          pk_safe, num_segments=P)
-        return part, nseg
-    need_sum = "SUM" in names
-    need_norm = "MEAN" in names or "VARIANCE" in names
-    need_sumsq = "VARIANCE" in names
-    if need_sum or need_norm:
-        if need_sumsq:
-            sums = jax.ops.segment_sum(
-                jnp.stack([masked, masked * masked], axis=1), pk_safe,
-                num_segments=P)
-            raw_sum = sums[:, 0]
-            raw_sumsq = sums[:, 1]
-        else:
-            raw_sum = jax.ops.segment_sum(masked, pk_safe, num_segments=P)
-        if need_sum:
-            part["sum"] = raw_sum
-    if need_norm:
-        # Normalized-sum trick in pk space: sum(x - mid) and sum((x-mid)^2)
-        # are linear in {sum x, sum x^2, count} — no per-segment pass.
-        middle = dp_computations.compute_middle(config.min_value,
-                                                config.max_value)
-        cf = part["count"].astype(raw_sum.dtype)
-        part["nsum"] = raw_sum - middle * cf
-        if need_sumsq:
-            part["nsumsq"] = (raw_sumsq - 2.0 * middle * raw_sum +
-                              cf * middle * middle)
     return part, nseg
+
+
+def _fold_fixedpoint(config: FusedConfig, part64) -> None:
+    """Reassembles the fixed-point lane columns into float64 values
+    (mutates ``part64``): value = (sum of lanes * 2^(7k) - entries *
+    offset) / scale. ``entries`` (the per-partition count of contributing
+    rows/segments) is exact int, so the offset removal is exact."""
+    for spec in _fixedpoint_layout(config):
+        total = np.zeros_like(part64[spec.count_col], dtype=np.float64)
+        for k in range(_FX_LANES):
+            total += part64.pop(f"{spec.name}_fx{k}").astype(
+                np.float64) * float(1 << (k * _FX_LANE_BITS))
+        if spec.signed:
+            total -= part64[spec.count_col].astype(np.float64) * _FX_OFFSET
+        part64[spec.name] = total / spec.scale
 
 
 def _qrows(config: FusedConfig, pk, values, kept):
@@ -716,25 +806,39 @@ def _selection_and_metrics(config: FusedConfig, num_partitions: int, part,
                            part_nseg, noise_scales, keep_table,
                            sel_threshold, sel_scale, sel_min_count,
                            sel_rows_per_uid, k_sel, k_noise, qrows=None,
-                           psum_axis=None):
-    """Batched partition selection + metric noising over the full pk axis.
-    Runs replicated in the multi-chip path (identical keys on every
-    device)."""
+                           pk_axis=None, pk_axis_size=1):
+    """Batched partition selection + metric noising.
+
+    Single-chip: ``num_partitions`` is the full pk axis. Multi-chip
+    (``pk_axis`` set): the partition axis is SHARDED — ``part``/
+    ``part_nseg`` are this device's owned block of ``num_partitions``
+    partitions (out of ``num_partitions * pk_axis_size`` global), after
+    the ``psum_scatter`` exchange in ``parallel.sharded``. Selection
+    randomness is drawn over the GLOBAL axis and sliced, so the mesh
+    computes bit-identical keep decisions to a single device with the
+    same key whenever the global axis equals the single-chip padded axis
+    (any power-of-two mesh; see ``sharded_fused_aggregate``'s rounding
+    note)."""
     P = num_partitions
+    if pk_axis is None:
+        offset = None
+        P_total = P
+    else:
+        offset = jax.lax.axis_index(pk_axis) * P
+        P_total = P * pk_axis_size
+
+    def owned(draw_fn):
+        """Draws a [P_total] random vector, returns this device's block."""
+        full = draw_fn((P_total,))
+        if offset is None:
+            return full
+        return jax.lax.dynamic_slice(full, (offset,), (P,))
+
     # --- partition selection (batched over all partitions) ---
     if config.selection is None:
         keep_pk = jnp.ones(P, dtype=bool)
-        if config.per_partition_bounds:
-            # Public-partition parity with the generic path: every public
-            # partition receives one empty accumulator whose clipped sum is
-            # clip(0, min_sum, max_sum) (reference
-            # _add_empty_public_partitions + SumCombiner.create([])).
-            empty_sum = float(
-                np.clip(0.0, config.min_sum_per_partition,
-                        config.max_sum_per_partition))
-            if "sum" in part:
-                part = dict(part)
-                part["sum"] = part["sum"] + empty_sum
+        # (The public-partition empty-accumulator sum adjustment happens
+        # in the float64 host release, _host_release.)
     else:
         # Without privacy ids one row is not one user; the conservative
         # user-count estimate is ceil(rows / max_rows_per_privacy_id)
@@ -746,13 +850,16 @@ def _selection_and_metrics(config: FusedConfig, num_partitions: int, part,
                 PartitionSelectionStrategy.TRUNCATED_GEOMETRIC):
             idx = jnp.clip(counts, 0, keep_table.shape[0] - 1)
             p_keep = keep_table[idx]
-            keep_pk = jax.random.uniform(k_sel, (P,)) < p_keep
+            keep_pk = owned(
+                lambda s: jax.random.uniform(k_sel, s)) < p_keep
         else:
             if config.selection == (
                     PartitionSelectionStrategy.LAPLACE_THRESHOLDING):
-                noise_sel = jax.random.laplace(k_sel, (P,)) * sel_scale
+                noise_sel = owned(
+                    lambda s: jax.random.laplace(k_sel, s)) * sel_scale
             else:
-                noise_sel = jax.random.normal(k_sel, (P,)) * sel_scale
+                noise_sel = owned(
+                    lambda s: jax.random.normal(k_sel, s)) * sel_scale
             keep_pk = ((est_users + noise_sel) >= sel_threshold) & (
                 est_users >= sel_min_count)  # pre-threshold hard floor
         keep_pk = keep_pk & (part_nseg > 0)
@@ -770,8 +877,13 @@ def _selection_and_metrics(config: FusedConfig, num_partitions: int, part,
         # Percentile noise scale is the last _noise_scales entry; the tree
         # key is independent of the selection key stream.
         k_tree = jax.random.fold_in(k_noise, 0x7ee)
-        vals = _percentile_values(config, P, qrows, noise_scales[-1],
-                                  k_tree, psum_axis)
+        if pk_axis is None:
+            vals = _percentile_values(config, P, qrows, noise_scales[-1],
+                                      k_tree)
+        else:
+            vals = _percentile_values_owned(config, P, qrows,
+                                            noise_scales[-1], k_tree,
+                                            pk_axis, pk_axis_size)
         for qi, name in enumerate(_percentile_field_names(
                 config.percentiles)):
             out[name] = vals[:, qi]
@@ -789,14 +901,18 @@ def _percentile_field_names(percentiles) -> List[str]:
     return names
 
 
-def _node_noise(noise_kind: NoiseKind, key, node_ids):
+def _node_noise(noise_kind: NoiseKind, key, node_ids, pk_index=None):
     """One noise draw per (partition, tree node), as a pure function of
     the indices: every quantile walk that visits a node sees the same
     noisy count — the stateless form of the host tree's memoization
-    (``ops/quantile_tree.py:176-183``). ``node_ids`` is int32 [P, Q, b]."""
+    (``ops/quantile_tree.py:176-183``). ``node_ids`` is int32 [P, Q, b];
+    ``pk_index`` overrides the per-partition key indices (the GLOBAL
+    partition ids when the pk axis is sharded, so mesh noise matches
+    single-chip noise bit-for-bit)."""
     P = node_ids.shape[0]
-    pkeys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
-        jnp.arange(P, dtype=jnp.uint32))
+    if pk_index is None:
+        pk_index = jnp.arange(P, dtype=jnp.uint32)
+    pkeys = jax.vmap(lambda i: jax.random.fold_in(key, i))(pk_index)
     flat = node_ids.reshape(P, -1).astype(jnp.uint32)
 
     def per_pk(k, ids):
@@ -808,19 +924,17 @@ def _node_noise(noise_kind: NoiseKind, key, node_ids):
     return jax.vmap(per_pk)(pkeys, flat).reshape(node_ids.shape)
 
 
-def _percentile_values(config: FusedConfig, P, qrows, scale, key,
-                       psum_axis=None):
-    """Batched DP quantile-tree descent over every partition at once.
+def _percentile_values(config: FusedConfig, P, qrows, scale, key):
+    """Batched DP quantile-tree descent over every partition at once
+    (single-chip; the sharded twin is ``_percentile_values_owned``).
 
     Level l needs, per (partition, quantile), the noisy counts of the
     ``b`` children of the walk's current node. Rather than materializing
     per-partition trees, each level counts its children with one
     segment_sum over the rows (a row lands in child ``leaf//w - base``
-    of its partition's walk, or nowhere). In the sharded path the counts
-    are per-shard partials combined by psum — the only collective the
-    descent needs. The arithmetic (rank targeting, child pick,
-    interpolation, early stop when no noisy signal remains, monotone
-    post-processing) mirrors ``QuantileTree.compute_quantiles``.
+    of its partition's walk, or nowhere). The arithmetic (rank targeting,
+    child pick, interpolation, early stop when no noisy signal remains,
+    monotone post-processing) mirrors ``QuantileTree.compute_quantiles``.
     """
     qpk, leaf, kept = qrows
     b = quantile_tree_ops.DEFAULT_BRANCHING_FACTOR
@@ -831,15 +945,13 @@ def _percentile_values(config: FusedConfig, P, qrows, scale, key,
     lower = float(config.min_value)
     upper = float(config.max_value)
 
-    # Single-device fast path: one [P, b^2] histogram (bucket width
-    # b^(height-2)), built with ONE row scatter, serves the top two
-    # levels via P-space sums/gathers — full-row scatters are the walk's
-    # dominant cost, so this trades 2 of the 4 away. Wider histograms
-    # don't pay: [P, b^3] is a 536M-segment scatter plus 2GB temps. The
-    # sharded path keeps per-level row scatters (it would otherwise psum
-    # whole histograms instead of [P, Q, b] partials).
+    # Fast path: one [P, b^2] histogram (bucket width b^(height-2)),
+    # built with ONE row scatter, serves the top two levels via P-space
+    # sums/gathers — full-row scatters are the walk's dominant cost, so
+    # this trades 2 of the 4 away. Wider histograms don't pay: [P, b^3]
+    # is a 536M-segment scatter plus 2GB temps.
     hist = None
-    if psum_axis is None and height >= 2:
+    if height >= 2:
         n_mid = b * b
         bucket_w = b**(height - 2)
         hist = jax.ops.segment_sum(
@@ -886,37 +998,105 @@ def _percentile_values(config: FusedConfig, P, qrows, scale, key,
         w = b**(height - 1 - level)
         base = leaf_lo // w  # [P, Q] first-child index at this level
         raw = counts_at(w, base)  # [P, Q, b]
-        if psum_axis is not None:
-            raw = jax.lax.psum(raw, psum_axis)
         node_ids = (level_offset + base)[..., None] + jnp.arange(
             b, dtype=jnp.int32)
         noisy = jnp.maximum(
             raw + _node_noise(config.noise_kind, key, node_ids) * scale,
             0.0)
-        total = noisy.sum(-1)
-        incl = jnp.cumsum(noisy, axis=-1)
-        rank = target * total
-        ge = incl >= rank[..., None]
-        child = jnp.where(ge.any(-1), jnp.argmax(ge, -1), b - 1)
-        c = jnp.take_along_axis(noisy, child[..., None], -1)[..., 0]
-        cum = jnp.take_along_axis(incl, child[..., None], -1)[..., 0] - c
-        width = (hi - lo) / b
-        new_lo = lo + child * width
-        new_target = jnp.where(
-            c <= 0, 0.0,
-            jnp.clip((rank - cum) / jnp.maximum(c, 1e-30), 0.0, 1.0))
-        stop = done | (total <= 0)
-        lo = jnp.where(stop, lo, new_lo)
-        hi = jnp.where(stop, hi, new_lo + width)
-        target = jnp.where(stop, target, new_target)
-        leaf_lo = jnp.where(stop, leaf_lo, leaf_lo + child * w)
-        done = stop
+        lo, hi, target, leaf_lo, done = _walk_step(
+            noisy, lo, hi, target, leaf_lo, done, b, w)
         level_offset += b**(level + 1)
     vals = lo + (hi - lo) * target  # [P, Q]
-    # Monotone in q, like the host post-processing step.
+    return _monotone_in_q(vals, quantiles)
+
+
+def _walk_step(noisy, lo, hi, target, leaf_lo, done, b, w):
+    """One level of the quantile descent: pick the child bucket whose
+    cumulative noisy count crosses the rank target, re-normalize the
+    target into it (``QuantileTree.compute_quantiles`` arithmetic)."""
+    total = noisy.sum(-1)
+    incl = jnp.cumsum(noisy, axis=-1)
+    rank = target * total
+    ge = incl >= rank[..., None]
+    child = jnp.where(ge.any(-1), jnp.argmax(ge, -1), b - 1)
+    c = jnp.take_along_axis(noisy, child[..., None], -1)[..., 0]
+    cum = jnp.take_along_axis(incl, child[..., None], -1)[..., 0] - c
+    width = (hi - lo) / b
+    new_lo = lo + child * width
+    new_target = jnp.where(
+        c <= 0, 0.0,
+        jnp.clip((rank - cum) / jnp.maximum(c, 1e-30), 0.0, 1.0))
+    stop = done | (total <= 0)
+    lo = jnp.where(stop, lo, new_lo)
+    hi = jnp.where(stop, hi, new_lo + width)
+    target = jnp.where(stop, target, new_target)
+    leaf_lo = jnp.where(stop, leaf_lo, leaf_lo + child * w)
+    return lo, hi, target, leaf_lo, stop
+
+
+def _monotone_in_q(vals, quantiles):
+    """Monotone in q, like the host post-processing step."""
     order = np.argsort(quantiles, kind="stable")
     mono = jax.lax.cummax(vals[:, order], axis=1)
     return mono[:, np.argsort(order)]
+
+
+def _percentile_values_owned(config: FusedConfig, P_own, qrows, scale,
+                             key, axis, n_dev):
+    """The quantile descent with the partition axis SHARDED over the
+    mesh: each device walks only its owned block of ``P_own`` partitions
+    (global partition ``axis_index * P_own + i``).
+
+    Per level the collective protocol is: ``all_gather`` the owned walk
+    bases (small [P, Q] int32 — every device's rows may hit any
+    partition's walk), count children locally from this device's rows,
+    then ``psum_scatter`` the [P, Q, b] counts so each owner receives
+    exactly its block's totals — per-device ICI traffic O(P/n_dev·Q·b)
+    instead of the replicated psum's O(P·Q·b). Node noise is keyed by
+    GLOBAL partition index, so the mesh walk is bit-identical to the
+    single-chip walk given the same PRNG key."""
+    qpk, leaf, kept = qrows
+    b = quantile_tree_ops.DEFAULT_BRANCHING_FACTOR
+    height = quantile_tree_ops.DEFAULT_TREE_HEIGHT
+    quantiles = np.asarray([p / 100.0 for p in config.percentiles],
+                           np.float32)
+    Q = quantiles.shape[0]
+    P = P_own * n_dev
+    offset = jax.lax.axis_index(axis) * P_own
+    pk_index = (offset + jnp.arange(P_own)).astype(jnp.uint32)
+
+    lo = jnp.full((P_own, Q), float(config.min_value), jnp.float32)
+    hi = jnp.full((P_own, Q), float(config.max_value), jnp.float32)
+    target = jnp.broadcast_to(quantiles[None, :], (P_own, Q))
+    leaf_lo = jnp.zeros((P_own, Q), jnp.int32)
+    done = jnp.zeros((P_own, Q), bool)
+    level_offset = 0
+    for level in range(height):
+        w = b**(height - 1 - level)
+        base_own = leaf_lo // w  # [P_own, Q]
+        base = jax.lax.all_gather(base_own, axis, axis=0,
+                                  tiled=True)  # [P, Q]
+        counts = []
+        for q in range(Q):
+            slot = leaf // w - base[:, q][qpk]
+            ok = kept & (slot >= 0) & (slot < b)
+            seg = qpk * b + jnp.clip(slot, 0, b - 1)
+            counts.append(
+                jax.ops.segment_sum(ok.astype(jnp.int32), seg,
+                                    num_segments=P * b).reshape(P, b))
+        raw = jax.lax.psum_scatter(jnp.stack(counts, axis=1), axis,
+                                   scatter_dimension=0,
+                                   tiled=True).astype(jnp.float32)
+        node_ids = (level_offset + base_own)[..., None] + jnp.arange(
+            b, dtype=jnp.int32)
+        noisy = jnp.maximum(
+            raw + _node_noise(config.noise_kind, key, node_ids,
+                              pk_index) * scale, 0.0)
+        lo, hi, target, leaf_lo, done = _walk_step(
+            noisy, lo, hi, target, leaf_lo, done, b, w)
+        level_offset += b**(level + 1)
+    vals = lo + (hi - lo) * target  # [P_own, Q]
+    return _monotone_in_q(vals, quantiles)
 
 
 def _expand(mask, like):
@@ -966,14 +1146,17 @@ def _host_release(config: FusedConfig, specs, part, nseg,
     out = {}
     if "VARIANCE" in names or "MEAN" in names:
         snp = _release_noise_params(config, specs["mean_var"])
+        # The device accumulated the normalized sums directly (fixed
+        # point); everything here is float64.
+        nsum = part["nsum"]
         if "VARIANCE" in names:
             dp_count, dp_sum, dp_mean, dp_var = (
-                dp_computations.compute_dp_var(part["count"], part["nsum"],
+                dp_computations.compute_dp_var(part["count"], nsum,
                                                part["nsumsq"], snp, rng))
             out["variance"] = dp_var
         else:
             dp_count, dp_sum, dp_mean = dp_computations.compute_dp_mean(
-                part["count"], part["nsum"], snp, rng)
+                part["count"], nsum, snp, rng)
         if "MEAN" in names:
             out["mean"] = dp_mean
         if "COUNT" in names:
@@ -986,8 +1169,27 @@ def _host_release(config: FusedConfig, specs, part, nseg,
                 part["count"], _release_noise_params(config,
                                                      specs["count"]), rng)
         if "SUM" in names:
+            if config.per_partition_bounds:
+                raw_sum = part["sum"]
+                if config.selection is None:
+                    # Public-partition parity with the generic path:
+                    # every public partition receives one empty
+                    # accumulator whose clipped sum is
+                    # clip(0, min_sum, max_sum) (reference
+                    # _add_empty_public_partitions +
+                    # SumCombiner.create([])).
+                    raw_sum = raw_sum + float(
+                        np.clip(0.0, config.min_sum_per_partition,
+                                config.max_sum_per_partition))
+            else:
+                # Raw sum from the normalized sum: sum(x) = sum(x - mid)
+                # + count * mid, exactly, in float64.
+                middle = dp_computations.compute_middle(
+                    config.min_value, config.max_value)
+                raw_sum = part["nsum"] + part["count"].astype(
+                    np.float64) * middle
             out["sum"] = dp_computations.compute_dp_sum(
-                part["sum"], _release_noise_params(config, specs["sum"]),
+                raw_sum, _release_noise_params(config, specs["sum"]),
                 rng)
     if "PRIVACY_ID_COUNT" in names:
         out["privacy_id_count"] = dp_computations.compute_dp_privacy_id_count(
@@ -1305,6 +1507,8 @@ class LazyFusedResult:
             k: (v.astype(np.int64) if v.dtype.kind in "iu" else
                 v.astype(np.float64)) for k, v in fetched.items()
         }
+        # Reassemble fixed-point value lanes into float64 columns.
+        _fold_fixedpoint(config, part64)
         rng = (np.random.default_rng(self._rng_seed)
                if self._rng_seed is not None else None)
         metric_arrays = _host_release(config, self._specs, part64,

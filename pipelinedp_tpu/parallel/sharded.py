@@ -8,16 +8,23 @@ TPU-native equivalent implemented here:
   assigns ``hash(pid) % n_devices``), so contribution bounding — which
   must see all of one privacy unit's rows — is shard-local. This replaces
   shuffles 1 and 2 of the reference call stack with a local sort.
-* Each shard computes per-pk accumulator *partials* over the full dense
-  partition axis; the cross-shard exchange (the reference's shuffle 3 /
-  ``CombinePerKey``) is a single ``psum`` over ICI — the collective rides
-  the mesh instead of a datacenter shuffle.
-* Selection probabilities (and percentile tree-node noise) are drawn
-  with identical PRNG keys on every device, so the keep decisions and
-  accumulator outputs are replicated and any host can read them. The
-  scalar DP release itself happens later, on host in float64
-  (``jax_engine.LazyFusedResult._host_release``) — the arrays returned
-  here are raw (un-noised) accumulators.
+* The partition axis is sharded too: device ``d`` OWNS the contiguous
+  block of ``P/n_devices`` partition ids starting at ``d*P/n_devices``
+  (ids are dense-factorized, so block ownership is balanced). Each shard
+  computes dense per-pk partials from its rows, then ONE
+  ``psum_scatter`` over ICI hands every owner exactly its block's totals
+  — the reference's shuffle 3 (``CombinePerKey`` key exchange,
+  ``pipeline_backend.py:300-305``) as a collective. Per-device
+  accumulator state and ICI traffic are O(P/n_devices), so adding chips
+  adds partition capacity, not just row throughput.
+* Partition selection and the percentile walk then run per-owner on the
+  owned blocks. Selection randomness is drawn over the global axis and
+  sliced, and percentile node noise is keyed by global partition index,
+  so the mesh's keep decisions and walk match a single device with the
+  same PRNG key bit-for-bit. The scalar DP release happens later, on
+  host in float64 (``jax_engine.LazyFusedResult._host_release``) — the
+  arrays returned here are raw (un-noised) accumulators, reassembled
+  from the owner shards.
 
 The same code runs on a virtual CPU mesh
 (``--xla_force_host_platform_device_count``) for tests and on real
@@ -64,26 +71,34 @@ def make_mesh(n_devices: Optional[int] = None, axis_name: str = "data"
 def _sharded_kernel(config, num_partitions, mesh, pid, pk, values, valid,
                     noise_scales, keep_table, sel_threshold, sel_scale,
                     sel_min_count, sel_rows_per_uid, key):
+    """``num_partitions`` is the GLOBAL (padded) pk axis, a multiple of
+    the mesh size; outputs come back partition-sharded over the mesh."""
     axis = mesh.axis_names[0]
+    n_dev = mesh.devices.size
 
     def local_fn(pid, pk, values, valid, noise_scales, keep_table,
                  sel_threshold, sel_scale, sel_min_count,
                  sel_rows_per_uid, key):
-        # Distinct bounding randomness per shard; identical selection /
-        # noise randomness everywhere (replicated outputs).
+        # Distinct bounding randomness per shard; selection / node noise
+        # keys are shared (each owner draws/slices its global block).
         k_bound = jax.random.fold_in(key, jax.lax.axis_index(axis))
         k_sel, k_noise = jax.random.split(jax.random.fold_in(key, 1 << 20))
         part, part_nseg, qrows = jax_engine._partials(
             config, num_partitions, pid, pk, values, valid, k_bound)
-        # Cross-chip exchange: per-pk partial accumulators (the percentile
-        # walk additionally psums its per-level child counts internally).
-        part = jax.tree.map(lambda x: jax.lax.psum(x, axis), part)
-        part_nseg = jax.lax.psum(part_nseg, axis)
+        # Cross-chip exchange: each device keeps only the accumulator
+        # block it owns (the percentile walk runs its own per-level
+        # all_gather + psum_scatter protocol internally).
+        def to_owner(x):
+            return jax.lax.psum_scatter(x, axis, scatter_dimension=0,
+                                        tiled=True)
+
+        part = jax.tree.map(to_owner, part)
+        part_nseg = to_owner(part_nseg)
         return jax_engine._selection_and_metrics(
-            config, num_partitions, part, part_nseg, noise_scales,
-            keep_table, sel_threshold, sel_scale, sel_min_count,
-            sel_rows_per_uid, k_sel, k_noise, qrows=qrows,
-            psum_axis=axis)
+            config, num_partitions // n_dev, part, part_nseg,
+            noise_scales, keep_table, sel_threshold, sel_scale,
+            sel_min_count, sel_rows_per_uid, k_sel, k_noise, qrows=qrows,
+            pk_axis=axis, pk_axis_size=n_dev)
 
     shard = PSpec(axis)
     repl = PSpec()
@@ -91,7 +106,7 @@ def _sharded_kernel(config, num_partitions, mesh, pid, pk, values, valid,
         local_fn, mesh=mesh,
         in_specs=(shard, shard, shard, shard, repl, repl, repl, repl,
                   repl, repl, repl),
-        out_specs=repl,
+        out_specs=shard,
         **{_CHECK_KW: False})
     return mapped(pid, pk, values, valid, noise_scales, keep_table,
                   sel_threshold, sel_scale, sel_min_count,
@@ -106,10 +121,16 @@ def sharded_fused_aggregate(mesh: Mesh, config, num_partitions: int,
                             key):
     """Host entry: re-shards rows by hash(pid), pads each shard to a
     common length, places arrays over the mesh and runs the sharded
-    kernel. Returns (keep_pk[P], accumulator dict) — replicated, so
-    values are addressable from the host; the scalar release happens
-    downstream on host."""
+    kernel. Returns (keep_pk[P], accumulator dict) with the partition
+    axis sharded over the mesh (device d owns block d); the scalar
+    release happens downstream on host."""
     n_dev = mesh.devices.size
+    # Owner blocks must tile the pk axis evenly. When this rounding is a
+    # no-op (any power-of-two mesh: the padded axis is a power of two),
+    # the mesh's selection draws are bit-identical to single-chip; a mesh
+    # size that does NOT divide the padded axis widens it, so the draws
+    # differ from single-chip (still valid DP, just not replay-identical).
+    num_partitions = -(-num_partitions // n_dev) * n_dev
     # Hash before the modulo: raw ids pass through the encode step
     # unchanged, and id families sharing a residue class (all-even user
     # ids, snowflake ids with fixed low bits) would otherwise pile every
