@@ -139,14 +139,27 @@ def bench_analysis_sweep(n_rows, n_users, n_partitions, n_configs):
     ds = zipf_dataset(n_rows, n_users, n_partitions, seed=1)
 
     def sweep_options(n_cfg):
-        caps = np.unique(np.geomspace(1, 60, n_cfg).astype(int))
-        multi = analysis.MultiParameterConfiguration(
-            max_partitions_contributed=caps.tolist(),
-            max_contributions_per_partition=[2] * len(caps))
+        if n_cfg >= 1000:
+            # BASELINE config 5 at spec: a 10k-configuration grid over
+            # the contribution caps (l0 x linf), all distinct.
+            side = int(round(np.sqrt(n_cfg)))
+            l0s = range(1, side + 1)
+            linfs = range(1, n_cfg // side + 1)
+            pairs = [(a, b) for a in l0s for b in linfs]
+            multi = analysis.MultiParameterConfiguration(
+                max_partitions_contributed=[p[0] for p in pairs],
+                max_contributions_per_partition=[p[1] for p in pairs])
+            n_eff = len(pairs)
+        else:
+            caps = np.unique(np.geomspace(1, 60, n_cfg).astype(int))
+            multi = analysis.MultiParameterConfiguration(
+                max_partitions_contributed=caps.tolist(),
+                max_contributions_per_partition=[2] * len(caps))
+            n_eff = len(caps)
         params = pdp.AggregateParams(
             metrics=[pdp.Metrics.COUNT], noise_kind=pdp.NoiseKind.LAPLACE,
             max_partitions_contributed=4, max_contributions_per_partition=2)
-        return len(caps), analysis.UtilityAnalysisOptions(
+        return n_eff, analysis.UtilityAnalysisOptions(
             epsilon=1.0, delta=1e-6, aggregate_params=params,
             multi_param_configuration=multi)
 
@@ -172,6 +185,22 @@ def bench_analysis_sweep(n_rows, n_users, n_partitions, n_configs):
     run(jax_backend, ds, options)  # warm-up
     n_fused, fused_dt = run(jax_backend, ds, options)
     unit_per_s = n_eff * n_rows / fused_dt
+
+    # Host-oracle spot check: a sampled config subset on a small slice
+    # must agree between the device sweep and the pure-Python graph.
+    spot_cfg, spot_options = sweep_options(3)
+    spot_ds = slice_dataset(ds, base_rows)
+    host_res = list(analysis.perform_utility_analysis(
+        spot_ds, pdp.LocalBackend(), spot_options, extractors))[0]
+    fused_res = list(analysis.perform_utility_analysis(
+        spot_ds, jax_backend, spot_options, extractors))[0]
+    oracle_ok = len(host_res) == len(fused_res) == spot_cfg
+    for h, f in zip(host_res, fused_res):
+        hv = h.count_metrics.error_expected
+        fv = f.count_metrics.error_expected
+        if abs(hv - fv) > max(0.05 * abs(hv), 0.5):
+            oracle_ok = False
+            log(f"## SWEEP ORACLE MISMATCH: host {hv} fused {fv}")
     rec = {
         "metric": "analysis_sweep_config_rows_per_sec",
         "value": round(unit_per_s),
@@ -181,6 +210,7 @@ def bench_analysis_sweep(n_rows, n_users, n_partitions, n_configs):
         "configs": n_eff,
         "fused_s": round(fused_dt, 3),
         "local_unit_rate": round(host_unit_rate),
+        "oracle_check": "ok" if oracle_ok else "MISMATCH",
     }
     log(f"## analysis sweep: {n_eff} configs x {n_rows} rows in "
         f"{fused_dt:.2f}s; host baseline {host_unit_rate:.0f} config*rows/s "
@@ -301,7 +331,8 @@ def main():
         q_rows, q_parts = 10_000_000, 100_000
         # vs_baseline is a unit rate (config*rows/s), comparable across
         # sizes; the host baseline is measured on a small slice.
-        a_rows, a_configs = 500_000, 256
+        # BASELINE config 5 specifies a 10,000-configuration sweep.
+        a_rows, a_configs = 500_000, 10_000
 
     def flagship_params():
         return pdp.AggregateParams(

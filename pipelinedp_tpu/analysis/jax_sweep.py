@@ -76,8 +76,10 @@ _CHUNK_CAP = 512
 def sweep_is_supported(options: data_structures.UtilityAnalysisOptions,
                        data_extractors, return_per_partition: bool) -> bool:
     """Gates for the fused path; anything else falls back to the host
-    graph (which remains the oracle)."""
-    if return_per_partition or options.pre_aggregated_data:
+    graph (which remains the oracle). Per-config ``noise_kind`` /
+    ``partition_selection_strategy`` vectors and pre-aggregated input run
+    fused (VERDICT r2 #6)."""
+    if return_per_partition:
         return False
     params = options.aggregate_params
     if (params.max_partitions_contributed is None or
@@ -97,9 +99,6 @@ def sweep_is_supported(options: data_structures.UtilityAnalysisOptions,
                      multi.min_sum_per_partition is not None)
         if not (has_base or has_multi):
             return False
-    if multi is not None and (multi.noise_kind is not None or
-                              multi.partition_selection_strategy is not None):
-        return False  # per-config mechanism changes: host path
     return True
 
 
@@ -159,33 +158,32 @@ def _noise_stds(metric, all_params, budgets) -> np.ndarray:
 
 
 def _selection_tables(all_params, eps, delta) -> Tuple[np.ndarray, ...]:
-    """Per-config keep-probability inputs. For the truncated-geometric
-    strategy: a [C, T] table (row-padded with its saturating tail value);
-    for thresholding: (threshold[C], scale[C])."""
-    strategy = all_params[0].partition_selection_strategy
-    if strategy == PartitionSelectionStrategy.TRUNCATED_GEOMETRIC:
-        tables = []
-        for p in all_params:
-            s = ps_ops.create_partition_selection_strategy(
-                strategy, eps, delta, p.max_partitions_contributed)
-            tables.append(s.keep_table[:_MAX_TABLE])
-        T = max(len(t) for t in tables)
-        out = np.ones((len(tables), T), np.float32)
-        for i, t in enumerate(tables):
-            out[i, :len(t)] = t
-            out[i, len(t):] = t[-1] if len(t) else 1.0
-        return out, np.zeros(len(tables), np.float32), np.ones(
-            len(tables), np.float32)
-    thr, scale = [], []
+    """Per-config keep-probability inputs, supporting a DIFFERENT
+    selection strategy per configuration: a [C, T] truncated-geometric
+    table (row-padded with its saturating tail value; all-ones dummy row
+    for thresholding configs), threshold[C] and scale[C] (dummies for
+    table configs)."""
+    tables, thr, scale = [], [], []
     for p in all_params:
+        strat = p.partition_selection_strategy
         s = ps_ops.create_partition_selection_strategy(
-            strategy, eps, delta, p.max_partitions_contributed)
-        thr.append(s.threshold)
-        scale.append(s.noise_scale if strategy ==
-                     PartitionSelectionStrategy.LAPLACE_THRESHOLDING else
-                     s.noise_stddev)
-    dummy = np.ones((len(thr), 2), np.float32)
-    return dummy, np.asarray(thr, np.float32), np.asarray(scale, np.float32)
+            strat, eps, delta, p.max_partitions_contributed)
+        if strat == PartitionSelectionStrategy.TRUNCATED_GEOMETRIC:
+            tables.append(s.keep_table[:_MAX_TABLE])
+            thr.append(0.0)
+            scale.append(1.0)
+        else:
+            tables.append(np.ones(1, np.float32))
+            thr.append(s.threshold)
+            scale.append(s.noise_scale if strat ==
+                         PartitionSelectionStrategy.LAPLACE_THRESHOLDING
+                         else s.noise_stddev)
+    T = max(len(t) for t in tables)
+    out = np.ones((len(tables), T), np.float32)
+    for i, t in enumerate(tables):
+        out[i, :len(t)] = t
+        out[i, len(t):] = t[-1] if len(t) else 1.0
+    return out, np.asarray(thr, np.float32), np.asarray(scale, np.float32)
 
 
 @functools.lru_cache(maxsize=4)
@@ -256,7 +254,11 @@ def _preagg_kernel(pid, pk, values, valid):
 # ---------------------------------------------------------------------------
 
 
-def _keep_probability(strategy, mu, var, m3, table, thr, scale):
+_MIXED = "mixed"  # static sentinel: per-config mechanisms in this chunk
+
+
+def _keep_probability(strategy, mu, var, m3, table, thr, scale, is_tg,
+                      is_lap):
     """E[keep(N)] for N ~ Poisson-binomial with the given moments, batched
     over [P, Cc].
 
@@ -264,24 +266,41 @@ def _keep_probability(strategy, mu, var, m3, table, thr, scale):
     window (the device twin of ``poisson_binomial.compute_pmf_approximation``).
     Large σ (window can't span ±8σ) and degenerate σ=0 are handled by
     Gauss-Hermite quadrature / direct lookup.
+
+    ``strategy`` may be the static ``_MIXED`` sentinel: each config then
+    picks its own strategy via the ``is_tg``/``is_lap`` [Cc] masks (all
+    three keep curves are evaluated and selected per config — the masks
+    are runtime inputs so mixed sweeps still compile once).
     """
     sigma = jnp.sqrt(jnp.maximum(var, 0.0))
     skew = jnp.where(sigma > 0, m3 / jnp.maximum(sigma, 1e-30)**3, 0.0)
+    T = table.shape[-1]
+
+    def tg_at(i):  # i: [P, Cc, K] float counts
+        ii = jnp.clip(jnp.round(i), 0, T - 1).astype(jnp.int32)
+        return _table_lookup(table, ii)
+
+    def lap_at(i):
+        z = (i - thr[None, :, None]) / scale[None, :, None]
+        # P(i + Lap(b) >= T) with b = scale.
+        return jnp.where(z < 0, 0.5 * jnp.exp(z),
+                         1.0 - 0.5 * jnp.exp(-z))
+
+    def gauss_at(i):
+        z = (i - thr[None, :, None]) / scale[None, :, None]
+        return _jnorm.cdf(z)
 
     if strategy == PartitionSelectionStrategy.TRUNCATED_GEOMETRIC:
-        T = table.shape[-1]
-
-        def keep_at(i):  # i: [P, Cc, K] float counts
-            ii = jnp.clip(jnp.round(i), 0, T - 1).astype(jnp.int32)
-            return _table_lookup(table, ii)
-    else:
+        keep_at = tg_at
+    elif strategy == PartitionSelectionStrategy.LAPLACE_THRESHOLDING:
+        keep_at = lap_at
+    elif strategy == _MIXED:
         def keep_at(i):
-            z = (i - thr[None, :, None]) / scale[None, :, None]
-            if strategy == PartitionSelectionStrategy.LAPLACE_THRESHOLDING:
-                # P(i + Lap(b) >= T) with b = scale.
-                return jnp.where(z < 0, 0.5 * jnp.exp(z),
-                                 1.0 - 0.5 * jnp.exp(-z))
-            return _jnorm.cdf(z)
+            return jnp.where(
+                is_tg[None, :, None], tg_at(i),
+                jnp.where(is_lap[None, :, None], lap_at(i), gauss_at(i)))
+    else:
+        keep_at = gauss_at
 
     # --- windowed refined normal (small sigma) ---
     offsets = jnp.arange(-_WINDOW, _WINDOW + 1, dtype=jnp.float32)
@@ -326,27 +345,38 @@ def _table_lookup(table, ii):
 
 
 def _error_quantiles(noise_kind, exp_l0, var_l0, noise_std, log_rs,
-                     t_table):
+                     t_table, is_gauss=None):
     """Per-(partition, config, q) error quantiles of bounding + noise.
     Host twin: ``SumAggregateErrorMetricsCombiner._compute_error_quantiles``
-    with the inverted quantile levels."""
+    with the inverted quantile levels. ``noise_kind=None`` means a mixed
+    sweep: both closed forms are evaluated and selected per config via
+    the ``is_gauss`` [Cc] mask."""
     inv_q = np.asarray([1.0 - q for q in ERROR_QUANTILES], np.float32)
-    if noise_kind == NoiseKind.GAUSSIAN:
+
+    def gaussian():
         std = jnp.sqrt(var_l0 + noise_std**2)
         return (exp_l0[..., None] +
                 std[..., None] * _ndtri(inv_q)[None, None, :])
-    # Laplace noise + Gaussian L0 error: interpolated quantile table over
-    # the noise ratio r = sigma_l0 / b.
-    b = noise_std / math.sqrt(2.0)
-    r = jnp.sqrt(jnp.maximum(var_l0, 0.0)) / jnp.maximum(b, 1e-30)
-    logr = jnp.log(jnp.maximum(r, 1e-6))
-    ts = []
-    for qi in range(len(ERROR_QUANTILES)):
-        t = jnp.interp(logr, log_rs, t_table[:, qi])
-        # Beyond the grid the Gaussian term dominates: t ≈ r·Φ⁻¹(q).
-        t = jnp.where(r > 900.0, r * float(_scipy_ppf(inv_q[qi])), t)
-        ts.append(t)
-    return exp_l0[..., None] + b[..., None] * jnp.stack(ts, axis=-1)
+
+    def laplace():
+        # Laplace noise + Gaussian L0 error: interpolated quantile table
+        # over the noise ratio r = sigma_l0 / b.
+        b = noise_std / math.sqrt(2.0)
+        r = jnp.sqrt(jnp.maximum(var_l0, 0.0)) / jnp.maximum(b, 1e-30)
+        logr = jnp.log(jnp.maximum(r, 1e-6))
+        ts = []
+        for qi in range(len(ERROR_QUANTILES)):
+            t = jnp.interp(logr, log_rs, t_table[:, qi])
+            # Beyond the grid the Gaussian term dominates: t ≈ r·Φ⁻¹(q).
+            t = jnp.where(r > 900.0, r * float(_scipy_ppf(inv_q[qi])), t)
+            ts.append(t)
+        return exp_l0[..., None] + b[..., None] * jnp.stack(ts, axis=-1)
+
+    if noise_kind == NoiseKind.GAUSSIAN:
+        return gaussian()
+    if noise_kind == NoiseKind.LAPLACE:
+        return laplace()
+    return jnp.where(is_gauss[None, :, None], gaussian(), laplace())
 
 
 def _scipy_ppf(q):
@@ -356,7 +386,7 @@ def _scipy_ppf(q):
 
 def _metric_chunk(metric_name, x_u, marker, pk_safe, p_u, bounds_lo,
                   bounds_hi, noise_std, noise_kind, p_keep_pk, mask_pk,
-                  pseudo_mask_pk, P, log_rs, t_table):
+                  pseudo_mask_pk, P, log_rs, t_table, is_gauss=None):
     """Stage B+C for one metric over one config chunk. Returns the [Cc]
     aggregate accumulator fields (reference
     ``SumAggregateErrorMetricsCombiner.create_accumulator`` summed over
@@ -404,7 +434,7 @@ def _metric_chunk(metric_name, x_u, marker, pk_safe, p_u, bounds_lo,
     err_var = p_keep * (var_l0 + noise**2)
     qs = _error_quantiles(noise_kind, exp_l0, var_l0,
                           jnp.broadcast_to(noise, exp_l0.shape), log_rs,
-                          t_table)  # [P, Cc, Q]
+                          t_table, is_gauss)  # [P, Cc, Q]
     err_quant = p_keep[..., None] * (qs + (e_min + e_max)[..., None])
     err_w_dropped = (p_keep * (exp_l0 + e_min + e_max) +
                      (1 - p_keep) * -psum)
@@ -457,12 +487,27 @@ def _metric_chunk(metric_name, x_u, marker, pk_safe, p_u, bounds_lo,
 
 
 def _sweep_chunk_body(metric_names, strategy, noise_kind, P, public,
-                      marker, pk_safe, count_u, sum_u, npart_u, users_pk,
-                      l0, linf, min_sum, max_sum, noise_std_rows, table,
-                      thr, scale, log_rs, t_table):
+                      chunk, start, marker, pk_safe, count_u, sum_u,
+                      npart_u, users_pk, l0, linf, min_sum, max_sum,
+                      noise_std_rows, table, thr, scale, is_tg, is_lap,
+                      is_gauss, log_rs, t_table):
     """Stages B+C for one chunk of configurations (pure function; jitted
     directly for one device, or shard_mapped over the mesh with the
-    configuration axis sharded and rows replicated)."""
+    configuration axis sharded and rows replicated).
+
+    The FULL (padded) config vectors live on device; each chunk call
+    slices its ``chunk`` configs at ``start`` on device — the host never
+    re-ships parameter vectors per chunk, so a 10k-config sweep costs
+    one parameter transfer, not one per chunk of the high-latency link."""
+    def sl(a, axis=0):
+        return jax.lax.dynamic_slice_in_dim(a, start, chunk, axis=axis)
+
+    l0, linf, min_sum, max_sum = (sl(l0), sl(linf), sl(min_sum),
+                                  sl(max_sum))
+    noise_std_rows = sl(noise_std_rows, axis=1)
+    table = sl(table)
+    thr, scale = sl(thr), sl(scale)
+    is_tg, is_lap, is_gauss = sl(is_tg), sl(is_lap), sl(is_gauss)
     markerf = marker.astype(jnp.float32)
     p_u = jnp.where(npart_u[:, None] > 0,
                     jnp.minimum(1.0, l0[None, :] /
@@ -485,7 +530,7 @@ def _sweep_chunk_body(metric_names, strategy, noise_kind, P, public,
         mom_pk = jax.ops.segment_sum(mom, pk_safe, num_segments=P)
         p_keep_pk = _keep_probability(strategy, mom_pk[..., 0],
                                       mom_pk[..., 1], mom_pk[..., 2],
-                                      table, thr, scale)
+                                      table, thr, scale, is_tg, is_lap)
         p_keep_pk = jnp.where(mask_pk[:, None], p_keep_pk, 0.0)
         mf = mask_pk.astype(jnp.float32)[:, None]
         sel_stats = {
@@ -509,7 +554,8 @@ def _sweep_chunk_body(metric_names, strategy, noise_kind, P, public,
         out[name] = _metric_chunk(
             name, x_u, markerf, pk_safe, p_u, lo_b, hi_b,
             noise_std_rows[idx], noise_kind, p_keep_pk,
-            mask_pk.astype(jnp.float32), pseudo_mask, P, log_rs, t_table)
+            mask_pk.astype(jnp.float32), pseudo_mask, P, log_rs, t_table,
+            is_gauss)
         idx += 1
     return out, sel_stats
 
@@ -517,46 +563,47 @@ def _sweep_chunk_body(metric_names, strategy, noise_kind, P, public,
 _sweep_chunk_kernel = functools.partial(
     jax.jit,
     static_argnames=("metric_names", "strategy", "noise_kind", "P",
-                     "public"))(_sweep_chunk_body)
+                     "public", "chunk"))(_sweep_chunk_body)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("metric_names", "strategy", "noise_kind", "P",
-                     "public", "mesh"))
+                     "public", "chunk", "mesh"))
 def _sweep_chunk_sharded(metric_names, strategy, noise_kind, P, public,
-                         mesh, marker, pk_safe, count_u, sum_u, npart_u,
-                         users_pk, l0, linf, min_sum, max_sum,
-                         noise_std_rows, table, thr, scale, log_rs,
-                         t_table):
-    """The chunk body over a device mesh: rows replicated, the
-    configuration axis sharded — each device analyzes its slice of the
-    parameter grid independently (no collectives needed; outputs come
-    back sharded along the config axis)."""
+                         chunk, mesh, start, marker, pk_safe, count_u,
+                         sum_u, npart_u, users_pk, l0, linf, min_sum,
+                         max_sum, noise_std_rows, table, thr, scale,
+                         is_tg, is_lap, is_gauss, log_rs, t_table):
+    """The chunk body over a device mesh: rows and the (padded) config
+    vectors replicated, the chunk's configuration axis SPLIT — device d
+    slices its chunk/n_dev configs at ``start + d*(chunk/n_dev)`` on
+    device; outputs come back sharded along the config axis (no
+    collectives needed)."""
     from jax.sharding import PartitionSpec as PSpec
 
     from pipelinedp_tpu.parallel.sharded import _CHECK_KW, shard_map
 
     axis = mesh.axis_names[0]
+    n_dev = mesh.devices.size
+    local = chunk // n_dev
     shard = PSpec(axis)
     repl = PSpec()
     check_kw = _CHECK_KW
 
-    def body(*args):
+    def body(start, *args):
+        my_start = start + jax.lax.axis_index(axis) * local
         return _sweep_chunk_body(metric_names, strategy, noise_kind, P,
-                                 public, *args)
+                                 public, local, my_start, *args)
 
     mapped = shard_map(
         body, mesh=mesh,
-        in_specs=(repl, repl, repl, repl, repl, repl,  # row/pk arrays
-                  shard, shard, shard, shard,          # l0/linf/min/max
-                  PSpec(None, axis),                   # noise rows [M, C]
-                  shard, shard, shard,                 # table/thr/scale
-                  repl, repl),                         # quantile tables
+        in_specs=(repl,) * 20,
         out_specs=shard, **{check_kw: False})
-    return mapped(marker, pk_safe, count_u, sum_u, npart_u, users_pk, l0,
-                  linf, min_sum, max_sum, noise_std_rows, table, thr,
-                  scale, log_rs, t_table)
+    return mapped(start, marker, pk_safe, count_u, sum_u, npart_u,
+                  users_pk, l0, linf, min_sum, max_sum, noise_std_rows,
+                  table, thr, scale, is_tg, is_lap, is_gauss, log_rs,
+                  t_table)
 
 
 # ---------------------------------------------------------------------------
@@ -725,16 +772,41 @@ class LazySweepResult:
         vectors, all_params = _config_vectors(options)
         C = len(all_params)
 
-        encoded = encode(self._col, self._extractors, None, self._public)
-        n_pad = _pad_pow2(max(encoded.n_rows, 1))
-        P = len(encoded.pk_vocab)
-        P_pad = _pad_pow2(max(P, 1))
-
-        pid, pk, values, valid = pad_and_put(
-            encoded, None, with_values=Metrics.SUM in params.metrics)
-        marker, pk_safe, count_u, sum_u, npart_u = _preagg_kernel(
-            pid, pk, values, valid)
-        if options.partitions_sampling_prob < 1:
+        if options.pre_aggregated_data:
+            # Pre-aggregated input: each row IS one (pid, pk) user record
+            # carrying (count, sum, n_partitions) — stage A is skipped
+            # entirely (host twin: NoOpContributionBounder, which also
+            # never samples partitions).
+            from pipelinedp_tpu.dp_engine import DataExtractors
+            ex = self._extractors
+            wrap = DataExtractors(
+                privacy_id_extractor=None,
+                partition_extractor=ex.partition_extractor,
+                value_extractor=lambda row: tuple(
+                    ex.preaggregate_extractor(row)))
+            encoded = encode(self._col, wrap, 3, self._public,
+                             require_pid=False)
+            n_pad = _pad_pow2(max(encoded.n_rows, 1))
+            P = len(encoded.pk_vocab)
+            P_pad = _pad_pow2(max(P, 1))
+            pid, pk, values, valid = pad_and_put(encoded, 3)
+            marker = valid
+            pk_safe = pk
+            count_u = values[:, 0]
+            sum_u = values[:, 1]
+            npart_u = values[:, 2]
+        else:
+            encoded = encode(self._col, self._extractors, None,
+                             self._public)
+            n_pad = _pad_pow2(max(encoded.n_rows, 1))
+            P = len(encoded.pk_vocab)
+            P_pad = _pad_pow2(max(P, 1))
+            pid, pk, values, valid = pad_and_put(
+                encoded, None, with_values=Metrics.SUM in params.metrics)
+            marker, pk_safe, count_u, sum_u, npart_u = _preagg_kernel(
+                pid, pk, values, valid)
+        if (options.partitions_sampling_prob < 1 and
+                not options.pre_aggregated_data):
             # Deterministic partition sampling, identical to the host
             # bounder's ValueSampler (SHA1 of the ORIGINAL key): drop the
             # sampled-out partitions' user records after stage A, so
@@ -767,16 +839,29 @@ class LazySweepResult:
             for m, nm, _ in _METRIC_ORDER if m in params.metrics
         ]) if metric_names else np.zeros((0, C), np.float32)
 
-        strategy = (None if public else
-                    params.partition_selection_strategy)
-        if strategy is not None:
-            table, thr, scale = _selection_tables(
-                all_params, self._selection_budget.eps,
-                self._selection_budget.delta)
-        else:
+        tg = PartitionSelectionStrategy.TRUNCATED_GEOMETRIC
+        lap_t = PartitionSelectionStrategy.LAPLACE_THRESHOLDING
+        if public:
+            strategy = None
             table = np.ones((C, 2), np.float32)
             thr = np.zeros(C, np.float32)
             scale = np.ones(C, np.float32)
+            is_tg = is_lap = np.zeros(C, bool)
+        else:
+            strategies = [p.partition_selection_strategy
+                          for p in all_params]
+            strategy = (strategies[0] if len(set(strategies)) == 1 else
+                        _MIXED)
+            table, thr, scale = _selection_tables(
+                all_params, self._selection_budget.eps,
+                self._selection_budget.delta)
+            is_tg = np.asarray([s == tg for s in strategies], bool)
+            is_lap = np.asarray([s == lap_t for s in strategies], bool)
+        kinds = [p.noise_kind for p in all_params]
+        # None = mixed per-config noise kinds (static sentinel).
+        noise_kind = kinds[0] if len(set(kinds)) == 1 else None
+        is_gauss = np.asarray([k == NoiseKind.GAUSSIAN for k in kinds],
+                              bool)
 
         log_rs, t_table = _laplace_gauss_table(
             tuple(1.0 - q for q in ERROR_QUANTILES))
@@ -794,10 +879,32 @@ class LazySweepResult:
             # the chunk's configuration axis.
             chunk = max(chunk // n_dev, 1) * n_dev
         users_in = jnp.where(real_pk, users_pk, -1)
+
+        # Pad every per-config vector to a chunk multiple (repeating the
+        # last config) and place it on device ONCE; chunks then slice on
+        # device, and all chunk outputs stay device-resident until one
+        # final fetch — the high-latency link is paid twice total, not
+        # twice per chunk.
+        C_pad = -(-C // chunk) * chunk
+
+        def cpad(a, axis=0):
+            a = np.asarray(a)
+            reps = C_pad - a.shape[axis]
+            if reps:
+                tail = np.repeat(np.take(a, [-1], axis=axis), reps, axis)
+                a = np.concatenate([a, tail], axis)
+            return a
+
+        host_cfg = (cpad(vectors["l0"]), cpad(vectors["linf"]),
+                    cpad(vectors["min_sum"]), cpad(vectors["max_sum"]),
+                    cpad(noise_rows, axis=1) if len(noise_rows) else
+                    np.zeros((0, C_pad), np.float32),
+                    cpad(table), cpad(thr), cpad(scale), cpad(is_tg),
+                    cpad(is_lap), cpad(is_gauss))
         if self._mesh is not None and n_dev > 1:
-            # Place the replicated row arrays and quantile tables on the
-            # mesh ONCE: left committed to a single device they would
-            # re-broadcast to every device on each chunk iteration.
+            # Place the replicated row arrays, config vectors and tables
+            # on the mesh ONCE: left committed to a single device they
+            # would re-broadcast to every device on each chunk iteration.
             from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as PSpec
             repl_sharding = NamedSharding(self._mesh, PSpec())
@@ -805,59 +912,44 @@ class LazySweepResult:
              dt_table) = jax.device_put(
                  (marker, pk_safe, count_u, sum_u, npart_u, users_in,
                   log_rs, t_table), repl_sharding)
+            cfg = jax.device_put(host_cfg, repl_sharding)
         else:
             dlog_rs, dt_table = jax.device_put((log_rs, t_table))
-        fields: Dict[str, Dict[str, List[np.ndarray]]] = {
-            nm: {} for nm in metric_names}
-        sel_fields: Dict[str, List[np.ndarray]] = {}
+            cfg = jax.device_put(host_cfg)
+
+        chunk_outs = []
         for start in range(0, C, chunk):
-            end = min(start + chunk, C)
-            pad = chunk - (end - start)
-
-            def cv(arr):
-                a = np.asarray(arr[start:end], np.float32)
-                if pad:
-                    a = np.concatenate([a, np.repeat(a[-1:], pad, 0)], 0)
-                return a
-
-            # One batched h2d for the chunk's parameter vectors.
-            chunk_in = jax.device_put(
-                (cv(vectors["l0"]), cv(vectors["linf"]),
-                 cv(vectors["min_sum"]), cv(vectors["max_sum"]),
-                 np.stack([cv(r) for r in noise_rows])
-                 if len(noise_rows) else np.zeros((0, chunk), np.float32),
-                 cv(table), cv(thr), cv(scale)))
             if self._mesh is not None and n_dev > 1:
                 out, sel = _sweep_chunk_sharded(
-                    metric_names, strategy, params.noise_kind, P_pad,
-                    public, self._mesh, marker, pk_safe, count_u, sum_u,
-                    npart_u, users_in, *chunk_in, dlog_rs, dt_table)
+                    metric_names, strategy, noise_kind, P_pad, public,
+                    chunk, self._mesh, np.int32(start), marker, pk_safe,
+                    count_u, sum_u, npart_u, users_in, *cfg, dlog_rs,
+                    dt_table)
             else:
                 out, sel = _sweep_chunk_kernel(
-                    metric_names, strategy, params.noise_kind, P_pad,
-                    public, marker, pk_safe, count_u, sum_u, npart_u,
-                    users_in, *chunk_in, dlog_rs, dt_table)
-            # The tunneled host link pays per round trip: flatten every
-            # output field into ONE d2h transfer and split on host.
-            leaves, treedef = jax.tree.flatten((out, sel))
-            shapes = [l.shape for l in leaves]
-            flat = np.asarray(jnp.concatenate([l.ravel() for l in leaves]))
-            split, off = [], 0
-            for s in shapes:
-                size = int(np.prod(s))
-                split.append(flat[off:off + size].reshape(s))
-                off += size
-            out, sel = jax.tree.unflatten(treedef, split)
-            for nm in metric_names:
-                for k, v in out[nm].items():
-                    fields[nm].setdefault(k, []).append(v[:end - start])
-            if sel is not None:
-                for k, v in sel.items():
-                    sel_fields.setdefault(k, []).append(v[:end - start])
+                    metric_names, strategy, noise_kind, P_pad, public,
+                    chunk, np.int32(start), marker, pk_safe, count_u,
+                    sum_u, npart_u, users_in, *cfg, dlog_rs, dt_table)
+            chunk_outs.append((out, sel))
 
-        cat = lambda d: {k: np.concatenate(v) for k, v in d.items()}
-        fields = {nm: cat(d) for nm, d in fields.items()}
-        sel_fields = cat(sel_fields) if sel_fields else None
+        out_cat = jax.tree.map(lambda *xs: jnp.concatenate(xs, 0),
+                               *[o for o, _ in chunk_outs])
+        sel_cat = None
+        if chunk_outs[0][1] is not None:
+            sel_cat = jax.tree.map(lambda *xs: jnp.concatenate(xs, 0),
+                                   *[s for _, s in chunk_outs])
+        # ONE flat d2h transfer for every output field of every chunk.
+        leaves, treedef = jax.tree.flatten((out_cat, sel_cat))
+        shapes = [l.shape for l in leaves]
+        flat = np.asarray(jnp.concatenate([l.ravel() for l in leaves]))
+        split, off = [], 0
+        for s in shapes:
+            size = int(np.prod(s))
+            split.append(flat[off:off + size].reshape(s)[:C])
+            off += size
+        out_cat, sel_cat = jax.tree.unflatten(treedef, split)
+        fields = {nm: out_cat[nm] for nm in metric_names}
+        sel_fields = sel_cat
         return self._pack(all_params, fields, sel_fields, noise_rows,
                           metric_names)
 
@@ -935,7 +1027,7 @@ def build_fused_sweep(col, options, data_extractors, public_partitions,
     """Requests the same budgets the host analysis engine would
     (``utility_analysis_engine.py:61-99``) and returns the lazy sweep."""
     params = options.aggregate_params
-    mechanism_type = params.noise_kind.convert_to_mechanism_type()
+    mechanism_type = data_structures.analysis_mechanism_type(options)
     selection_budget = None
     if public_partitions is None:
         selection_budget = budget_accountant.request_budget(

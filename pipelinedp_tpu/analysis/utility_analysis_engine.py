@@ -59,8 +59,8 @@ class UtilityAnalysisEngine(dp_engine.DPEngine):
             self._options.partitions_sampling_prob)
 
     def _create_compound_combiner(self, aggregate_params: AggregateParams):
-        mechanism_type = (
-            aggregate_params.noise_kind.convert_to_mechanism_type())
+        mechanism_type = data_structures.analysis_mechanism_type(
+            self._options)
         if not self._is_public_partitions:
             selection_budget = self._budget_accountant.request_budget(
                 MechanismType.GENERIC,

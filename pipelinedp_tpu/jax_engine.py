@@ -924,6 +924,11 @@ def _node_noise(noise_kind: NoiseKind, key, node_ids, pk_index=None):
     return jax.vmap(per_pk)(pkeys, flat).reshape(node_ids.shape)
 
 
+# HBM cap for the per-quantile subtree histogram (int32 [P, Q, span]);
+# above it the walk falls back to per-level row scatters.
+_SUBHIST_BYTE_CAP = 600 << 20
+
+
 def _percentile_values(config: FusedConfig, P, qrows, scale, key):
     """Batched DP quantile-tree descent over every partition at once
     (single-chip; the sharded twin is ``_percentile_values_owned``).
@@ -975,7 +980,7 @@ def _percentile_values(config: FusedConfig, P, qrows, scale, key):
             idx = base[..., None] + jnp.arange(b)  # [P, Q, b]
             return lvl[jnp.arange(P)[:, None, None], idx].astype(
                 jnp.float32)
-        # Lower levels (or sharded path): per-quantile row passes (an
+        # Fallback for the lower levels: per-quantile row passes (an
         # interleaved [n*Q] scatter benches slower than Q separate [n]
         # scatters on TPU).
         counts = []
@@ -994,10 +999,48 @@ def _percentile_values(config: FusedConfig, P, qrows, scale, key):
     leaf_lo = jnp.zeros((P, Q), jnp.int32)
     done = jnp.zeros((P, Q), bool)
     level_offset = 0
+    sub_hist = None  # [P, Q, span] leaf-granularity subtree histogram
+    sub_start = None  # [P, Q] first leaf of the sub_hist subtree
     for level in range(height):
         w = b**(height - 1 - level)
         base = leaf_lo // w  # [P, Q] first-child index at this level
-        raw = counts_at(w, base)  # [P, Q, b]
+        below_hist = hist is None or w < bucket_w
+        if below_hist and sub_hist is None:
+            # Entering the levels the top histogram can't serve. ONE
+            # leaf-granularity scatter per quantile over the chosen
+            # subtree (span = w*b leaves) serves ALL remaining levels via
+            # in-register group sums — halving the walk's dominant cost,
+            # the full-row scatters (VERDICT r2 #9). Skipped when the
+            # [P, Q, span] block would blow HBM; the per-level fallback
+            # then runs.
+            span = w * b
+            if P * Q * span * 4 <= _SUBHIST_BYTE_CAP:
+                sub_start = leaf_lo
+                subs = []
+                for q in range(Q):
+                    rel = leaf - sub_start[:, q][qpk]
+                    ok = kept & (rel >= 0) & (rel < span)
+                    seg = qpk * span + jnp.clip(rel, 0, span - 1)
+                    subs.append(
+                        jax.ops.segment_sum(ok.astype(jnp.int32), seg,
+                                            num_segments=P * span
+                                            ).reshape(P, span))
+                sub_hist = jnp.stack(subs, axis=1)  # [P, Q, span] int32
+        if not below_hist:
+            raw = counts_at(w, base)  # [P, Q, b]
+        elif sub_hist is not None:
+            span = sub_hist.shape[-1]
+            if w == 1:
+                g = sub_hist
+            else:
+                g = sub_hist.reshape(P, Q, span // w, w).sum(-1)
+            # Children occupy w-groups [off + c] for c < b, where off is
+            # the current node's group offset inside the subtree.
+            off = (leaf_lo - sub_start) // w  # [P, Q]
+            idx = off[..., None] + jnp.arange(b)  # [P, Q, b]
+            raw = jnp.take_along_axis(g, idx, axis=2).astype(jnp.float32)
+        else:
+            raw = counts_at(w, base)
         node_ids = (level_offset + base)[..., None] + jnp.arange(
             b, dtype=jnp.int32)
         noisy = jnp.maximum(
