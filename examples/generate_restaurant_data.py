@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Generates ``restaurants_week_data.csv`` — a synthetic week of
+restaurant visits with the same schema as the reference dataset
+(``examples/restaurant_visits/restaurants_week_data.csv`` in PipelineDP:
+VisitorId, Time entered, Time spent (minutes), Money spent (euros), Day).
+
+Deterministic (fixed seed), so the checked-in CSV regenerates
+bit-identically: ``python examples/generate_restaurant_data.py``.
+"""
+
+import csv
+import os
+
+import numpy as np
+
+OUT = os.path.join(os.path.dirname(__file__), "restaurants_week_data.csv")
+
+
+def generate(path=OUT, n_visitors=1200, seed=2026):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for visitor in range(1, n_visitors + 1):
+        # Most guests visit once or twice a week; regulars come daily.
+        n_visits = int(rng.choice([1, 1, 2, 2, 3, 5, 7]))
+        days = rng.choice(7, size=min(n_visits, 7), replace=False) + 1
+        for day in sorted(int(d) for d in days):
+            hour = int(rng.integers(9, 21))
+            minute = int(rng.integers(0, 60))
+            ampm = "AM" if hour < 12 else "PM"
+            h12 = hour if hour <= 12 else hour - 12
+            spent_minutes = int(rng.integers(5, 90))
+            money = int(np.clip(rng.normal(18, 8), 3, 60))
+            rows.append((visitor, f"{h12}:{minute:02d}{ampm}",
+                         spent_minutes, money, day))
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["VisitorId", "Time entered", "Time spent (minutes)",
+                    "Money spent (euros)", "Day"])
+        w.writerows(rows)
+    return len(rows)
+
+
+if __name__ == "__main__":
+    n = generate()
+    print(f"wrote {n} visits to {OUT}")

@@ -1,31 +1,53 @@
 #!/usr/bin/env python
-"""DP analysis of weekly restaurant visits with utility analysis and
-parameter tuning (the reference's ``examples/restaurant_visits/``,
-synthetic data generated in-process).
+"""DP analysis of a week of restaurant visits, read from a CSV file
+through real extractors (the reference's ``examples/restaurant_visits/``
+workflow: ``run_without_frameworks*.py`` over
+``restaurants_week_data.csv``).
+
+The dataset partitions by week day; metrics are per-day visit counts and
+money totals, plus utility analysis / parameter tuning over the same
+file. Regenerate the CSV with ``python examples/generate_restaurant_data.py``.
 
 Usage:
-  python examples/restaurant_visits.py             # DP privacy-id count
-  python examples/restaurant_visits.py --analyze   # utility analysis
-  python examples/restaurant_visits.py --tune      # parameter tuning
+  python examples/restaurant_visits.py               # DP count + sum per day
+  python examples/restaurant_visits.py --analyze     # utility analysis
+  python examples/restaurant_visits.py --tune        # parameter tuning
+  python examples/restaurant_visits.py --columnar    # ArrayDataset fast path
 """
 
 import argparse
+import csv
 import operator
+import os
 
-import numpy as np
+DATA = os.path.join(os.path.dirname(__file__), "restaurants_week_data.csv")
 
 
-def generate_visits(n_visitors=2_000, n_restaurants=40, seed=0):
-    """(visitor_id, restaurant, spend) rows: frequent diners visit several
-    restaurants several times a week."""
-    rng = np.random.default_rng(seed)
-    rows = []
-    for v in range(n_visitors):
-        n_visits = int(rng.integers(1, 8))
-        for _ in range(n_visits):
-            rows.append((v, int(rng.integers(0, n_restaurants)),
-                         float(rng.uniform(5, 50))))
-    return rows
+def load_rows(path=DATA):
+    """(visitor_id, day, money) tuples straight from the CSV. Plain
+    ``operator.itemgetter`` extractors over these rows take the
+    vectorized ingest bridge — no per-row Python extractor calls."""
+    with open(path, newline="") as f:
+        reader = csv.DictReader(f)
+        return [(int(r["VisitorId"]), int(r["Day"]),
+                 float(r["Money spent (euros)"])) for r in reader]
+
+
+def load_columns(path=DATA):
+    """The same file as a columnar ArrayDataset (the zero-copy fast path
+    into the fused TPU plane)."""
+    import numpy as np
+
+    import pipelinedp_tpu as pdp
+    visitors, days, money = [], [], []
+    with open(path, newline="") as f:
+        for r in csv.DictReader(f):
+            visitors.append(int(r["VisitorId"]))
+            days.append(int(r["Day"]))
+            money.append(float(r["Money spent (euros)"]))
+    return pdp.ArrayDataset(privacy_ids=np.asarray(visitors),
+                            partition_keys=np.asarray(days),
+                            values=np.asarray(money))
 
 
 def extractors():
@@ -35,22 +57,26 @@ def extractors():
                               value_extractor=operator.itemgetter(2))
 
 
-def run_dp_count(data):
+def run_dp_week(data, ext=None, backend=None):
+    """Per-day DP visit count + DP money total, public partitions =
+    the seven week days."""
     import pipelinedp_tpu as pdp
-    backend = pdp.LocalBackend()
+    backend = backend or pdp.LocalBackend()
     accountant = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
-                                           total_delta=1e-6)
-    pcol = pdp.make_private(data, backend, accountant,
-                            operator.itemgetter(0))
-    result = pcol.privacy_id_count(
-        pdp.PrivacyIdCountParams(
-            max_partitions_contributed=3,
-            partition_extractor=operator.itemgetter(1)))
+                                           total_delta=1e-7)
+    engine = pdp.DPEngine(accountant, backend)
+    params = pdp.AggregateParams(
+        metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+        noise_kind=pdp.NoiseKind.LAPLACE,
+        max_partitions_contributed=3,
+        max_contributions_per_partition=2,
+        min_value=0.0, max_value=60.0)
+    result = engine.aggregate(data, params, ext or extractors(),
+                              public_partitions=list(range(1, 8)))
     accountant.compute_budgets()
-    out = sorted(dict(result).items())
-    print(f"{len(out)} restaurants selected; first 5:")
-    for r, c in out[:5]:
-        print(f"  restaurant {r}: ~{c:.0f} distinct visitors")
+    print("day  ~visits  ~euros")
+    for day, m in sorted(dict(result).items()):
+        print(f"  {day}   {m.count:6.0f}  {m.sum:7.0f}")
 
 
 def run_analysis(data):
@@ -58,7 +84,7 @@ def run_analysis(data):
     from pipelinedp_tpu import analysis
     backend = pdp.LocalBackend()
     options = analysis.UtilityAnalysisOptions(
-        epsilon=1.0, delta=1e-6,
+        epsilon=1.0, delta=1e-7,
         aggregate_params=pdp.AggregateParams(
             metrics=[pdp.Metrics.COUNT], max_partitions_contributed=3,
             max_contributions_per_partition=2),
@@ -84,7 +110,7 @@ def run_tuning(data):
         analysis.compute_dataset_histograms(data, extractors(),
                                             backend))[0]
     options = analysis.TuneOptions(
-        epsilon=1.0, delta=1e-6,
+        epsilon=1.0, delta=1e-7,
         aggregate_params=pdp.AggregateParams(
             metrics=[pdp.Metrics.COUNT], max_partitions_contributed=1,
             max_contributions_per_partition=1),
@@ -106,14 +132,23 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--analyze", action="store_true")
     parser.add_argument("--tune", action="store_true")
+    parser.add_argument("--columnar", action="store_true",
+                        help="ingest via ArrayDataset columns")
     args = parser.parse_args()
-    data = generate_visits()
+    if not os.path.exists(DATA):
+        import generate_restaurant_data
+        generate_restaurant_data.generate()
     if args.analyze:
-        run_analysis(data)
+        run_analysis(load_rows())
     elif args.tune:
-        run_tuning(data)
+        run_tuning(load_rows())
+    elif args.columnar:
+        import pipelinedp_tpu as pdp
+        from pipelinedp_tpu.backends import JaxBackend
+        run_dp_week(load_columns(), ext=pdp.DataExtractors(),
+                    backend=JaxBackend())
     else:
-        run_dp_count(data)
+        run_dp_week(load_rows())
 
 
 if __name__ == "__main__":

@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""The restaurant-visits pipeline on Apache Beam through the fluent
+``private_beam`` API (the reference's
+``examples/restaurant_visits/run_on_beam.py`` workflow).
+
+Requires ``pip install apache-beam`` (not bundled); the DP engine and the
+two-phase budget protocol are exactly the ones the local/TPU planes use —
+Beam only supplies the distributed shuffle.
+"""
+
+import operator
+
+from restaurant_visits import DATA, load_rows
+
+
+def main():
+    try:
+        import apache_beam as beam
+    except ImportError:
+        raise SystemExit("apache-beam is not installed; "
+                         "`pip install apache-beam` to run this example.")
+
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu import private_beam
+
+    accountant = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                           total_delta=1e-7)
+    with beam.Pipeline() as pipeline:
+        visits = pipeline | beam.Create(load_rows(DATA))
+        private = visits | private_beam.MakePrivate(
+            budget_accountant=accountant,
+            privacy_id_extractor=operator.itemgetter(0))
+        sums = private | private_beam.Sum(
+            pdp.SumParams(
+                partition_extractor=operator.itemgetter(1),
+                value_extractor=operator.itemgetter(2),
+                max_partitions_contributed=3,
+                max_contributions_per_partition=2,
+                min_value=0.0, max_value=60.0),
+            public_partitions=list(range(1, 8)))
+        accountant.compute_budgets()
+        sums | beam.Map(lambda kv: print(f"day {kv[0]}: ~{kv[1]:.0f} EUR"))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""The restaurant-visits pipeline on Spark RDDs through the fluent
+``private_spark`` API (the reference's
+``examples/restaurant_visits/run_on_spark.py`` workflow).
+
+Requires ``pip install pyspark`` (not bundled)."""
+
+import operator
+
+from restaurant_visits import DATA, load_rows
+
+
+def main():
+    try:
+        import pyspark
+    except ImportError:
+        raise SystemExit("pyspark is not installed; "
+                         "`pip install pyspark` to run this example.")
+
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu import private_spark
+
+    master = pyspark.SparkConf().setMaster("local[1]")
+    sc = pyspark.SparkContext(conf=master)
+    accountant = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                           total_delta=1e-7)
+    rdd = sc.parallelize(load_rows(DATA))
+    private = private_spark.make_private(rdd, accountant,
+                                         operator.itemgetter(0))
+    result = private.sum(
+        pdp.SumParams(partition_extractor=operator.itemgetter(1),
+                      value_extractor=operator.itemgetter(2),
+                      max_partitions_contributed=3,
+                      max_contributions_per_partition=2,
+                      min_value=0.0, max_value=60.0),
+        public_partitions=list(range(1, 8)))
+    accountant.compute_budgets()
+    for day, total in sorted(result.collect()):
+        print(f"day {day}: ~{total:.0f} EUR")
+    sc.stop()
+
+
+if __name__ == "__main__":
+    main()

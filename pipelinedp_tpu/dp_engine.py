@@ -31,6 +31,23 @@ def _cached_partition_selection_strategy(strategy, eps, delta,
         strategy, eps, delta, max_partitions, pre_threshold)
 
 
+def _selection_filter_fn(budget, max_partitions, max_rows_per_privacy_id,
+                         strategy, pre_threshold, row) -> bool:
+    """The private-partition-selection predicate, at module level so the
+    ``functools.partial`` closing over it pickles to cluster workers.
+
+    Strategy objects are created lazily on workers, after budgets are
+    computed (reference :350-352) — but cached per (strategy, eps, delta,
+    ...) so the truncated-geometric probability table is built once per
+    worker, not per partition."""
+    row_count, _ = row[1]
+    privacy_id_count = (row_count + max_rows_per_privacy_id -
+                        1) // max_rows_per_privacy_id
+    strategy_object = _cached_partition_selection_strategy(
+        strategy, budget.eps, budget.delta, max_partitions, pre_threshold)
+    return strategy_object.should_keep(privacy_id_count)
+
+
 @dataclasses.dataclass
 class DataExtractors:
     """Extractor triple (reference :27-37): given an input row, return its
@@ -284,22 +301,11 @@ class DPEngine:
         count passes the selection strategy (reference :312-362)."""
         budget = self._budget_accountant.request_budget(
             mechanism_type=MechanismType.GENERIC)
-
-        def filter_fn(budget, max_partitions, max_rows_per_privacy_id,
-                      strategy, pre_threshold, row) -> bool:
-            # Strategy objects are created lazily on workers, after budgets
-            # are computed (reference :350-352) — but cached per
-            # (strategy, eps, delta, ...) so the truncated-geometric
-            # probability table is built once per worker, not per partition.
-            row_count, _ = row[1]
-            privacy_id_count = (row_count + max_rows_per_privacy_id -
-                                1) // max_rows_per_privacy_id
-            strategy_object = _cached_partition_selection_strategy(
-                strategy, budget.eps, budget.delta, max_partitions,
-                pre_threshold)
-            return strategy_object.should_keep(privacy_id_count)
-
-        filter_fn = functools.partial(filter_fn, budget,
+        # functools.partial over the MODULE-LEVEL _selection_filter_fn:
+        # cluster runners pickle this closure to ship it to workers, and
+        # only importable functions survive the stdlib pickler (reference
+        # :354-357 uses the same construction for the same reason).
+        filter_fn = functools.partial(_selection_filter_fn, budget,
                                       max_partitions_contributed,
                                       max_rows_per_privacy_id, strategy,
                                       pre_threshold)

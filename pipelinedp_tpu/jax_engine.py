@@ -429,6 +429,108 @@ def _encode_arrays(ds: ArrayDataset, vector_size: Optional[int],
                        n_rows=len(pk_idx))
 
 
+def _itemgetter_index(fn) -> Optional[int]:
+    """The index a plain single-item ``operator.itemgetter`` selects, or
+    None for any other callable. Resolved by probing with a recording
+    object — exact-type-gated, so only true positional selectors (which
+    can do nothing but index) qualify."""
+    import operator
+    if type(fn) is not operator.itemgetter:
+        return None
+
+    class _Probe:
+        def __init__(self):
+            self.indices = []
+
+        def __getitem__(self, i):
+            self.indices.append(i)
+            return i
+
+    probe = _Probe()
+    try:
+        result = fn(probe)
+    except Exception:
+        return None
+    if len(probe.indices) == 1 and result == probe.indices[0]:
+        return probe.indices[0]
+    return None
+
+
+def _rows_to_arrays(rows, data_extractors,
+                    require_pid: bool) -> Optional[ArrayDataset]:
+    """The vectorized extractor bridge: when every extractor is a plain
+    ``operator.itemgetter`` over tuple rows, ingest transposes the rows
+    once at C level (``zip(*rows)``) instead of paying three Python
+    extractor calls per row, and the columns take the same vectorized
+    encode as an ArrayDataset. Returns None when the rows/extractors
+    don't qualify (arbitrary callables fall back to the row loop)."""
+    if not isinstance(rows, (list, tuple)) or not rows:
+        return None
+    if not isinstance(rows[0], (tuple, list)):
+        return None
+    i_pid = _itemgetter_index(data_extractors.privacy_id_extractor)
+    i_pk = _itemgetter_index(data_extractors.partition_extractor)
+    i_val = _itemgetter_index(data_extractors.value_extractor)
+    if i_pk is None:
+        return None
+    if require_pid and i_pid is None:
+        return None
+    if (data_extractors.value_extractor is not None and i_val is None):
+        return None
+    # Per-column extraction: a plain `[r[i] for r in rows]` comprehension
+    # benches ~3.5x faster than both np.asarray(rows) and zip(*rows) for
+    # multi-million-row lists (one bytecode-level loop per column, no
+    # intermediate 2-D object array). Dtypes are probed on a small prefix
+    # first so unsupported columns (string keys) bail out without paying
+    # a full O(n) pass before the row-loop fallback.
+    def col(i, probe=0):
+        if i is None:
+            return None
+        try:
+            sample = rows[:256] if probe else rows
+            arr = np.asarray([r[i] for r in sample])
+        except (IndexError, ValueError, TypeError):
+            return None
+        return None if arr.dtype == object else arr
+
+    # Id columns must be numeric: np.unique on large string columns is
+    # slower than the dict-based row loop, so strings keep that path.
+    def usable(i, need_1d):
+        a = col(i, probe=1)
+        return (a is not None and a.dtype.kind in "iuf" and
+                (not need_1d or a.ndim == 1))
+
+    if not usable(i_pk, True):
+        return None
+    if i_pid is not None and not usable(i_pid, True):
+        return None
+    if i_val is not None and not usable(i_val, False):
+        return None
+
+    def full(i, need_1d):
+        a = col(i)
+        if (a is None or a.dtype.kind not in "iuf" or
+                (need_1d and a.ndim != 1)):
+            return None
+        return a
+
+    pk_arr = full(i_pk, True)
+    if pk_arr is None:
+        return None
+    pid_arr = None
+    if i_pid is not None:
+        pid_arr = full(i_pid, True)
+        if pid_arr is None:
+            return None
+    val_arr = None
+    if i_val is not None:
+        val_arr = full(i_val, False)
+        if val_arr is None:
+            return None
+    return ArrayDataset(privacy_ids=pid_arr, partition_keys=pk_arr,
+                        values=val_arr)
+
+
 def encode(rows, data_extractors, vector_size: Optional[int],
            public_partitions: Optional[Sequence] = None,
            require_pid: bool = True) -> EncodedData:
@@ -444,6 +546,10 @@ def encode(rows, data_extractors, vector_size: Optional[int],
                 ("encode", vector_size, require_pid),
                 lambda: _encode_arrays(rows, vector_size, None, require_pid))
         return _encode_arrays(rows, vector_size, public_partitions,
+                              require_pid)
+    bridged = _rows_to_arrays(rows, data_extractors, require_pid)
+    if bridged is not None:
+        return _encode_arrays(bridged, vector_size, public_partitions,
                               require_pid)
     pids, pks, vals = [], [], []
     pid_ex = data_extractors.privacy_id_extractor

@@ -31,6 +31,14 @@ class Pipeline:
     def __init__(self):
         self._labels = set()
 
+    # Real beam pipelines run on context exit; the fake is eager, so the
+    # context manager is a pass-through.
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
     def check_label(self, label):
         if label is None:
             return
