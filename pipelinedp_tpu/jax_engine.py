@@ -596,11 +596,12 @@ def encode(rows, data_extractors, vector_size: Optional[int],
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("config", "num_partitions"))
+@functools.partial(jax.jit, static_argnames=("config", "num_partitions",
+                                             "fx_bits"))
 def fused_aggregate_kernel(config: FusedConfig, num_partitions: int, pid,
                            pk, values, valid, noise_scales, keep_table,
                            sel_threshold, sel_scale, sel_min_count,
-                           sel_rows_per_uid, key):
+                           sel_rows_per_uid, key, fx_bits: int = 7):
     """One compiled program for the whole aggregation. See module docstring.
 
     Runtime inputs:
@@ -614,7 +615,7 @@ def fused_aggregate_kernel(config: FusedConfig, num_partitions: int, pid,
     """
     k_bound, k_sel, k_noise = jax.random.split(key, 3)
     part, part_nseg, qrows = _partials(config, num_partitions, pid, pk,
-                                       values, valid, k_bound)
+                                       values, valid, k_bound, fx_bits)
     return _selection_and_metrics(config, num_partitions, part, part_nseg,
                                   noise_scales, keep_table, sel_threshold,
                                   sel_scale, sel_min_count,
@@ -623,7 +624,7 @@ def fused_aggregate_kernel(config: FusedConfig, num_partitions: int, pid,
 
 
 def _partials(config: FusedConfig, num_partitions: int, pid, pk, values,
-              valid, key):
+              valid, key, fx_bits: int = 7):
     """Contribution bounding + per-pk accumulator partials. Shardable by
     privacy id: every pid's rows must live in one shard, pks may be
     spread — partials then combine across shards by plain addition
@@ -661,7 +662,7 @@ def _partials(config: FusedConfig, num_partitions: int, pid, pk, values,
         qrows = (_qrows(config, pk_safe, values, row_keep)
                  if config.percentiles else None)
         part, _ = _reduce_per_pk(config, pk_safe, masked, row_keep, masked,
-                                 P)
+                                 P, fx_bits=fx_bits)
         # Without pids every row counts as its own privacy unit
         # (reference dp_engine.py:341-348 works off row counts).
         part_nseg = part["count"]
@@ -745,10 +746,12 @@ def _partials(config: FusedConfig, num_partitions: int, pid, pk, values,
             jnp.clip(tot_row, config.min_sum_per_partition,
                      config.max_sum_per_partition), 0.0)
         part, part_nseg = _reduce_per_pk(config, pk_safe, masked, keep_row,
-                                         contrib, P, seg_marker=seg_marker)
+                                         contrib, P, seg_marker=seg_marker,
+                                         fx_bits=fx_bits)
     else:
         part, part_nseg = _reduce_per_pk(config, pk_safe, masked, keep_row,
-                                         None, P, seg_marker=seg_marker)
+                                         None, P, seg_marker=seg_marker,
+                                         fx_bits=fx_bits)
 
     qrows = (_qrows(config, spk, svalues, keep_row)
              if config.percentiles else None)
@@ -756,14 +759,28 @@ def _partials(config: FusedConfig, num_partitions: int, pid, pk, values,
 
 
 # Fixed-point value accumulation: quantization grid (2^23 steps over the
-# clip bound), lane width and count. 7-bit lanes keep every int32 lane
-# accumulator exact for up to 2^24 rows (2^24 * 127 < 2^31); four lanes
-# span the 25-bit offset-shifted payload.
+# clip bound) split into integer lanes whose int32 segment sums stay
+# EXACT. The lane width adapts to the (global) row count: a lane of
+# ``bits`` bits accumulates up to 2^31/(2^bits - 1) rows exactly, so
+# small datasets ride two wide 12-bit lanes (narrower scatter payload)
+# and huge ones six 4-bit lanes (capacity 2^27 rows across the mesh).
 _FX_STEPS = 1 << 23
 _FX_OFFSET = 1 << 23
-_FX_LANE_BITS = 7
-_FX_LANES = 4
-_FX_MAX_ROWS = 1 << 24
+_FX_PAYLOAD_BITS = 24  # offset-shifted u fits 24 bits (u <= 2^24 - 2)
+
+
+def _fx_plan(n_rows_total: int) -> Tuple[int, int]:
+    """(lane_bits, n_lanes) for a pipeline with ``n_rows_total`` rows
+    across all devices — the cross-device psum adds per-shard lane sums,
+    so capacity is a GLOBAL row bound."""
+    bits = 12
+    while bits > 4 and n_rows_total * ((1 << bits) - 1) >= (1 << 31):
+        bits -= 1
+    if n_rows_total * ((1 << bits) - 1) >= (1 << 31):
+        raise NotImplementedError(
+            f"fixed-point value lanes support up to 2^27 rows per "
+            f"pipeline (got {n_rows_total}); split the input")
+    return bits, -(-_FX_PAYLOAD_BITS // bits)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -798,7 +815,8 @@ def _fixedpoint_layout(config: FusedConfig) -> List[_FxSpec]:
 
 
 def _reduce_per_pk(config: FusedConfig, pk_safe, masked, keep_row,
-                   per_partition_sum_contrib, P, seg_marker=None):
+                   per_partition_sum_contrib, P, seg_marker=None,
+                   fx_bits: int = 7):
     """The fused shuffle 3: per-pk accumulator columns straight from row
     space, returned as (columns dict, privacy-id-count column).
 
@@ -832,10 +850,18 @@ def _reduce_per_pk(config: FusedConfig, pk_safe, masked, keep_row,
         int_cols.append(seg_marker.astype(jnp.int32))
 
     layout = _fixedpoint_layout(config)
-    if layout and pk_safe.shape[0] > _FX_MAX_ROWS:
+    n_lanes = -(-_FX_PAYLOAD_BITS // fx_bits)
+    if layout and (pk_safe.shape[0] // 2) * ((1 << fx_bits) - 1) >= (
+            1 << 31):
+        # Loud trace-time guard for direct kernel callers: lane sums past
+        # int32 capacity would wrap silently. The kernel only sees the
+        # PADDED shape (< 2x the real rows, which are what consume
+        # capacity — padding is masked to zero), hence the factor-2
+        # allowance; _run_fused_kernel sizes fx_bits from the real global
+        # row count, so the engine path never trips this.
         raise NotImplementedError(
-            f"fixed-point lanes support up to {_FX_MAX_ROWS} rows per "
-            "device; shard the rows over a mesh")
+            f"{pk_safe.shape[0]} (padded) rows overflow {fx_bits}-bit "
+            "fixed-point lanes; pass a smaller fx_bits (see _fx_plan)")
     for spec in layout:
         if spec.name == "sum":  # per-partition-bound mode
             y = per_partition_sum_contrib
@@ -850,11 +876,15 @@ def _reduce_per_pk(config: FusedConfig, pk_safe, masked, keep_row,
                                                     config.max_value)
             y = (masked - middle) * (masked - middle)
             mask = keep_row
-        q = jnp.round(y * spec.scale).astype(jnp.int32)
+        # Clamp after rounding: f32 rounding of y*scale at the clip
+        # boundary can land one step past ±(2^23 - 1), which would need a
+        # 25th payload bit; the clamp costs one grid step of accuracy at
+        # the exact boundary and keeps u <= 2^24 - 2 in 24 bits.
+        q = jnp.clip(jnp.round(y * spec.scale), -(_FX_STEPS - 1),
+                     _FX_STEPS - 1).astype(jnp.int32)
         u = jnp.where(mask, q + (_FX_OFFSET if spec.signed else 0), 0)
-        for k in range(_FX_LANES):
-            int_cols.append((u >> (k * _FX_LANE_BITS)) &
-                            ((1 << _FX_LANE_BITS) - 1))
+        for k in range(n_lanes):
+            int_cols.append((u >> (k * fx_bits)) & ((1 << fx_bits) - 1))
             lane_names.append(f"{spec.name}_fx{k}")
 
     if len(int_cols) == 1:
@@ -879,16 +909,17 @@ def _reduce_per_pk(config: FusedConfig, pk_safe, masked, keep_row,
     return part, nseg
 
 
-def _fold_fixedpoint(config: FusedConfig, part64) -> None:
+def _fold_fixedpoint(config: FusedConfig, part64, fx_bits: int) -> None:
     """Reassembles the fixed-point lane columns into float64 values
-    (mutates ``part64``): value = (sum of lanes * 2^(7k) - entries *
+    (mutates ``part64``): value = (sum of lanes * 2^(bits*k) - entries *
     offset) / scale. ``entries`` (the per-partition count of contributing
     rows/segments) is exact int, so the offset removal is exact."""
+    n_lanes = -(-_FX_PAYLOAD_BITS // fx_bits)
     for spec in _fixedpoint_layout(config):
         total = np.zeros_like(part64[spec.count_col], dtype=np.float64)
-        for k in range(_FX_LANES):
+        for k in range(n_lanes):
             total += part64.pop(f"{spec.name}_fx{k}").astype(
-                np.float64) * float(1 << (k * _FX_LANE_BITS))
+                np.float64) * float(1 << (k * fx_bits))
         if spec.signed:
             total -= part64[spec.count_col].astype(np.float64) * _FX_OFFSET
         part64[spec.name] = total / spec.scale
@@ -1585,7 +1616,7 @@ class LazyFusedResult:
                 config, 1.0, 1e-9, None)
 
         t1 = _time.perf_counter()
-        keep_pk, raw = _run_fused_kernel(
+        keep_pk, raw, fx_bits = _run_fused_kernel(
             config, encoded, scales, keep_table, thr, s_scale, min_count,
             rows_per_uid, self._rng_seed, self._mesh)
 
@@ -1657,7 +1688,7 @@ class LazyFusedResult:
                 v.astype(np.float64)) for k, v in fetched.items()
         }
         # Reassemble fixed-point value lanes into float64 columns.
-        _fold_fixedpoint(config, part64)
+        _fold_fixedpoint(config, part64, fx_bits)
         rng = (np.random.default_rng(self._rng_seed)
                if self._rng_seed is not None else None)
         metric_arrays = _host_release(config, self._specs, part64,
@@ -1708,19 +1739,27 @@ def _run_fused_kernel(config: FusedConfig, encoded: EncodedData, scales,
     seed = (rng_seed if rng_seed is not None else
             int(noise_ops._host_rng.integers(0, 2**31 - 1)))
     key = jax.random.PRNGKey(seed)
+    # Lane plan from the GLOBAL row count (the mesh's cross-device psum
+    # adds per-shard lane sums, so capacity is a global bound; padding
+    # rows are masked to zero and never consume capacity); the same value
+    # drives the host-side lane fold.
+    fx_bits, _ = _fx_plan(max(encoded.n_rows, 1))
     if mesh is not None:
         from pipelinedp_tpu.parallel import sharded_fused_aggregate
-        return sharded_fused_aggregate(
+        keep_pk, raw = sharded_fused_aggregate(
             mesh, config, P_pad, encoded.pid, encoded.pk,
             encoded.values if config.needs_values else None,
             np.ones(encoded.n_rows, bool), scales, keep_table, thr,
-            s_scale, min_count, rows_per_uid, key)
+            s_scale, min_count, rows_per_uid, key, fx_bits)
+        return keep_pk, raw, fx_bits
     pid, pk, values, valid = pad_and_put(encoded, config.vector_size,
                                          with_values=config.needs_values)
-    return fused_aggregate_kernel(
+    keep_pk, raw = fused_aggregate_kernel(
         config, P_pad, pid, pk, values, valid, jnp.asarray(scales),
         jnp.asarray(keep_table), jnp.float32(thr), jnp.float32(s_scale),
-        jnp.float32(min_count), jnp.float32(rows_per_uid), key)
+        jnp.float32(min_count), jnp.float32(rows_per_uid), key,
+        fx_bits=fx_bits)
+    return keep_pk, raw, fx_bits
 
 
 class LazySelectResult:
@@ -1758,7 +1797,7 @@ class LazySelectResult:
             return []
         keep_table, thr, s_scale, min_count = selection_inputs(
             config, self._spec.eps, self._spec.delta, params.pre_threshold)
-        keep_pk, _ = _run_fused_kernel(
+        keep_pk, _, _ = _run_fused_kernel(
             config, encoded, np.zeros(0, np.float32), keep_table, thr,
             s_scale, min_count, 1.0, self._rng_seed, self._mesh)
         keep_np = np.asarray(keep_pk)[:P]

@@ -932,3 +932,25 @@ class TestFixedPointAccumulation:
         assert got.sum == pytest.approx(7.25 * n, rel=1e-7)
         assert got.mean == pytest.approx(7.25, abs=1e-6)
         assert got.variance == pytest.approx(0.0, abs=1e-4)
+
+
+class TestAdaptiveLanePlan:
+    """The fixed-point lane width adapts to the global row count: small
+    datasets ride 2 wide lanes, huge ones 6 narrow lanes; every plan's
+    int32 lane accumulators stay exact (n * (2^bits - 1) < 2^31)."""
+
+    @pytest.mark.parametrize("n,bits,lanes", [
+        (1 << 10, 12, 2), (1 << 19, 12, 2), (1 << 20, 11, 3),
+        (1 << 23, 8, 3), (1 << 24, 7, 4), (1 << 26, 5, 5),
+        (1 << 27, 4, 6),
+    ])
+    def test_plan(self, n, bits, lanes):
+        from pipelinedp_tpu import jax_engine as je
+        got_bits, got_lanes = je._fx_plan(n)
+        assert (got_bits, got_lanes) == (bits, lanes)
+        assert n * ((1 << got_bits) - 1) < (1 << 31)
+
+    def test_beyond_capacity_raises(self):
+        from pipelinedp_tpu import jax_engine as je
+        with pytest.raises(NotImplementedError, match="2\\^27"):
+            je._fx_plan(1 << 28)
