@@ -130,6 +130,11 @@ def _convert_utility_analysis_to_tune_result(
             am.count_metrics.absolute_rmse()
             for am in utility_analysis_result
         ]
+    elif metric == Metrics.SUM:
+        rmse = [
+            am.sum_metrics.absolute_rmse()
+            for am in utility_analysis_result
+        ]
     else:
         rmse = [
             am.privacy_id_count_metrics.absolute_rmse()
@@ -181,10 +186,32 @@ def _check_tune_args(options: TuneOptions):
     if len(metrics_list) != 1:
         raise NotImplementedError(
             f"Tuning supports only one metric, but {metrics_list} given.")
-    if metrics_list[0] not in [Metrics.COUNT, Metrics.PRIVACY_ID_COUNT]:
+    if metrics_list[0] not in [Metrics.COUNT, Metrics.PRIVACY_ID_COUNT,
+                               Metrics.SUM]:
         raise NotImplementedError(
-            "Tuning is supported only for COUNT and PRIVACY_ID_COUNT, "
-            f"but {metrics_list[0]} given.")
+            "Tuning is supported only for COUNT, PRIVACY_ID_COUNT and "
+            f"SUM, but {metrics_list[0]} given.")
+    if metrics_list[0] == Metrics.SUM:
+        # Exceeds the reference (its tuner rejects SUM outright,
+        # reference parameter_tuning.py:255-270): the L0 bound is tuned
+        # from the contribution histograms; the per-partition sum clip
+        # bounds themselves are not tunable (no value histograms) and
+        # must be supplied.
+        p = options.aggregate_params
+        if (p.min_sum_per_partition is None or
+                p.max_sum_per_partition is None):
+            raise ValueError(
+                "Tuning SUM requires min/max_sum_per_partition on the "
+                "aggregate params (the clip bounds are not tuned).")
+        to_tune = options.parameters_to_tune
+        if (not to_tune.max_partitions_contributed or
+                to_tune.min_sum_per_partition or
+                to_tune.max_sum_per_partition):
+            raise NotImplementedError(
+                "For SUM only max_partitions_contributed is tunable "
+                "(linf does not enter the per-partition-sum clip model, "
+                "and there are no value histograms to derive clip-bound "
+                "candidates from).")
     if options.function_to_minimize != MinimizingFunction.ABSOLUTE_ERROR:
         raise NotImplementedError(
             f"Only {MinimizingFunction.ABSOLUTE_ERROR} is implemented.")

@@ -1800,9 +1800,17 @@ class LazySelectResult:
         keep_pk, _, _ = _run_fused_kernel(
             config, encoded, np.zeros(0, np.float32), keep_table, thr,
             s_scale, min_count, 1.0, self._rng_seed, self._mesh)
-        keep_np = np.asarray(keep_pk)[:P]
         vocab = encoded.pk_vocab
-        return [vocab[i] for i in np.flatnonzero(keep_np)]
+        # Same packed compact fetch as the aggregation path: kept count
+        # + kept indices in one small transfer instead of the full
+        # [P] keep vector (selection typically keeps a tiny fraction).
+        cap = min(P, _COMPACT_FETCH_CAP)
+        packed = np.asarray(_compact_fetch_kernel(keep_pk, (), P, cap))
+        n_keep = int(packed[0, 0])
+        if n_keep > cap:
+            keep_np = np.asarray(keep_pk)[:P]
+            return [vocab[i] for i in np.flatnonzero(keep_np)]
+        return [vocab[i] for i in packed[1, :n_keep].tolist()]
 
 
 def build_fused_select_partitions(col, params, data_extractors,

@@ -388,20 +388,69 @@ class TestTune:
         assert len(result.utility_analysis_results) == n_configs
         assert 0 <= result.index_best < n_configs
 
-    def test_tune_rejects_unsupported(self):
-        options_kwargs = dict(
-            epsilon=1.0, delta=1e-5,
+    def test_tune_sum(self):
+        # Exceeds the reference (its tuner rejects SUM outright,
+        # reference parameter_tuning.py:255-270): the L0 bound tunes for
+        # SUM under supplied per-partition clip bounds, on both planes.
+        from pipelinedp_tpu.backends import JaxBackend
+        rng = np.random.default_rng(1)
+        data = []
+        for u in range(150):
+            # A wide L0 spread (heavy tail) so the histogram quantiles
+            # yield several distinct candidates.
+            n_parts = 1 + min(int(rng.pareto(1.0) * 3), 40)
+            for pk in rng.choice(50, n_parts, replace=False):
+                data.append((u, int(pk), float(rng.uniform(0, 5))))
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.SUM], max_partitions_contributed=1,
+            max_contributions_per_partition=1,
+            min_sum_per_partition=0.0, max_sum_per_partition=10.0)
+        options = analysis.TuneOptions(
+            epsilon=1.0, delta=1e-5, aggregate_params=params,
             function_to_minimize=analysis.MinimizingFunction.ABSOLUTE_ERROR,
             parameters_to_tune=analysis.ParametersToTune(
                 max_partitions_contributed=True))
+        hist = list(analysis.compute_dataset_histograms(
+            data, extractors(), pdp.LocalBackend()))[0]
+        for backend in (pdp.LocalBackend(), JaxBackend()):
+            result = list(analysis.tune(data, backend, hist, options,
+                                        extractors()))[0]
+            n_configs = result.utility_analysis_parameters.size
+            assert n_configs > 1
+            assert 0 <= result.index_best < n_configs
+            best = result.utility_analysis_results[result.index_best]
+            assert best.sum_metrics is not None
+
+    def test_tune_sum_requires_clip_bounds(self):
         params = pdp.AggregateParams(
             metrics=[pdp.Metrics.SUM], max_partitions_contributed=1,
             max_contributions_per_partition=1, min_value=0.0,
             max_value=1.0)
+        with pytest.raises(ValueError, match="min/max_sum_per_partition"):
+            analysis.tune([1], pdp.LocalBackend(), None,
+                          analysis.TuneOptions(
+                              epsilon=1.0, delta=1e-5,
+                              aggregate_params=params,
+                              function_to_minimize=(
+                                  analysis.MinimizingFunction.ABSOLUTE_ERROR),
+                              parameters_to_tune=analysis.ParametersToTune(
+                                  max_partitions_contributed=True)),
+                          extractors())
+
+    def test_tune_rejects_unsupported(self):
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.VECTOR_SUM], max_partitions_contributed=1,
+            max_contributions_per_partition=1, vector_size=2,
+            vector_max_norm=1.0, vector_norm_kind=pdp.NormKind.L2)
         with pytest.raises(NotImplementedError):
             analysis.tune([1], pdp.LocalBackend(), None,
-                          analysis.TuneOptions(aggregate_params=params,
-                                               **options_kwargs),
+                          analysis.TuneOptions(
+                              epsilon=1.0, delta=1e-5,
+                              aggregate_params=params,
+                              function_to_minimize=(
+                                  analysis.MinimizingFunction.ABSOLUTE_ERROR),
+                              parameters_to_tune=analysis.ParametersToTune(
+                                  max_partitions_contributed=True)),
                           extractors())
 
 
