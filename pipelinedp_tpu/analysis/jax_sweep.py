@@ -305,17 +305,22 @@ def _keep_probability(strategy, mu, var, m3, table, thr, scale, is_tg,
     # --- windowed refined normal (small sigma) ---
     offsets = jnp.arange(-_WINDOW, _WINDOW + 1, dtype=jnp.float32)
     centers = jnp.round(mu)[..., None] + offsets  # [P, Cc, W]
-    z_hi = (centers + 0.5 - mu[..., None]) / jnp.maximum(
-        sigma[..., None], 1e-30)
-    z_lo = z_hi - 1.0 / jnp.maximum(sigma[..., None], 1e-30)
 
     def refined_cdf(z):
         return jnp.clip(
             _jnorm.cdf(z) + skew[..., None] * (1 - z * z) *
             _jnorm.pdf(z) / 6.0, 0.0, 1.0)
 
-    cdf_hi = refined_cdf(z_hi)
-    cdf_lo = refined_cdf(z_lo)
+    # Consecutive bins share an edge (z_lo[i] == z_hi[i-1]), so evaluate
+    # the refined CDF once on the W+1 edges and difference — the
+    # erf/pdf transcendentals are this window's dominant cost.
+    edge_offsets = jnp.arange(-_WINDOW - 1, _WINDOW + 1,
+                              dtype=jnp.float32)  # [W+1] left+right edges
+    z_edges = (jnp.round(mu)[..., None] + edge_offsets + 0.5 -
+               mu[..., None]) / jnp.maximum(sigma[..., None], 1e-30)
+    cdf_edges = refined_cdf(z_edges)
+    cdf_hi = cdf_edges[..., 1:]
+    cdf_lo = cdf_edges[..., :-1]
     # Edge bins absorb the tails so the pmf always sums to 1.
     pmf = cdf_hi - cdf_lo
     pmf = pmf.at[..., 0].set(cdf_hi[..., 0])
