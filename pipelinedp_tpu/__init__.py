@@ -50,6 +50,16 @@ from pipelinedp_tpu.report_generator import ExplainComputationReport
 try:
     from pipelinedp_tpu.pipeline_backend import BeamBackend
 except ImportError:  # apache_beam not installed
-    pass
+
+    class BeamBackend:  # type: ignore
+        """Placeholder kept for API parity with the reference (its
+        ``BeamBackend`` name exists regardless of whether beam is
+        installed): constructing it without apache_beam fails with a
+        clear error instead of an AttributeError on the package."""
+
+        def __init__(self, *args, **kwargs):
+            raise ImportError(
+                "apache_beam is required for BeamBackend; "
+                "`pip install apache-beam` (see contributing/Dockerfile)")
 
 __version__ = "0.1.0"
