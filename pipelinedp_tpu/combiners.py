@@ -340,9 +340,13 @@ class QuantileCombiner(Combiner):
         tree = self._create_empty_quantile_tree()
         tree.merge(accumulator)
         p = self._params.aggregate_params
+        # Total-cap mode maps to the concentration-safe (1, M) pair —
+        # the same calculus the fused plane's _noise_scales uses.
+        l0, linf = dp_computations.count_sensitivity_pair(
+            p.max_partitions_contributed,
+            p.max_contributions_per_partition, p.max_contributions)
         quantiles = tree.compute_quantiles(
-            self._params.eps, self._params.delta,
-            p.max_partitions_contributed, p.max_contributions_per_partition,
+            self._params.eps, self._params.delta, int(l0), int(linf),
             self._quantiles_to_compute, p.noise_kind)
         return dict(zip(self.metrics_names(), quantiles))
 

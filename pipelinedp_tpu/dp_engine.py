@@ -346,20 +346,26 @@ class DPEngine:
                                           None) is not None:
             # The reference declares this parameter end-to-end but its
             # engine rejects it (reference dp_engine.py:395-396); here the
-            # total-cap mode is implemented for the scalar metrics.
+            # total-cap mode is implemented for the scalar metrics and
+            # percentiles.
             if params.custom_combiners:
                 raise NotImplementedError(
                     "max_contributions is not supported with custom "
                     "combiners (combiners receive no (l0, linf) pair to "
                     "calibrate against)")
+            # (PERCENTILE runs under the total cap: the tree noises with
+            # the concentration-safe (1, M) sensitivity pair on both
+            # planes.)
             unsupported = [
                 m for m in (params.metrics or [])
-                if m.is_percentile or m.name == "VECTOR_SUM"
+                if m.name == "VECTOR_SUM"
             ]
             if unsupported:
                 raise NotImplementedError(
-                    f"max_contributions does not support {unsupported}; "
-                    "use (max_partitions_contributed, "
+                    f"max_contributions does not support {unsupported} "
+                    "(the vector norm-clip sensitivity model has no "
+                    "total-cap analogue); use "
+                    "(max_partitions_contributed, "
                     "max_contributions_per_partition)")
         if col is None or not col:
             raise ValueError("col must be non-empty")

@@ -144,8 +144,8 @@ _VALUE_METRICS = {"SUM", "MEAN", "VARIANCE", "VECTOR_SUM", "PERCENTILE"}
 def params_are_fusable(params: AggregateParams) -> bool:
     if params.custom_combiners:
         return False
-    # (Total-cap ``max_contributions`` bounding is fused too: the engine
-    # rejects PERCENTILE/VECTOR_SUM with it before dispatch, and in
+    # (Total-cap ``max_contributions`` bounding is fused too, including
+    # PERCENTILE: the engine rejects only VECTOR_SUM with it, and in
     # bounds-already-enforced mode no bounding runs anywhere.)
     for m in params.metrics:
         if m.is_percentile:

@@ -432,13 +432,16 @@ class TestMaxContributions:
         out = dict(result)
         assert set(out) == {"a", "b", "c"}  # 60 users: surely kept
 
-    def test_percentile_and_vector_sum_rejected(self):
+    def test_percentile_supported_vector_sum_rejected(self):
+        # PERCENTILE runs under the total cap since r3
+        # (TestMaxContributionsPercentile); VECTOR_SUM stays rejected.
         engine, _ = make_engine()
         with pytest.raises(NotImplementedError, match="max_contributions"):
             engine.aggregate(
                 dataset(), self._params(
-                    [pdp.Metrics.PERCENTILE(50)], m=3,
-                    min_value=0.0, max_value=1.0), extractors())
+                    [pdp.Metrics.VECTOR_SUM], m=3, vector_size=2,
+                    vector_max_norm=1.0,
+                    vector_norm_kind=pdp.NormKind.L2), extractors())
 
     def test_fused_plane_matches_local(self):
         from pipelinedp_tpu import jax_engine
@@ -548,3 +551,67 @@ class TestMaxContributions:
                                      custom_combiners=[CC()])
         with pytest.raises(NotImplementedError, match="custom"):
             engine.aggregate(dataset(), params, extractors())
+
+
+class TestMaxContributionsPercentile:
+    """Total-cap bounding now covers PERCENTILE on both planes (the
+    reference rejects max_contributions outright; round 2 supported the
+    scalar metrics): the tree noises with the concentration-safe (1, M)
+    sensitivity pair."""
+
+    def test_percentile_total_cap_parity(self):
+        from pipelinedp_tpu.backends import JaxBackend
+        from pipelinedp_tpu.ops import noise as noise_ops
+        rng = np.random.default_rng(0)
+        # Caps never bind (each user has 3 rows, cap 10): both planes
+        # must agree with tiny noise.
+        data = [(u, "a", float(rng.uniform(0, 100)))
+                for u in range(400) for _ in range(3)]
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.PERCENTILE(50), pdp.Metrics.PERCENTILE(90)],
+            max_contributions=10, min_value=0.0, max_value=100.0)
+        outs = []
+        for backend in (pdp.LocalBackend(), JaxBackend(rng_seed=5)):
+            noise_ops.seed_host_rng(0)
+            acc = pdp.NaiveBudgetAccountant(total_epsilon=1e5,
+                                            total_delta=1e-10)
+            engine = pdp.DPEngine(acc, backend)
+            res = engine.aggregate(data, params, extractors())
+            acc.compute_budgets()
+            outs.append(dict(res)["a"])
+        local, fused = outs
+        assert fused.percentile_50 == pytest.approx(local.percentile_50,
+                                                    abs=1.5)
+        assert fused.percentile_90 == pytest.approx(local.percentile_90,
+                                                    abs=1.5)
+        assert local.percentile_50 == pytest.approx(50.0, abs=5.0)
+
+    def test_binding_cap_limits_one_users_influence(self):
+        from pipelinedp_tpu.backends import JaxBackend
+        from pipelinedp_tpu.ops import noise as noise_ops
+        # 500 regular users at low values + one whale with 5000 rows at
+        # 100.0 under cap M=2: the whale contributes at most 2 rows, so
+        # the median stays near the regular population's.
+        data = ([(u, "a", 10.0) for u in range(500)] +
+                [(9999, "a", 100.0)] * 5000)
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.PERCENTILE(50)], max_contributions=2,
+            min_value=0.0, max_value=100.0)
+        noise_ops.seed_host_rng(0)
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=1e5,
+                                        total_delta=1e-10)
+        engine = pdp.DPEngine(acc, JaxBackend(rng_seed=7))
+        res = engine.aggregate(data, params, extractors())
+        acc.compute_budgets()
+        assert dict(res)["a"].percentile_50 == pytest.approx(10.0, abs=5.0)
+
+    def test_vector_sum_still_rejected(self):
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.VECTOR_SUM], max_contributions=2,
+            vector_size=2, vector_max_norm=1.0,
+            vector_norm_kind=pdp.NormKind.L2)
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                        total_delta=1e-6)
+        engine = pdp.DPEngine(acc, pdp.LocalBackend())
+        with pytest.raises(NotImplementedError, match="VECTOR_SUM"):
+            engine.aggregate([(0, "a", [1.0, 0.0])], params, extractors())
