@@ -604,14 +604,3 @@ class TestMaxContributionsPercentile:
         res = engine.aggregate(data, params, extractors())
         acc.compute_budgets()
         assert dict(res)["a"].percentile_50 == pytest.approx(10.0, abs=5.0)
-
-    def test_vector_sum_still_rejected(self):
-        params = pdp.AggregateParams(
-            metrics=[pdp.Metrics.VECTOR_SUM], max_contributions=2,
-            vector_size=2, vector_max_norm=1.0,
-            vector_norm_kind=pdp.NormKind.L2)
-        acc = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
-                                        total_delta=1e-6)
-        engine = pdp.DPEngine(acc, pdp.LocalBackend())
-        with pytest.raises(NotImplementedError, match="VECTOR_SUM"):
-            engine.aggregate([(0, "a", [1.0, 0.0])], params, extractors())
