@@ -10,6 +10,11 @@ Usage:
   python examples/movie_view_ratings.py                 # fused TPU plane
   python examples/movie_view_ratings.py --backend local # generator plane
   python examples/movie_view_ratings.py --public        # public partitions
+  python examples/movie_view_ratings.py --vector        # per-movie rating
+                                                        # histogram (one-hot
+                                                        # VECTOR_SUM)
+  python examples/movie_view_ratings.py --bounds-enforced  # caller-bounded
+                                                        # data, no privacy ids
 """
 
 import argparse
@@ -37,6 +42,13 @@ def main():
                         help="use public partitions (all movie ids)")
     parser.add_argument("--rows", type=int, default=500_000)
     parser.add_argument("--percentiles", action="store_true")
+    parser.add_argument("--vector", action="store_true",
+                        help="VECTOR_SUM demo: one-hot rating histogram "
+                        "per movie (reference run_all_frameworks' vector "
+                        "metrics demo)")
+    parser.add_argument("--bounds-enforced", action="store_true",
+                        help="contribution_bounds_already_enforced: no "
+                        "privacy ids, the caller vouches for bounding")
     args = parser.parse_args()
 
     import pipelinedp_tpu as pdp
@@ -50,9 +62,53 @@ def main():
         backend = pdp.LocalBackend()
 
     data = generate_data(n_rows=args.rows)
-    metrics = [pdp.Metrics.COUNT, pdp.Metrics.SUM, pdp.Metrics.MEAN]
-    if args.percentiles:
-        metrics += [pdp.Metrics.PERCENTILE(50), pdp.Metrics.PERCENTILE(90)]
+    if args.vector and args.percentiles:
+        parser.error("--vector and --percentiles are mutually exclusive")
+    if args.vector:
+        # One-hot the 1..5 star ratings: VECTOR_SUM then releases a DP
+        # per-movie rating histogram (reference
+        # run_all_frameworks.py:91-97,189-192).
+        one_hot = np.eye(5)[data.values.astype(int) - 1]
+        data = pdp.ArrayDataset(privacy_ids=data.privacy_ids,
+                                partition_keys=data.partition_keys,
+                                values=one_hot)
+        metrics = [pdp.Metrics.VECTOR_SUM]
+        # The norm clip applies to the whole partition's accumulated
+        # vector (reference add_noise_vector semantics), so it is set
+        # far above any movie's view count — the per-coordinate noise,
+        # calibrated on the l0/linf contribution bounds, provides the DP.
+        extra = dict(vector_size=5, vector_max_norm=1e6,
+                     vector_norm_kind=pdp.NormKind.L1)
+    else:
+        metrics = [pdp.Metrics.COUNT, pdp.Metrics.SUM, pdp.Metrics.MEAN]
+        if args.percentiles:
+            metrics += [pdp.Metrics.PERCENTILE(50),
+                        pdp.Metrics.PERCENTILE(90)]
+        extra = dict(min_value=1.0, max_value=5.0)
+    if args.bounds_enforced:
+        # The caller vouches the data is already contribution-bounded —
+        # so actually BOUND it first (cap each user at 4 movies x 2
+        # ratings, the declared l0/linf), then drop the privacy ids;
+        # selection works from conservative row-count estimates.
+        order = np.lexsort((data.partition_keys, data.privacy_ids))
+        pid_s = data.privacy_ids[order]
+        pk_s = data.partition_keys[order]
+        val_s = data.values[order]
+        idx = np.arange(len(pid_s))
+        new_pair = np.r_[True, (pid_s[1:] != pid_s[:-1]) |
+                         (pk_s[1:] != pk_s[:-1])]
+        pair_id = np.cumsum(new_pair) - 1
+        rank_in_pair = idx - np.maximum.accumulate(
+            np.where(new_pair, idx, 0))
+        new_user = np.r_[True, pid_s[1:] != pid_s[:-1]]
+        first_pair_of_user = pair_id[np.maximum.accumulate(
+            np.where(new_user, idx, 0))]
+        pair_rank_in_user = pair_id - first_pair_of_user
+        keep = (rank_in_pair < 2) & (pair_rank_in_user < 4)
+        data = pdp.ArrayDataset(privacy_ids=None,
+                                partition_keys=pk_s[keep],
+                                values=val_s[keep])
+        extra["contribution_bounds_already_enforced"] = True
 
     accountant = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
                                            total_delta=1e-6)
@@ -60,7 +116,7 @@ def main():
     params = pdp.AggregateParams(
         metrics=metrics, noise_kind=pdp.NoiseKind.LAPLACE,
         max_partitions_contributed=4, max_contributions_per_partition=2,
-        min_value=1.0, max_value=5.0)
+        **extra)
     report = pdp.ExplainComputationReport()
     public = list(range(2_000)) if args.public else None
     result = engine.aggregate(data, params, pdp.DataExtractors(),
@@ -74,8 +130,12 @@ def main():
     print(f"{len(rows)} movies released in {dt:.2f}s "
           f"({args.rows / dt:,.0f} rows/s) on backend={args.backend}")
     for movie, m in sorted(rows)[:5]:
-        print(f"  movie {movie}: count={m.count:.0f} sum={m.sum:.0f} "
-              f"mean={m.mean:.2f}")
+        if args.vector:
+            hist = ", ".join(f"{v:.0f}" for v in m.vector_sum)
+            print(f"  movie {movie}: stars 1..5 = [{hist}]")
+        else:
+            print(f"  movie {movie}: count={m.count:.0f} sum={m.sum:.0f} "
+                  f"mean={m.mean:.2f}")
     print()
     print(report.text())
 
