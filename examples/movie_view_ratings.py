@@ -61,9 +61,9 @@ def main():
     else:
         backend = pdp.LocalBackend()
 
-    data = generate_data(n_rows=args.rows)
     if args.vector and args.percentiles:
         parser.error("--vector and --percentiles are mutually exclusive")
+    data = generate_data(n_rows=args.rows)
     if args.vector:
         # One-hot the 1..5 star ratings: VECTOR_SUM then releases a DP
         # per-movie rating histogram (reference

@@ -820,8 +820,11 @@ def _reduce_per_pk(config: FusedConfig, pk_safe, masked, keep_row,
     """The fused shuffle 3: per-pk accumulator columns straight from row
     space, returned as (columns dict, privacy-id-count column).
 
-    Everything accumulates in int32, in ONE multi-feature segment_sum
-    (the scatter's addressing pass is shared; only the payload widens):
+    Everything accumulates in int32 — in ONE multi-feature segment_sum
+    up to 2^24 rows (the scatter's addressing pass is shared; only the
+    payload widens), and in independent per-column scatters beyond that
+    (XLA tile-pads a [N, C] operand's C dim to 128 lanes and materializes
+    a 21x remat copy at 2^25 rows):
 
     * counts + kept-segment markers directly — float32 addition saturates
       at 2^24 (1.0 + 16777216.0 == 16777216.0), silently under-counting
@@ -888,20 +891,28 @@ def _reduce_per_pk(config: FusedConfig, pk_safe, masked, keep_row,
             lane_names.append(f"{spec.name}_fx{k}")
 
     if len(int_cols) == 1:
-        ints = jax.ops.segment_sum(int_cols[0], pk_safe,
-                                   num_segments=P)[:, None]
+        ints = [jax.ops.segment_sum(int_cols[0], pk_safe, num_segments=P)]
+    elif pk_safe.shape[0] >= (1 << 25):
+        # Past 2^24 rows XLA materializes a tile-padded remat copy of the
+        # [N, C] stack (the C-sized dim pads to 128 lanes — a 21x, 16GB
+        # blowup at 2^25); independent per-column scatters keep every
+        # operand rank-1 and densely tiled.
+        ints = [jax.ops.segment_sum(c, pk_safe, num_segments=P)
+                for c in int_cols]
     else:
-        ints = jax.ops.segment_sum(jnp.stack(int_cols, axis=1), pk_safe,
-                                   num_segments=P)
-    part = {"count": ints[:, 0]}
+        # One multi-feature scatter: the addressing pass is shared.
+        stacked = jax.ops.segment_sum(jnp.stack(int_cols, axis=1),
+                                      pk_safe, num_segments=P)
+        ints = [stacked[:, i] for i in range(len(int_cols))]
+    part = {"count": ints[0]}
     col = 1
     if seg_marker is not None:
-        nseg = ints[:, col]
+        nseg = ints[col]
         col += 1
     else:
         nseg = None
     for i, name in enumerate(lane_names):
-        part[name] = ints[:, col + i]
+        part[name] = ints[col + i]
 
     if "VECTOR_SUM" in names:
         part["vector_sum"] = jax.ops.segment_sum(masked, pk_safe,

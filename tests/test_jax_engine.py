@@ -954,3 +954,25 @@ class TestAdaptiveLanePlan:
         from pipelinedp_tpu import jax_engine as je
         with pytest.raises(NotImplementedError, match="2\\^27"):
             je._fx_plan(1 << 28)
+
+
+class TestCompactFetchFallback:
+    """Private selection keeping more partitions than the packed-fetch
+    cap (8192) must fall back to the full fetch and still release every
+    kept partition."""
+
+    def test_many_kept_partitions(self):
+        n_parts = 10_000
+        users_per = 3
+        pid = np.arange(n_parts * users_per)  # every row its own user
+        pk = np.repeat(np.arange(n_parts), users_per)
+        ds = pdp.ArrayDataset(privacy_ids=pid, partition_keys=pk,
+                              values=None)
+        params = count_params(max_partitions_contributed=1,
+                              max_contributions_per_partition=1)
+        fused = run(JaxBackend(rng_seed=0), ds, params, eps=1e6,
+                    delta=1e-2, ext=pdp.DataExtractors())
+        # With eps huge every 3-user partition passes selection.
+        assert len(fused) == n_parts
+        assert fused[0].count == pytest.approx(3, abs=0.3)
+        assert fused[n_parts - 1].count == pytest.approx(3, abs=0.3)
