@@ -976,3 +976,32 @@ class TestCompactFetchFallback:
         assert len(fused) == n_parts
         assert fused[0].count == pytest.approx(3, abs=0.3)
         assert fused[n_parts - 1].count == pytest.approx(3, abs=0.3)
+
+
+class TestLanePlanBoundary:
+    """End-to-end coverage of the non-default lane plans: row counts just
+    past a plan boundary switch the kernel to narrower lanes, whose
+    released sums must still match the exact float64 oracle within the
+    quantization bound (n * bound / 2^23)."""
+
+    @pytest.mark.parametrize("n", [(1 << 19) - 8, 525_000])
+    def test_sum_across_plan_boundary(self, n):
+        from pipelinedp_tpu import jax_engine as je
+        bits, lanes = je._fx_plan(n)
+        assert (bits, lanes) == ((12, 2) if n < 524_420 else (11, 3))
+        rng = np.random.default_rng(n)
+        vals = rng.uniform(0.0, 10.0, n)
+        ds = pdp.ArrayDataset(privacy_ids=np.arange(n) % (1 << 18),
+                              partition_keys=np.zeros(n, np.int64),
+                              values=vals)
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.SUM], max_partitions_contributed=4,
+            max_contributions_per_partition=4, min_value=0.0,
+            max_value=10.0)
+        fused = run(JaxBackend(rng_seed=0), ds, params, eps=1e12,
+                    delta=1e-2, ext=pdp.DataExtractors())
+        exact = float(np.sum(vals))
+        # Quantization bound: every row rounds on a bound/2^23 grid (the
+        # inputs also pass through float32 encode, same error scale).
+        bound = n * (10.0 / (1 << 23)) + n * 10.0 * 2**-24 + 1.0
+        assert abs(fused[0].sum - exact) < bound
