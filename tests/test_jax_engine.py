@@ -988,7 +988,7 @@ class TestLanePlanBoundary:
     def test_sum_across_plan_boundary(self, n):
         from pipelinedp_tpu import jax_engine as je
         bits, lanes = je._fx_plan(n)
-        assert (bits, lanes) == ((12, 2) if n < 524_420 else (11, 3))
+        assert (bits, lanes) == ((12, 2) if n < 524_417 else (11, 3))
         rng = np.random.default_rng(n)
         vals = rng.uniform(0.0, 10.0, n)
         ds = pdp.ArrayDataset(privacy_ids=np.arange(n) % (1 << 18),
