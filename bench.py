@@ -174,10 +174,14 @@ def bench_analysis_sweep(n_rows, n_users, n_partitions, n_configs):
 
     # The pure-Python baseline is far too slow for the full sweep: measure
     # its unit rate (configs x rows per second) on a small slice and scale.
+    # Best-of-3 (the per-config baselines use best-of-5; the sweep's
+    # host leg is slower per run): a single host measurement swings with
+    # CPU load and distorts the ratio.
     base_rows = min(n_rows, 20_000)
     base_cfg, base_options = sweep_options(min(n_configs, 8))
-    _, host_dt = run(pdp.LocalBackend(), slice_dataset(ds, base_rows),
-                     base_options)
+    host_dt = min(
+        run(pdp.LocalBackend(), slice_dataset(ds, base_rows),
+            base_options)[1] for _ in range(3))
     host_unit_rate = base_cfg * base_rows / host_dt
 
     n_eff, options = sweep_options(n_configs)
