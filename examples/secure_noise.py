@@ -7,7 +7,10 @@ A textbook float Laplace release leaks information through the noise
 sample's low-order mantissa bits (Mironov, CCS 2012). With secure host
 noise enabled, integer queries (counts) release exact two-sided-geometric
 noise — no float bits at all — and float queries release through the
-snapping mechanism, rounded to the power-of-two resolution Lambda.
+snapping mechanism, rounded to the power-of-two resolution Lambda. The
+Gaussian mechanism is hardened the same way: exact discrete Gaussian
+(Canonne–Kamath–Steinke) for counts, granularity-snapped discrete
+Gaussian for float queries.
 
 Usage: python examples/secure_noise.py
 """
@@ -53,6 +56,23 @@ def main():
             print(f"{pk:9d}  {m.count:23.1f}  {m.sum:21.3f}")
         print("\ncounts are exact integers (discrete Laplace); sums are "
               "multiples of the snapping resolution.")
+
+        # Same pipeline under the hardened Gaussian mechanism.
+        accountant = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                               total_delta=1e-6)
+        engine = pdp.DPEngine(accountant, pdp.LocalBackend())
+        gauss_params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            max_partitions_contributed=2,
+            max_contributions_per_partition=2,
+            min_value=0.0, max_value=10.0,
+            noise_kind=pdp.NoiseKind.GAUSSIAN)
+        result = engine.aggregate(rows, gauss_params, extractors)
+        accountant.compute_budgets()
+        print("\nGaussian: counts get exact discrete-Gaussian noise; "
+              "sums are granularity-snapped:")
+        for pk, m in sorted(result):
+            print(f"{pk:9d}  {m.count:23.1f}  {m.sum:21.3f}")
     finally:
         noise_ops.set_secure_host_noise(False)
 

@@ -162,6 +162,21 @@ def _noise_std(eps: float, delta: float, l0_sensitivity: float,
     raise ValueError("Noise kind must be either Laplace or Gaussian.")
 
 
+def _secure_release(value: ArrayLike, scale: float, int_fn, float_fn,
+                    shape) -> ArrayLike:
+    """Hardened release through the native samplers: exact integer noise
+    for integer queries (counts — no float noise bits at all), the
+    grid-snapped mechanism for real-valued ones. Shared by both noise
+    kinds (the native twin of the reference's PyDP secure mechanisms,
+    reference ``dp_computations.py:111-143``)."""
+    varr = np.asarray(value)
+    if varr.dtype.kind in "iu":
+        result = int_fn(varr, scale).astype(np.float64)
+    else:
+        result = float_fn(varr.astype(np.float64), scale)
+    return result if shape else float(result)
+
+
 def _add_random_noise(value: ArrayLike, eps: float, delta: float,
                       l0_sensitivity: float, linf_sensitivity: float,
                       noise_kind: NoiseKind,
@@ -169,28 +184,26 @@ def _add_random_noise(value: ArrayLike, eps: float, delta: float,
     """Adds calibrated noise; batched when ``value`` is an array
     (reference :146-176, but vectorized)."""
     shape = np.shape(value) or None
+    secure = noise_ops.secure_host_noise_enabled() and rng is None
     if noise_kind == NoiseKind.LAPLACE:
         scale = noise_ops.laplace_scale(
             eps, compute_l1_sensitivity(l0_sensitivity, linf_sensitivity))
-        if noise_ops.secure_host_noise_enabled() and rng is None:
-            # Hardened release from the native library: exact two-sided
-            # geometric noise for integer queries (counts — no float
-            # noise bits at all), the snapping mechanism (value + noise,
-            # snapped) otherwise.
+        if secure:
+            # Discrete Laplace for counts, Mironov snapping otherwise.
             from pipelinedp_tpu import native
-            varr = np.asarray(value)
-            if varr.dtype.kind in "iu":
-                result = native.discrete_laplace(varr, scale).astype(
-                    np.float64)
-            else:
-                result = native.snapping_laplace(
-                    varr.astype(np.float64), scale)
-            return result if shape else float(result)
+            return _secure_release(value, scale, native.discrete_laplace,
+                                   native.snapping_laplace, shape)
         noise = noise_ops.np_laplace(scale, shape=shape, rng=rng)
     elif noise_kind == NoiseKind.GAUSSIAN:
         sigma = noise_ops.gaussian_sigma(
             eps, delta, compute_l2_sensitivity(l0_sensitivity,
                                                linf_sensitivity))
+        if secure:
+            # Exact discrete Gaussian (CKS) for counts,
+            # granularity-snapped discrete Gaussian otherwise.
+            from pipelinedp_tpu import native
+            return _secure_release(value, sigma, native.discrete_gaussian,
+                                   native.secure_gaussian, shape)
         noise = noise_ops.np_gaussian(sigma, shape=shape, rng=rng)
     else:
         raise ValueError("Noise kind must be either Laplace or Gaussian.")

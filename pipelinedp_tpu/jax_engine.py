@@ -365,15 +365,26 @@ def pad_and_put(encoded: EncodedData, vector_size: Optional[int],
     return pid, pk, values, valid
 
 
-def _narrow_ids(arr):
+def _plane_spec(max_id: int) -> str:
+    """Byte-width tier for an id column: one policy for the single-batch
+    and streaming ship paths (streaming decides ONCE from the global max
+    so every batch shares a jit signature)."""
+    if max_id < (1 << 16):
+        return "u16"
+    if max_id < (1 << 24):
+        return "u8x3"
+    return "i32"
+
+
+def _narrow_ids(arr, spec: Optional[str] = None):
     """Minimal-byte-width host planes of a non-negative id column
-    (encode() guarantees non-negative ids)."""
-    if not arr.size:
-        return (arr,)
-    mx = int(arr.max())
-    if mx < (1 << 16):
+    (encode() guarantees non-negative ids). ``spec`` forces a tier
+    decided elsewhere (streaming's global-max decision)."""
+    if spec is None:
+        spec = _plane_spec(int(arr.max()) if arr.size else 0)
+    if spec == "u16":
         return (arr.astype(np.uint16),)
-    if mx < (1 << 24):
+    if spec == "u8x3":
         a32 = arr.astype(np.uint32)
         return (a32.astype(np.uint8), (a32 >> 8).astype(np.uint8),
                 (a32 >> 16).astype(np.uint8))
@@ -766,7 +777,7 @@ def _partials(config: FusedConfig, num_partitions: int, pid, pk, values,
 # and huge ones six 4-bit lanes (capacity 2^27 rows across the mesh).
 _FX_STEPS = 1 << 23
 _FX_OFFSET = 1 << 23
-_FX_PAYLOAD_BITS = 24  # offset-shifted u fits 24 bits (u <= 2^24 - 2)
+_FX_PAYLOAD_BITS = 24  # offset-shifted u fits 24 bits (u <= 2^24 - 1)
 
 
 def _fx_plan(n_rows_total: int) -> Tuple[int, int]:
@@ -779,7 +790,11 @@ def _fx_plan(n_rows_total: int) -> Tuple[int, int]:
     if n_rows_total * ((1 << bits) - 1) >= (1 << 31):
         raise NotImplementedError(
             f"fixed-point value lanes support up to 2^27 rows per "
-            f"pipeline (got {n_rows_total}); split the input")
+            f"BATCH (got {n_rows_total}). The engine streams larger "
+            "pipelines automatically (pipelinedp_tpu.streaming) unless "
+            "percentiles are requested (the quantile walk needs all of "
+            "a partition's rows in one batch) or a mesh is set; split "
+            "the input or drop the percentile metrics")
     return bits, -(-_FX_PAYLOAD_BITS // bits)
 
 
@@ -820,7 +835,8 @@ def _reduce_per_pk(config: FusedConfig, pk_safe, masked, keep_row,
     """The fused shuffle 3: per-pk accumulator columns straight from row
     space, returned as (columns dict, privacy-id-count column).
 
-    Everything accumulates in int32 — in ONE multi-feature segment_sum
+    Every scalar column accumulates in int32 (VECTOR_SUM is the one
+    exception — see below) — in ONE multi-feature segment_sum
     up to 2^24 rows (the scatter's addressing pass is shared; only the
     payload widens), and in independent per-column scatters beyond that
     (XLA tile-pads a [N, C] operand's C dim to 128 lanes and materializes
@@ -882,7 +898,7 @@ def _reduce_per_pk(config: FusedConfig, pk_safe, masked, keep_row,
         # Clamp after rounding: f32 rounding of y*scale at the clip
         # boundary can land one step past ±(2^23 - 1), which would need a
         # 25th payload bit; the clamp costs one grid step of accuracy at
-        # the exact boundary and keeps u <= 2^24 - 2 in 24 bits.
+        # the exact boundary and keeps u <= 2^24 - 1 in 24 bits.
         q = jnp.clip(jnp.round(y * spec.scale), -(_FX_STEPS - 1),
                      _FX_STEPS - 1).astype(jnp.int32)
         u = jnp.where(mask, q + (_FX_OFFSET if spec.signed else 0), 0)
@@ -915,6 +931,12 @@ def _reduce_per_pk(config: FusedConfig, pk_safe, masked, keep_row,
         part[name] = ints[col + i]
 
     if "VECTOR_SUM" in names:
+        # Vector coordinates accumulate in float32 (not fixed-point
+        # lanes): the [N, V] operand would need V*n_lanes scatter
+        # columns. The f32 drift/saturation hazard the lanes eliminate
+        # for scalars therefore still applies per coordinate past ~2^24
+        # equal contributions in one partition (documented in README
+        # "Scaling limits").
         part["vector_sum"] = jax.ops.segment_sum(masked, pk_safe,
                                                  num_segments=P)
     return part, nseg
@@ -1564,6 +1586,27 @@ def _compact_fetch_kernel(keep_pk, cols, num_partitions, cap):
     return jnp.stack([meta, sel.astype(jnp.int32)] + gathered)
 
 
+def _assemble_output(config: FusedConfig, vocab, metric_arrays, rel_sel,
+                     vocab_idx):
+    """Released metric columns -> [(partition_key, MetricsTuple)].
+    Column-wise conversion: one C-level tolist() per metric instead of a
+    Python float() call per (partition, metric)."""
+    fields = _metric_field_order(config)
+    columns = []
+    for f in fields:
+        arr = metric_arrays[f]
+        if arr.ndim == 1:
+            columns.append(arr[rel_sel].tolist())
+        else:
+            columns.append(list(arr[rel_sel, :]))
+    tuple_fields = tuple(fields)
+    return [
+        (vocab[i], _create_named_tuple_instance(
+            "MetricsTuple", tuple_fields, vals))
+        for i, vals in zip(vocab_idx.tolist(), zip(*columns))
+    ]
+
+
 class LazyFusedResult:
     """Iterable of (partition_key, MetricsTuple); runs the fused kernel on
     first iteration — after ``compute_budgets()``, honoring the two-phase
@@ -1627,6 +1670,33 @@ class LazyFusedResult:
                 config, 1.0, 1e-9, None)
 
         t1 = _time.perf_counter()
+        from pipelinedp_tpu import streaming
+        if streaming.should_stream(config, encoded.n_rows, self._mesh):
+            # Multi-batch ingest: the dataset exceeds one device batch.
+            # Partials accumulate on host (int64 / folded float64),
+            # selection runs once on device, release below as usual.
+            keep_np, part64, stream_stats = (
+                streaming.stream_partials_and_select(
+                    config, encoded, keep_table, thr, s_scale, min_count,
+                    rows_per_uid, self._rng_seed))
+            self.timings["device_s"] = _time.perf_counter() - t1
+            self.timings["stream_batches"] = stream_stats["n_batches"]
+            t_rel = _time.perf_counter()
+            part64 = {k: v[:P] for k, v in part64.items()}
+            rng = (np.random.default_rng(self._rng_seed)
+                   if self._rng_seed is not None else None)
+            metric_arrays = _host_release(config, self._specs, part64,
+                                          part64["privacy_id_count_raw"],
+                                          rng)
+            if self._public is not None:
+                rel_sel = vocab_idx = np.arange(P)
+            else:
+                rel_sel = vocab_idx = np.flatnonzero(keep_np[:P])
+            out = _assemble_output(config, encoded.pk_vocab,
+                                   metric_arrays, rel_sel, vocab_idx)
+            self.timings["host_decode_s"] = _time.perf_counter() - t_rel
+            return out
+
         keep_pk, raw, fx_bits = _run_fused_kernel(
             config, encoded, scales, keep_table, thr, s_scale, min_count,
             rows_per_uid, self._rng_seed, self._mesh)
@@ -1706,7 +1776,6 @@ class LazyFusedResult:
                                       part64["privacy_id_count_raw"], rng)
         for name in _percentile_field_names(config.percentiles):
             metric_arrays[name] = fetched[name]
-        fields = _metric_field_order(config)
 
         # Only materialize kept partitions (with private selection the kept
         # fraction can be tiny — never walk the full pk axis in Python).
@@ -1718,22 +1787,8 @@ class LazyFusedResult:
             vocab_idx = kept_idx
         else:
             rel_sel = vocab_idx = kept_idx
-        vocab = encoded.pk_vocab
-        # Column-wise conversion: one C-level tolist() per metric instead
-        # of a Python float() call per (partition, metric).
-        columns = []
-        for f in fields:
-            arr = metric_arrays[f]
-            if arr.ndim == 1:
-                columns.append(arr[rel_sel].tolist())
-            else:
-                columns.append(list(arr[rel_sel, :]))
-        tuple_fields = tuple(fields)
-        out = [
-            (vocab[i], _create_named_tuple_instance(
-                "MetricsTuple", tuple_fields, vals))
-            for i, vals in zip(vocab_idx.tolist(), zip(*columns))
-        ]
+        out = _assemble_output(config, encoded.pk_vocab, metric_arrays,
+                               rel_sel, vocab_idx)
         self.timings["host_decode_s"] = _time.perf_counter() - t_rel
         return out
 
@@ -1753,8 +1808,14 @@ def _run_fused_kernel(config: FusedConfig, encoded: EncodedData, scales,
     # Lane plan from the GLOBAL row count (the mesh's cross-device psum
     # adds per-shard lane sums, so capacity is a global bound; padding
     # rows are masked to zero and never consume capacity); the same value
-    # drives the host-side lane fold.
-    fx_bits, _ = _fx_plan(max(encoded.n_rows, 1))
+    # drives the host-side lane fold. Pipelines with no fixed-point value
+    # columns (COUNT/PRIVACY_ID_COUNT-only, PERCENTILE, VECTOR_SUM,
+    # select_partitions) skip the plan entirely — their int32 count
+    # columns are exact to 2^31 rows and must not inherit the lane cap.
+    if _fixedpoint_layout(config):
+        fx_bits, _ = _fx_plan(max(encoded.n_rows, 1))
+    else:
+        fx_bits = 12
     if mesh is not None:
         from pipelinedp_tpu.parallel import sharded_fused_aggregate
         keep_pk, raw = sharded_fused_aggregate(
@@ -1808,6 +1869,13 @@ class LazySelectResult:
             return []
         keep_table, thr, s_scale, min_count = selection_inputs(
             config, self._spec.eps, self._spec.delta, params.pre_threshold)
+        from pipelinedp_tpu import streaming
+        if streaming.should_stream(config, encoded.n_rows, self._mesh):
+            keep_np, _, _ = streaming.stream_partials_and_select(
+                config, encoded, keep_table, thr, s_scale, min_count,
+                1.0, self._rng_seed)
+            vocab = encoded.pk_vocab
+            return [vocab[i] for i in np.flatnonzero(keep_np[:P])]
         keep_pk, _, _ = _run_fused_kernel(
             config, encoded, np.zeros(0, np.float32), keep_table, thr,
             s_scale, min_count, 1.0, self._rng_seed, self._mesh)

@@ -11,6 +11,11 @@ against this; this package is the TPU framework's native twin:
   mechanism over a ChaCha20 CSPRNG,
 * ``discrete_laplace(counts, scale)`` — exact two-sided geometric noise
   for integer releases (no float noise bits at all),
+* ``discrete_gaussian(counts, sigma)`` — exact discrete-Gaussian noise
+  (Canonne–Kamath–Steinke sampler) for integer releases,
+* ``secure_gaussian(values, sigma, bound)`` — granularity-snapped
+  discrete-Gaussian release for real values (the Gaussian twin of the
+  snapping mechanism),
 * ``seed(n)`` / ``seed_from_os()`` — deterministic seeding for tests,
   OS entropy otherwise.
 
@@ -97,6 +102,14 @@ def _lib() -> ctypes.CDLL:
         lib.sn_discrete_laplace.argtypes = [
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
             ctypes.c_int64, ctypes.c_double]
+        lib.sn_discrete_gaussian.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_double]
+        lib.sn_discrete_gaussian.restype = ctypes.c_int32
+        lib.sn_secure_gaussian.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64, ctypes.c_double, ctypes.c_double]
+        lib.sn_secure_gaussian.restype = ctypes.c_double
         _LIB = lib
         return _LIB
 
@@ -177,6 +190,59 @@ def discrete_laplace(counts, scale: float) -> np.ndarray:
         flat.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         flat.size, float(scale))
+    return out.reshape(shape)
+
+
+def discrete_gaussian(counts, sigma: float) -> np.ndarray:
+    """Integer release: counts + exact discrete-Gaussian noise of
+    standard deviation ~``sigma`` (Canonne–Kamath–Steinke sampler) — no
+    floating-point noise bits. ``sigma`` must be in (0, 2^40)."""
+    if not 0 < sigma < 2.0**40:
+        raise ValueError("sigma must be in (0, 2^40)")
+    vals = np.asarray(counts, dtype=np.int64)
+    shape = vals.shape
+    flat = np.ascontiguousarray(vals).ravel()
+    out = np.empty_like(flat)
+    rc = _lib().sn_discrete_gaussian(
+        flat.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        flat.size, float(sigma))
+    if rc != 0:
+        raise ValueError(f"sn_discrete_gaussian rejected sigma={sigma}")
+    return out.reshape(shape)
+
+
+def secure_gaussian(values, sigma: float,
+                    bound: Optional[float] = None) -> np.ndarray:
+    """Hardened Gaussian release of ``values`` with noise std ``sigma``:
+    the value is snapped to a power-of-two granularity g (sized so
+    sigma/g is in (2^39, 2^40]) and g-scaled exact discrete-Gaussian
+    noise is added, so the release's support is the g-grid — the
+    Gaussian twin of :func:`snapping_laplace`, replacing the reference's
+    PyDP secure GaussianMechanism (reference
+    ``pipeline_dp/dp_computations.py:127-143``). Same default clamp
+    bound policy as the snapping mechanism."""
+    if sigma <= 0:
+        raise ValueError("sigma must be positive")
+    vals = np.asarray(values, dtype=np.float64)
+    shape = vals.shape
+    flat = np.ascontiguousarray(vals).ravel()
+    out = np.empty_like(flat)
+    if bound is None:
+        lam = 2.0**np.ceil(np.log2(sigma))
+        bound = float(max(lam, 1.0) * 2.0**46)
+    if flat.size and float(np.max(np.abs(flat))) > bound:
+        import warnings
+        warnings.warn(
+            "secure_gaussian: input magnitude exceeds the clamp bound "
+            f"({bound:.3g}); the release is clamped. Pass an explicit "
+            "bound sized to the query range.", UserWarning)
+    g = _lib().sn_secure_gaussian(
+        flat.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        flat.size, float(sigma), float(bound))
+    if g <= 0:
+        raise ValueError(f"sn_secure_gaussian rejected sigma={sigma}")
     return out.reshape(shape)
 
 

@@ -136,6 +136,103 @@ inline double clamp(double x, double bound) {
   return x;
 }
 
+// ---------------------------------------------------------------------
+// Exact discrete Gaussian (Canonne–Kamath–Steinke, "The Discrete
+// Gaussian for Differential Privacy", NeurIPS 2020) — the hardened twin
+// of the reference's PyDP GaussianMechanism (reference
+// pipeline_dp/dp_computations.py:127-143). Rejection sampling from the
+// discrete Laplace via exact Bernoulli(exp(-gamma)) coin flips; every
+// Bernoulli uses one fresh 64-bit ChaCha word, so individual coin
+// probabilities are realized to 2^-64 (rational gammas) / 2^-53 (the
+// one real-valued acceptance gamma) — deviations far below any (eps,
+// delta) this framework can express, and crucially the *support* of
+// the output is exactly the integers: no floating-point noise bits.
+// ---------------------------------------------------------------------
+
+// Bernoulli(num / (den * k)) with num <= den * k, den <= 2^40, k small:
+// compare one uniform 64-bit word against the exact rational threshold
+// in 128-bit arithmetic (no rounding).
+inline bool bern_frac(uint64_t num, uint64_t den, uint64_t k) {
+  uint64_t r = g_rng.next64();
+  return (static_cast<unsigned __int128>(r) * den) * k <
+         (static_cast<unsigned __int128>(num) << 64);
+}
+
+// Bernoulli(p) for real p in [0, 1] at 2^-53 resolution.
+inline bool bern_p(double p) {
+  uint64_t r = g_rng.next64() >> 11;
+  return static_cast<double>(r) < p * 0x1p53;
+}
+
+// Bernoulli(exp(-u/t)) for 0 <= u <= t (CKS Algorithm 1): run the von
+// Neumann series K=1,2,... with Bernoulli(gamma/K) coins; exp(-gamma)
+// is the probability K stops odd. The cap at K=64 is unreachable in
+// practice (P ~ 1/64!) and breaks toward an odd K.
+inline bool bexp_rat(uint64_t u, uint64_t t) {
+  uint64_t k = 1;
+  while (bern_frac(u, t, k)) {
+    if (++k > 64) break;
+  }
+  return (k & 1) == 1;
+}
+
+// Bernoulli(exp(-f)) for real f in [0, 1] — same series, real coins.
+inline bool bexp_frac(double f) {
+  uint64_t k = 1;
+  while (bern_p(f / static_cast<double>(k))) {
+    if (++k > 64) break;
+  }
+  return (k & 1) == 1;
+}
+
+// Bernoulli(exp(-gamma)) for real gamma >= 0: exp(-gamma) =
+// exp(-1)^floor(gamma) * exp(-frac(gamma)).
+inline bool bexp(double gamma) {
+  while (gamma > 1.0) {
+    if (!bexp_rat(1, 1)) return false;
+    gamma -= 1.0;
+  }
+  return bexp_frac(gamma < 0.0 ? 0.0 : gamma);
+}
+
+// Discrete Laplace with integer scale t: P(Y = y) proportional to
+// exp(-|y|/t) (CKS Algorithm 2). U is drawn modulo-bias-free.
+inline int64_t sample_dlaplace(uint64_t t) {
+  for (;;) {
+    uint64_t u = 0;
+    if (t > 1) {
+      const uint64_t lim = UINT64_MAX - UINT64_MAX % t;
+      do {
+        u = g_rng.next64();
+      } while (u >= lim);
+      u %= t;
+    }
+    if (!bexp_rat(u, t)) continue;  // accept U with prob exp(-U/t)
+    uint64_t v = 0;  // V ~ Geometric(1 - exp(-1))
+    while (bexp_rat(1, 1)) {
+      if (++v > 4096) break;  // unreachable (P ~ e^-4096)
+    }
+    const uint64_t x = u + t * v;
+    const bool neg = (g_rng.next64() & 1) != 0;
+    if (neg && x == 0) continue;  // don't double-count zero
+    return neg ? -static_cast<int64_t>(x) : static_cast<int64_t>(x);
+  }
+}
+
+// Discrete Gaussian N_Z(0, sigma^2) (CKS Algorithm 3): rejection from
+// discrete Laplace of scale t = floor(sigma) + 1; O(1) expected
+// iterations independent of sigma.
+inline int64_t sample_dgauss(double sigma) {
+  const uint64_t t = static_cast<uint64_t>(std::floor(sigma)) + 1;
+  const double s2 = sigma * sigma;
+  for (;;) {
+    const int64_t y = sample_dlaplace(t);
+    const double a =
+        std::fabs(static_cast<double>(y)) - s2 / static_cast<double>(t);
+    if (bexp(a * a / (2.0 * s2))) return y;
+  }
+}
+
 }  // namespace
 
 extern "C" {
@@ -197,6 +294,45 @@ void sn_discrete_laplace(const int64_t* values, int64_t* out, int64_t n,
         std::floor(std::log(g_rng.uniform01()) / log_q));
     out[i] = values[i] + (g1 - g2);
   }
+}
+
+// Exact discrete Gaussian noise for integer releases (counts): the
+// release is an integer — no floating-point noise bits at all. Returns
+// 0 on success, -1 for out-of-range sigma (must be in (0, 2^40): the
+// exact-rational Bernoulli threshold needs r * t * k < 2^128).
+int32_t sn_discrete_gaussian(const int64_t* values, int64_t* out,
+                             int64_t n, double sigma) {
+  if (!(sigma > 0.0) || sigma >= 0x1p40) return -1;
+  ensure_seeded();
+  for (int64_t i = 0; i < n; i++) {
+    out[i] = values[i] + sample_dgauss(sigma);
+  }
+  return 0;
+}
+
+// Hardened Gaussian for real-valued releases, mirroring the snapping
+// Laplace's contract: snap the (clamped) value to a power-of-two
+// granularity g and add g * DiscreteGaussian(sigma/g). g is sized so
+// sigma/g lands in (2^39, 2^40] (the top end hit exactly when sigma is
+// a power of two — sample_dgauss handles t = 2^40 + 1 without 128-bit
+// overflow in bern_frac): the output's support is the g-grid
+// (for |value| < 2^53 * g; beyond that the double's own ulp > g is the
+// effective grid — still power-of-two), so a textbook float Gaussian's
+// low-mantissa-bit leakage (Mironov-style) has no channel, while the
+// g/2 <= sigma * 2^-41 rounding is far below the noise. Returns g,
+// or -1.0 for invalid sigma.
+double sn_secure_gaussian(const double* values, double* out, int64_t n,
+                          double sigma, double bound) {
+  if (!(sigma > 0.0) || !std::isfinite(sigma)) return -1.0;
+  ensure_seeded();
+  const double g = lambda_for(sigma) * 0x1p-40;  // sigma/g in (2^39, 2^40]
+  const double sigma_i = sigma / g;
+  for (int64_t i = 0; i < n; i++) {
+    const double v = round_to(clamp(values[i], bound), g);
+    out[i] = clamp(
+        v + g * static_cast<double>(sample_dgauss(sigma_i)), bound);
+  }
+  return g;
 }
 
 }  // extern "C"

@@ -1,0 +1,281 @@
+"""Multi-batch streaming ingest for the fused plane: unbounded rows.
+
+The fused kernel's per-pk accumulator columns are ADDITIVE — counts and
+kept-segment markers are int32 sums, value columns are exact fixed-point
+integer lane sums, vector coordinates are float sums. A dataset larger
+than one device batch therefore streams through the SAME kernel
+(``jax_engine._partials``) in privacy-id-partitioned chunks:
+
+* every privacy unit's rows land in exactly ONE chunk (rows are grouped
+  by ``fmix32(pid)`` — the same invariant ``parallel/sharded.py`` relies
+  on for its row sharding), so per-chunk contribution bounding equals
+  global bounding;
+* each chunk's per-pk partials are fetched (a small [C, P] int32 block)
+  and folded into host accumulators: counts in exact int64, fixed-point
+  value lanes folded per chunk (each fold is an integer multiple of the
+  static quantization step, exactly representable) and summed in
+  float64, vector coordinates in float64 — BETTER conditioned than the
+  single-batch float32 vector accumulation;
+* partition selection then runs ONCE on device over the combined
+  privacy-id counts (the same batched draw as the single-batch kernel),
+  and the scalar DP release goes through the shared float64 host
+  mechanisms (``jax_engine._host_release``).
+
+This is the TPU plane's answer to the reference's unbounded Beam/Spark
+dataflow ingestion (reference ``pipeline_dp/pipeline_backend.py:219-359``):
+dataset size is bounded by HOST memory only — not by HBM and not by the
+int32 lane capacity that caps a single batch at 2^27 rows.
+
+Exactness: per-chunk folded value sums are integers in units of the
+quantization step with magnitude <= chunk_rows * 2^23; their float64
+accumulation stays exact while the GLOBAL total stays below 2^53 steps
+(~2^30 rows at full-scale values); beyond that the only additional error
+is float64 rounding at relative 2^-53 — far below the per-row
+quantization already accepted by the single-batch kernel.
+
+Percentile metrics are excluded: the quantile-tree walk needs all of a
+partition's rows resident in one pass (``jax_engine._percentile_values``);
+percentile pipelines past the single-batch capacity raise instead.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from pipelinedp_tpu import jax_engine as je
+from pipelinedp_tpu.ops.segment import fmix32
+
+#: Rows per device batch (and the engine's streaming trigger: pipelines
+#: with more rows than one chunk stream). Overridable for tests and for
+#: hosts with small HBM.
+_CHUNK_ENV = "PIPELINEDP_TPU_STREAM_CHUNK"
+
+
+def stream_chunk_rows() -> int:
+    return int(os.environ.get(_CHUNK_ENV, 1 << 26))
+
+
+def stream_is_supported(config) -> bool:
+    """Percentiles need all rows of a partition on device in one pass."""
+    return not config.percentiles
+
+
+def should_stream(config, n_rows: int, mesh) -> bool:
+    """The engine streams when one batch can't hold the pipeline. On a
+    mesh the per-device batch is the shard, which scales with the mesh;
+    streaming composes with sharding in a later round if needed."""
+    return (mesh is None and n_rows > stream_chunk_rows() and
+            stream_is_supported(config))
+
+
+def _rank1_names(config, fx_bits: int):
+    """Host mirror of the rank-1 accumulator columns ``_reduce_per_pk``
+    produces (all int32): deterministic packing order for the fetch."""
+    names = ["count"]
+    n_lanes = -(-je._FX_PAYLOAD_BITS // fx_bits)
+    for spec in je._fixedpoint_layout(config):
+        names += [f"{spec.name}_fx{k}" for k in range(n_lanes)]
+    return sorted(names)
+
+
+@functools.partial(jax.jit, static_argnames=("config", "num_partitions",
+                                             "fx_bits", "n_pid_planes"))
+def _partials_kernel(config, num_partitions, planes, values, n_valid, key,
+                     fx_bits, n_pid_planes):
+    """One chunk's bounding + per-pk reduction, packed for the fetch:
+    a [C+1, P] int32 stack (rank-1 columns in sorted-name order, the
+    privacy-id count last) and the rank-2 vector sums (or None).
+
+    Ids arrive as narrow byte planes (the tunneled host link runs at
+    tens of MB/s — bytes are wall time, exactly as in
+    ``jax_engine.pad_and_put``) and the row-validity mask is derived on
+    device from the scalar row count."""
+    pid = je._widen_ids(planes[:n_pid_planes])
+    pk = je._widen_ids(planes[n_pid_planes:])
+    valid = jnp.arange(pid.shape[0]) < n_valid
+    part, nseg, _ = je._partials(config, num_partitions, pid, pk, values,
+                                 valid, key, fx_bits)
+    vec = part.pop("vector_sum", None)
+    names = sorted(k for k in part)
+    packed = jnp.stack([part[k] for k in names] + [nseg])
+    return packed, vec
+
+
+@functools.partial(jax.jit, static_argnames=("config", "num_partitions"))
+def _select_kernel(config, num_partitions, part_nseg, keep_table,
+                   sel_threshold, sel_scale, sel_min_count,
+                   sel_rows_per_uid, k_sel):
+    """Batched partition selection over the combined partials — the same
+    draw structure as the single-batch kernel's selection block."""
+    keep_pk, _ = je._selection_and_metrics(
+        config, num_partitions, {}, part_nseg,
+        jnp.zeros(1, jnp.float32), keep_table, sel_threshold, sel_scale,
+        sel_min_count, sel_rows_per_uid, k_sel, k_sel)
+    return keep_pk
+
+
+def _batch_assignment(config, encoded, n_batches: int, seed: int):
+    """Row order + per-batch counts such that each privacy unit's rows
+    are contiguous within one batch. Without privacy ids every row is
+    its own unit, so plain contiguous slices suffice (no reorder)."""
+    n = encoded.n_rows
+    if config.bounds_already_enforced:
+        base = n // n_batches
+        rem = n % n_batches
+        counts = np.full(n_batches, base, np.int64)
+        counts[:rem] += 1
+        return None, counts
+    # Hash before the bucketing (id families sharing low bits would pile
+    # into one batch), salt by the run seed so adversarial id sets can't
+    # target a batch across runs.
+    h = fmix32(encoded.pid.astype(np.uint32) ^ np.uint32(seed & 0xFFFFFFFF))
+    batch_of_row = ((h.astype(np.uint64) * np.uint64(n_batches)) >> np.uint64(32)).astype(np.int64)
+    order = np.argsort(batch_of_row, kind="stable")
+    counts = np.bincount(batch_of_row, minlength=n_batches)
+    return order, counts
+
+
+def stream_partials_and_select(config, encoded, keep_table, sel_threshold,
+                               sel_scale, sel_min_count, sel_rows_per_uid,
+                               rng_seed: Optional[int]
+                               ) -> Tuple[np.ndarray, Dict, Dict]:
+    """Runs the streaming aggregation. Returns ``(keep[P_pad] bool,
+    part64, stats)`` where ``part64`` holds the combined float64/int64
+    accumulator columns ready for ``jax_engine._host_release``."""
+    from pipelinedp_tpu.ops import noise as noise_ops
+
+    P = len(encoded.pk_vocab)
+    P_pad = je._pad_pow2(P)
+    n = encoded.n_rows
+    chunk = stream_chunk_rows()
+    n_batches = max(1, -(-n // chunk))
+    seed = (rng_seed if rng_seed is not None else
+            int(noise_ops._host_rng.integers(0, 2**31 - 1)))
+    key = jax.random.PRNGKey(seed)
+    # Same key topology as the single-batch kernel: one bounding stream
+    # (folded per batch), one selection stream.
+    k_bound, k_sel, _ = jax.random.split(key, 3)
+
+    order, counts = _batch_assignment(config, encoded, n_batches, seed)
+    max_rows = int(counts.max()) if len(counts) else 1
+    pad_rows = je._pad_pow2(max(max_rows, 1))
+    layout = je._fixedpoint_layout(config)
+    # Lane capacity is a PER-BATCH bound here — that is the whole point:
+    # the plan depends on the largest chunk, not the global row count.
+    # A batch can exceed the chunk target only through privacy-unit
+    # skew: one unit's rows are indivisible (bounding must see them
+    # together), so the heaviest unit sets the batch floor.
+    try:
+        fx_bits = je._fx_plan(max_rows)[0] if layout else 12
+    except NotImplementedError:
+        raise NotImplementedError(
+            f"the largest streaming batch holds {max_rows} rows — beyond "
+            "the 2^27-row per-batch lane capacity. A batch this far over "
+            f"the {chunk}-row chunk target means a single privacy unit "
+            "owns that many rows; its rows cannot be split across "
+            "batches (contribution bounding must see them together)")
+    names = _rank1_names(config, fx_bits)
+
+    # Lane columns fold into float64 value columns per batch and never
+    # accumulate raw: only the integer count columns live in acc.
+    acc = {"count": np.zeros(P_pad, np.int64),
+           "privacy_id_count_raw": np.zeros(P_pad, np.int64)}
+    val_acc = {spec.name: np.zeros(P_pad, np.float64) for spec in layout}
+    vec_acc = None
+
+    pid_spec = (je._plane_spec(int(encoded.pid.max(initial=0)))
+                if not config.bounds_already_enforced else "u16")
+    pk_spec = je._plane_spec(int(encoded.pk.max(initial=0)))
+    zeros_dev = None  # shared on-device zero values for COUNT-style runs
+    # Staging buffers are allocated once and reused across batches (only
+    # the stale tail needs re-zeroing); rows past n_valid are masked in
+    # the kernel, so the id content of the padding is irrelevant — but
+    # narrow-plane packing reads the whole buffer, so stale ids must not
+    # widen the plane spec (they can't: the spec is fixed globally).
+    pid_b = np.zeros(pad_rows, np.int32)
+    pk_b = np.zeros(pad_rows, np.int32)
+    values_b = None
+    if config.needs_values:
+        vshape = ((pad_rows, config.vector_size) if config.vector_size
+                  else (pad_rows,))
+        values_b = np.zeros(vshape, np.float32)
+    offset = 0
+    for b in range(n_batches):
+        cnt = int(counts[b])
+        rows = (slice(offset, offset + cnt) if order is None
+                else order[offset:offset + cnt])
+        offset += cnt
+        if cnt == 0:
+            continue
+        # Narrow byte planes, padded on host to the uniform batch shape
+        # (uniform shape = ONE compile for every batch).
+        if not config.bounds_already_enforced:
+            pid_b[:cnt] = encoded.pid[rows]
+        pk_b[:cnt] = encoded.pk[rows]
+        pid_planes = je._narrow_ids(pid_b, pid_spec)
+        pk_planes = je._narrow_ids(pk_b, pk_spec)
+        host = list(pid_planes) + list(pk_planes)
+        if config.needs_values:
+            values_b[:cnt] = encoded.values[rows]
+            values_b[cnt:] = 0.0
+            host.append(values_b)
+        dev = jax.device_put(tuple(host))  # one batched transfer
+        if config.needs_values:
+            planes, values_d = dev[:-1], dev[-1]
+        else:
+            planes = dev
+            if zeros_dev is None:
+                zeros_dev = jnp.zeros(pad_rows, jnp.float32)
+            values_d = zeros_dev
+        packed, vec = _partials_kernel(
+            config, P_pad, planes, values_d, jnp.int32(cnt),
+            jax.random.fold_in(k_bound, b), fx_bits,
+            n_pid_planes=len(pid_planes))
+        host = np.asarray(packed)  # [C+1, P_pad] int32, one transfer
+        # Loud failure if the kernel's packed column set ever diverges
+        # from the host-side name mirror (a silent mismatch would hand
+        # the release mislabeled accumulators).
+        assert host.shape[0] == len(names) + 1, (
+            f"kernel packed {host.shape[0]} columns, host expected "
+            f"{names} + nseg")
+        batch64 = {name: host[i].astype(np.int64)
+                   for i, name in enumerate(names)}
+        batch64["privacy_id_count_raw"] = host[-1].astype(np.int64)
+        # Fold this chunk's lanes into float64 value columns (exact:
+        # integer multiples of the static quantization step).
+        je._fold_fixedpoint(config, batch64, fx_bits)
+        acc["count"] += batch64["count"]
+        acc["privacy_id_count_raw"] += batch64["privacy_id_count_raw"]
+        for spec in layout:
+            val_acc[spec.name] += batch64[spec.name]
+        if vec is not None:
+            v64 = np.asarray(vec).astype(np.float64)
+            vec_acc = v64 if vec_acc is None else vec_acc + v64
+
+    part64: Dict[str, np.ndarray] = dict(acc)
+    part64.update(val_acc)
+    if vec_acc is not None:
+        part64["vector_sum"] = vec_acc
+
+    if config.selection is None:
+        keep = np.ones(P_pad, bool)
+    else:
+        nseg = acc["privacy_id_count_raw"]
+        if nseg.max(initial=0) >= np.iinfo(np.int32).max:
+            raise NotImplementedError(
+                "more than 2^31 privacy units in one partition")
+        keep = np.asarray(_select_kernel(
+            config, P_pad, jnp.asarray(nseg.astype(np.int32)),
+            jnp.asarray(keep_table), jnp.float32(sel_threshold),
+            jnp.float32(sel_scale), jnp.float32(sel_min_count),
+            jnp.float32(sel_rows_per_uid), k_sel))
+    stats = {"n_batches": n_batches, "chunk_rows": chunk,
+             "fx_bits": fx_bits, "max_batch_rows": max_rows}
+    return keep, part64, stats

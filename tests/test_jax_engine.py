@@ -955,6 +955,25 @@ class TestAdaptiveLanePlan:
         with pytest.raises(NotImplementedError, match="2\\^27"):
             je._fx_plan(1 << 28)
 
+    def test_no_value_columns_skip_the_plan(self, monkeypatch):
+        """COUNT/PRIVACY_ID_COUNT-only pipelines use no fixed-point
+        lanes, so the lane-capacity plan (and its row cap) must never
+        run for them — counts are exact int32 to 2^31 rows."""
+        from pipelinedp_tpu import jax_engine as je
+
+        def boom(n):
+            raise AssertionError("_fx_plan must not run for count-only")
+
+        monkeypatch.setattr(je, "_fx_plan", boom)
+        ds = pdp.ArrayDataset(privacy_ids=np.arange(100) % 10,
+                              partition_keys=np.arange(100) % 5,
+                              values=None)
+        params = count_params(max_partitions_contributed=2,
+                              max_contributions_per_partition=2)
+        fused = run(JaxBackend(rng_seed=0), ds, params, eps=1e6,
+                    delta=1e-2, ext=pdp.DataExtractors())
+        assert len(fused) == 5
+
 
 class TestCompactFetchFallback:
     """Private selection keeping more partitions than the packed-fetch

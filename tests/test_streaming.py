@@ -1,0 +1,255 @@
+"""Streaming (multi-batch) fused-plane tests.
+
+Strategy: force tiny chunks via the ``PIPELINEDP_TPU_STREAM_CHUNK`` env
+knob so ordinary-size datasets stream through many batches, then apply
+the same differential discipline as ``test_jax_engine``: at huge eps the
+streamed result must match the LocalBackend oracle / exact aggregates
+partition by partition, across metric combinations, bounding modes and
+selection regimes. The chunked execution must be observable
+(``timings["stream_batches"] > 1``) so these tests can't silently pass
+through the single-batch path.
+"""
+
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import streaming
+from pipelinedp_tpu.backends import JaxBackend
+
+BIG_EPS = 1e12
+
+
+@pytest.fixture(autouse=True)
+def tiny_chunks(monkeypatch):
+    monkeypatch.setenv("PIPELINEDP_TPU_STREAM_CHUNK", "997")
+
+
+def run_streamed(ds, params, public=None, eps=BIG_EPS, delta=1e-2,
+                 seed=0):
+    acc = pdp.NaiveBudgetAccountant(total_epsilon=eps, total_delta=delta)
+    engine = pdp.DPEngine(acc, JaxBackend(rng_seed=seed))
+    res = engine.aggregate(ds, params, pdp.DataExtractors(),
+                           public_partitions=public)
+    acc.compute_budgets()
+    got = dict(res)
+    assert res.timings.get("stream_batches", 0) > 1, (
+        "dataset did not stream — test is not covering the chunked path")
+    return got
+
+
+def make_ds(rng, n=12_000, users=2_000, parts=15):
+    return pdp.ArrayDataset(privacy_ids=rng.integers(0, users, n),
+                            partition_keys=rng.integers(0, parts, n),
+                            values=rng.uniform(0.0, 10.0, n)), parts
+
+
+class TestStreamedDifferential:
+    """Huge-eps, non-binding caps: streamed == exact, per partition."""
+
+    def test_count_sum_mean_variance_pid_count(self):
+        rng = np.random.default_rng(1)
+        ds, parts = make_ds(rng)
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM, pdp.Metrics.MEAN,
+                     pdp.Metrics.VARIANCE, pdp.Metrics.PRIVACY_ID_COUNT],
+            max_partitions_contributed=parts,
+            max_contributions_per_partition=50,
+            min_value=0.0, max_value=10.0)
+        got = run_streamed(ds, params)
+        pk = ds.partition_keys
+        vals = ds.values
+        pid = ds.privacy_ids
+        assert len(got) == parts
+        for p in range(parts):
+            m = pk == p
+            assert got[p].count == pytest.approx(m.sum(), abs=0.5)
+            assert got[p].sum == pytest.approx(vals[m].sum(), rel=1e-5)
+            assert got[p].mean == pytest.approx(vals[m].mean(), abs=1e-4)
+            assert got[p].variance == pytest.approx(vals[m].var(),
+                                                    abs=1e-2)
+            assert got[p].privacy_id_count == pytest.approx(
+                len(np.unique(pid[m])), abs=0.5)
+
+    def test_matches_single_batch_aggregates(self):
+        """Same dataset through the single-batch kernel (big chunk) and
+        the streamed path: deterministic aggregates identical at huge
+        eps, regardless of the different batch structure."""
+        rng = np.random.default_rng(2)
+        ds, parts = make_ds(rng, n=8_000)
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            max_partitions_contributed=parts,
+            max_contributions_per_partition=50,
+            min_value=0.0, max_value=10.0)
+        streamed = run_streamed(ds, params, public=list(range(parts)))
+
+        import os
+        os.environ["PIPELINEDP_TPU_STREAM_CHUNK"] = str(1 << 26)
+        ds.invalidate_cache()
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=BIG_EPS,
+                                        total_delta=1e-2)
+        engine = pdp.DPEngine(acc, JaxBackend(rng_seed=0))
+        res = engine.aggregate(ds, params, pdp.DataExtractors(),
+                               public_partitions=list(range(parts)))
+        acc.compute_budgets()
+        single = dict(res)
+        for p in range(parts):
+            assert streamed[p].count == pytest.approx(single[p].count,
+                                                      abs=1e-3)
+            assert streamed[p].sum == pytest.approx(single[p].sum,
+                                                    rel=1e-5)
+
+    def test_per_partition_bounds_mode(self):
+        rng = np.random.default_rng(3)
+        ds, parts = make_ds(rng)
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.SUM],
+            max_partitions_contributed=parts,
+            max_contributions_per_partition=50,
+            min_sum_per_partition=0.0, max_sum_per_partition=100.0)
+        got = run_streamed(ds, params)
+        pk, vals = ds.partition_keys, ds.values
+        for p in range(parts):
+            m = pk == p
+            # Quantization grid is bound/2^23 per SEGMENT — keep the
+            # clip bound realistic or the grid dominates the check.
+            assert got[p].sum == pytest.approx(vals[m].sum(), rel=1e-4)
+
+    def test_total_cap_bounding_invariants(self):
+        """max_contributions binding: the per-pid sample differs between
+        planes, so check invariants — global kept rows = sum over pids of
+        min(rows, cap)."""
+        rng = np.random.default_rng(4)
+        n = 10_000
+        pid = rng.integers(0, 300, n)  # ~33 rows/pid, cap at 10
+        ds = pdp.ArrayDataset(privacy_ids=pid,
+                              partition_keys=rng.integers(0, 8, n),
+                              values=rng.uniform(0, 10, n))
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT], max_contributions=10)
+        got = run_streamed(ds, params, public=list(range(8)))
+        expect = sum(min(c, 10) for c in np.bincount(pid))
+        total = sum(m.count for m in got.values())
+        assert total == pytest.approx(expect, rel=1e-3)
+
+    def test_bounds_already_enforced(self):
+        rng = np.random.default_rng(5)
+        n = 9_000
+        pk = rng.integers(0, 6, n)
+        vals = rng.uniform(0, 5, n)
+        ds = pdp.ArrayDataset(privacy_ids=None, partition_keys=pk,
+                              values=vals)
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            max_partitions_contributed=6,
+            max_contributions_per_partition=3,
+            min_value=0.0, max_value=5.0,
+            contribution_bounds_already_enforced=True)
+        got = run_streamed(ds, params, public=list(range(6)))
+        for p in range(6):
+            m = pk == p
+            assert got[p].count == pytest.approx(m.sum(), abs=0.5)
+            assert got[p].sum == pytest.approx(vals[m].sum(), rel=1e-5)
+
+    def test_vector_sum(self):
+        rng = np.random.default_rng(6)
+        n = 6_000
+        ds = pdp.ArrayDataset(
+            privacy_ids=rng.integers(0, 1000, n),
+            partition_keys=rng.integers(0, 4, n),
+            values=rng.uniform(-1, 1, (n, 3)))
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.VECTOR_SUM],
+            max_partitions_contributed=4,
+            max_contributions_per_partition=20,
+            vector_size=3, vector_max_norm=100.0,
+            vector_norm_kind=pdp.NormKind.Linf)
+        got = run_streamed(ds, params, public=list(range(4)))
+        for p in range(4):
+            m = ds.partition_keys == p
+            np.testing.assert_allclose(np.asarray(got[p].vector_sum),
+                                       ds.values[m].sum(axis=0),
+                                       rtol=1e-4, atol=1e-3)
+
+    def test_private_selection_drops_small_partitions(self):
+        """Selection statistics survive the streamed nseg accumulation:
+        big partitions kept, single-user partitions dropped at modest
+        eps."""
+        rng = np.random.default_rng(7)
+        n = 8_000
+        pid = np.arange(n)  # every row its own user
+        pk = np.where(np.arange(n) < 7_800,
+                      rng.integers(0, 4, n), 4 + np.arange(n) % 150)
+        ds = pdp.ArrayDataset(privacy_ids=pid, partition_keys=pk,
+                              values=None)
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.PRIVACY_ID_COUNT],
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1)
+        got = run_streamed(ds, params, eps=5.0, delta=1e-5)
+        kept = set(got)
+        assert {0, 1, 2, 3} <= kept  # ~1950 users each: always kept
+        # the ~150 single/double-user partitions are overwhelmingly
+        # dropped
+        assert len(kept - {0, 1, 2, 3}) < 20
+
+
+class TestStreamingInternals:
+
+    def test_pid_batches_are_disjoint(self):
+        """Every privacy unit's rows land in exactly one batch."""
+        from pipelinedp_tpu import jax_engine as je
+        rng = np.random.default_rng(8)
+        n = 5_000
+        pid = rng.integers(0, 400, n)
+        enc = je.EncodedData(pid=pid.astype(np.int32),
+                             pk=np.zeros(n, np.int32),
+                             values=np.zeros(n, np.float32),
+                             pk_vocab=[0], n_rows=n)
+        config = je.FusedConfig.from_params(
+            pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                max_partitions_contributed=1,
+                                max_contributions_per_partition=1),
+            public=True)
+        order, counts = streaming._batch_assignment(config, enc, 7, 123)
+        seen = {}
+        offset = 0
+        for b, c in enumerate(counts):
+            batch_pids = set(pid[order[offset:offset + c]].tolist())
+            for u in batch_pids:
+                assert seen.setdefault(u, b) == b
+            offset += c
+        assert offset == n
+
+    def test_exact_lane_accumulation_across_batches(self):
+        """Adversarial equal values summed across many batches stay
+        exact (float32 single-batch accumulation would drift)."""
+        n = 30_000
+        ds = pdp.ArrayDataset(
+            privacy_ids=np.arange(n) % 5_000,
+            partition_keys=np.zeros(n, np.int64),
+            values=np.full(n, 7.25))
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.SUM], max_partitions_contributed=1,
+            max_contributions_per_partition=10,
+            min_value=0.0, max_value=10.0)
+        got = run_streamed(ds, params, public=[0])
+        assert got[0].sum == pytest.approx(7.25 * n, rel=1e-6)
+
+    def test_count_only_streams_past_lane_plan(self, monkeypatch):
+        """Streaming must never consult the single-batch lane plan for
+        pipelines with no fixed-point columns."""
+        from pipelinedp_tpu import jax_engine as je
+        monkeypatch.setattr(
+            je, "_fx_plan",
+            lambda n: (_ for _ in ()).throw(AssertionError("no plan")))
+        rng = np.random.default_rng(9)
+        ds = pdp.ArrayDataset(privacy_ids=rng.integers(0, 500, 4_000),
+                              partition_keys=rng.integers(0, 5, 4_000),
+                              values=None)
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT], max_partitions_contributed=5,
+            max_contributions_per_partition=20)
+        got = run_streamed(ds, params, public=list(range(5)))
+        assert len(got) == 5
