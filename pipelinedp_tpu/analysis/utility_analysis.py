@@ -23,10 +23,21 @@ def perform_utility_analysis(col, backend,
     (reference :27-110).
 
     On a fused backend (JaxBackend) the whole sweep runs on device with a
-    configuration axis (``analysis/jax_sweep.py``); the host graph below
-    remains the oracle and the fallback."""
-    if (getattr(backend, "supports_fused_aggregation", False) and
-            not return_per_partition):
+    configuration axis (``analysis/jax_sweep.py``) — including
+    ``return_per_partition``, whose [P, C] error blocks are fetched from
+    the same stage-B pass the aggregate reduction consumes (reference
+    emits per-partition metrics from the same pass,
+    ``analysis/utility_analysis.py:60-77``); the host graph below remains
+    the oracle and the fallback."""
+    mesh = getattr(backend, "mesh", None)
+    if (return_per_partition and mesh is not None and
+            mesh.devices.size > 1):
+        # The per-partition fetch is single-device (its [P, C] blocks
+        # would need partition-axis out_specs on a mesh); decide here,
+        # before any encode/device work.
+        return _host_analysis(col, backend, options, data_extractors,
+                              public_partitions, return_per_partition)
+    if getattr(backend, "supports_fused_aggregation", False):
         from pipelinedp_tpu.analysis import jax_sweep
         if jax_sweep.sweep_is_supported(options, data_extractors,
                                         return_per_partition):
@@ -36,10 +47,20 @@ def perform_utility_analysis(col, backend,
                 total_epsilon=options.epsilon, total_delta=options.delta)
             result = jax_sweep.build_fused_sweep(
                 col, options, data_extractors, public_partitions,
-                accountant, mesh=getattr(backend, "mesh", None))
+                accountant, mesh=getattr(backend, "mesh", None),
+                return_per_partition=return_per_partition,
+                backend=backend)
             accountant.compute_budgets()
+            if return_per_partition:
+                return result, result.per_partition_rows()
             return result
+    return _host_analysis(col, backend, options, data_extractors,
+                          public_partitions, return_per_partition)
 
+
+def _host_analysis(col, backend, options, data_extractors,
+                   public_partitions, return_per_partition):
+    """The host analysis graph (the oracle and the fallback path)."""
     budget_accountant = budget_accounting.NaiveBudgetAccountant(
         total_epsilon=options.epsilon, total_delta=options.delta)
     engine = utility_analysis_engine.UtilityAnalysisEngine(

@@ -60,6 +60,19 @@ from pipelinedp_tpu.ops import quantile_tree as quantile_tree_ops
 from pipelinedp_tpu.ops import segment as seg_ops
 
 
+def _pad_rows(n: int) -> int:
+    """Row-axis padding: the next multiple of 8192 (a whole number of
+    (8, 128) f32 tiles, and one shared compile shape for small tests).
+    Rows used to pad to a power of two, which wastes up to 2x of every
+    row-space op (sort, scatters, elementwise) — a 10M-row pipeline ran
+    all its row passes at 2^24 = 16.8M rows; measured on v5e, sorts and
+    scatters at a non-power-of-two length run at full speed, so the
+    tight padding is a ~1.4-1.7x cut of the whole row plane. The
+    partition axis keeps power-of-two padding (``_pad_pow2``): selection
+    bit-parity on meshes relies on it."""
+    return max(8192, -(-n // 8192) * 8192)
+
+
 def _pad_pow2(n: int, minimum: int = 8) -> int:
     return max(minimum, 1 << (n - 1).bit_length())
 
@@ -324,7 +337,7 @@ def pad_and_put(encoded: EncodedData, vector_size: Optional[int],
     ships the ids once and then only adds the value transfer (still one
     batched device_put per call for whatever is missing)."""
     n = encoded.n_rows
-    n_pad = _pad_pow2(max(n, 1))
+    n_pad = _pad_rows(n)
     cache = encoded.__dict__.setdefault("_device_cache", {})
     vals_key = ("values", vector_size)
     need_ids = "ids" not in cache
@@ -870,12 +883,12 @@ def _reduce_per_pk(config: FusedConfig, pk_safe, masked, keep_row,
 
     layout = _fixedpoint_layout(config)
     n_lanes = -(-_FX_PAYLOAD_BITS // fx_bits)
-    if layout and (pk_safe.shape[0] // 2) * ((1 << fx_bits) - 1) >= (
-            1 << 31):
+    if layout and max(pk_safe.shape[0] - 8191, 1) * (
+            (1 << fx_bits) - 1) >= (1 << 31):
         # Loud trace-time guard for direct kernel callers: lane sums past
         # int32 capacity would wrap silently. The kernel only sees the
-        # PADDED shape (< 2x the real rows, which are what consume
-        # capacity — padding is masked to zero), hence the factor-2
+        # PADDED shape (real rows + at most 8191 padding rows, which are
+        # masked to zero and consume no capacity), hence the 8191-row
         # allowance; _run_fused_kernel sizes fx_bits from the real global
         # row count, so the engine path never trips this.
         raise NotImplementedError(
@@ -1184,18 +1197,85 @@ def _percentile_values(config: FusedConfig, P, qrows, scale, key):
             # [P, Q, span] block would blow HBM; the per-level fallback
             # then runs.
             span = w * b
+            n_blocks = (b**height) // span
             if P * Q * span * 4 <= _SUBHIST_BYTE_CAP:
+                # The descent so far only added multiples of widths
+                # >= span, so every walk's subtree start is span-ALIGNED:
+                # membership is "the row's span-block == the walk's
+                # block id", the in-subtree offset is just the low leaf
+                # bits, and the scatter key is the SAME for every
+                # quantile — only the membership mask differs. The Q
+                # per-row block ids (each < n_blocks <= 256 for the
+                # default tree) pack 4-per-int32, so the per-row cost is
+                # ceil(Q/4) gathers + byte compares instead of Q
+                # gathers.
                 sub_start = leaf_lo
-                subs = []
-                for q in range(Q):
-                    rel = leaf - sub_start[:, q][qpk]
-                    ok = kept & (rel >= 0) & (rel < span)
-                    seg = qpk * span + jnp.clip(rel, 0, span - 1)
-                    subs.append(
+                shift = span.bit_length() - 1  # span is a power of two
+                mid = leaf >> shift
+                lo_bits = leaf & (span - 1)
+                blk = sub_start >> shift  # [P, Q] block ids
+
+                def row_masks(qpk_r, mid_r, kept_r):
+                    """Per-quantile membership masks of the given rows,
+                    via the packed block tables."""
+                    masks = []
+                    for g in range(0, Q, 4):
+                        packed = jnp.zeros(P, jnp.int32)
+                        for j, q in enumerate(range(g, min(g + 4, Q))):
+                            packed |= blk[:, q] << (8 * j)
+                        pr = packed[qpk_r]  # ONE gather per 4 quantiles
+                        for j, q in enumerate(range(g, min(g + 4, Q))):
+                            masks.append(kept_r & (
+                                mid_r == ((pr >> (8 * j)) & 0xFF)))
+                    return masks
+
+                def subs_over(qpk_r, mid_r, lo_r, kept_r):
+                    seg = qpk_r * span + lo_r  # q-independent key
+                    return jnp.stack([
                         jax.ops.segment_sum(ok.astype(jnp.int32), seg,
                                             num_segments=P * span
-                                            ).reshape(P, span))
-                sub_hist = jnp.stack(subs, axis=1)  # [P, Q, span] int32
+                                            ).reshape(P, span)
+                        for ok in row_masks(qpk_r, mid_r, kept_r)
+                    ], axis=1)  # [P, Q, span] int32
+
+                if n_blocks <= 256:
+                    # The chosen subtrees jointly cover ~Q/n_blocks of
+                    # the leaf space, so typically ~1% of rows land in
+                    # ANY sub-histogram — yet a full scatter scans every
+                    # row. Compact the relevant rows to a static n/8
+                    # prefix with one stable single-key sort and scatter
+                    # the prefix (~free); data concentrated enough to
+                    # overflow the prefix (e.g. all-equal values) falls
+                    # back to full-row scatters via lax.cond.
+                    n_rows = leaf.shape[0]
+                    cap = max(8192, n_rows // 8)
+                    rel_any = jnp.zeros(n_rows, bool)
+                    for ok in row_masks(qpk, mid, kept):
+                        rel_any |= ok
+                    order = jnp.argsort(~rel_any, stable=True)[:cap]
+                    n_rel = jnp.sum(rel_any.astype(jnp.int32))
+
+                    def compacted(_):
+                        return subs_over(qpk[order], mid[order],
+                                         lo_bits[order], kept[order])
+
+                    def full(_):
+                        return subs_over(qpk, mid, lo_bits, kept)
+
+                    sub_hist = jax.lax.cond(n_rel <= cap, compacted,
+                                            full, None)
+                else:  # non-default tree shapes: block ids > 8 bits
+                    subs = []
+                    for q in range(Q):
+                        rel = leaf - sub_start[:, q][qpk]
+                        ok = kept & (rel >= 0) & (rel < span)
+                        seg = qpk * span + jnp.clip(rel, 0, span - 1)
+                        subs.append(
+                            jax.ops.segment_sum(ok.astype(jnp.int32),
+                                                seg,
+                                                num_segments=P * span
+                                                ).reshape(P, span))
+                    sub_hist = jnp.stack(subs, axis=1)
         if not below_hist:
             raw = counts_at(w, base)  # [P, Q, b]
         elif sub_hist is not None:

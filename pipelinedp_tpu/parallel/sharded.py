@@ -142,7 +142,7 @@ def sharded_fused_aggregate(mesh: Mesh, config, num_partitions: int,
                     ).astype(np.int32)
     order = np.argsort(shard_of_row, kind="stable")
     counts = np.bincount(shard_of_row, minlength=n_dev)
-    per_shard = jax_engine._pad_pow2(int(counts.max()) if len(pid) else 1)
+    per_shard = jax_engine._pad_rows(int(counts.max()) if len(pid) else 1)
 
     def shard_array(arr, fill=0):
         shape = (n_dev * per_shard,) + arr.shape[1:]

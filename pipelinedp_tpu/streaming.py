@@ -165,7 +165,7 @@ def stream_partials_and_select(config, encoded, keep_table, sel_threshold,
 
     order, counts = _batch_assignment(config, encoded, n_batches, seed)
     max_rows = int(counts.max()) if len(counts) else 1
-    pad_rows = je._pad_pow2(max(max_rows, 1))
+    pad_rows = je._pad_rows(max_rows)
     layout = je._fixedpoint_layout(config)
     # Lane capacity is a PER-BATCH bound here — that is the whole point:
     # the plan depends on the largest chunk, not the global row count.

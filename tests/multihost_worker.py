@@ -1,0 +1,85 @@
+"""Worker process for the multi-host (DCN) mesh test.
+
+Launched by ``tests/test_multihost.py`` as one of two
+``jax.distributed`` processes, each contributing 4 virtual CPU devices
+to an 8-device GLOBAL mesh (the CPU stand-in for a 2-host TPU slice
+connected over DCN — SURVEY §5.8). Runs one fused aggregation with the
+partition axis owner-sharded across the process boundary and checks:
+
+* exact aggregates at huge eps against the host truth;
+* selection bit-parity: the global mesh's kept-partition set equals a
+  single LOCAL device run with the same PRNG seed (the power-of-two
+  global axis guarantee from ``parallel/sharded.py``).
+
+Not a pytest file — invoked directly with (process_id, n_processes,
+coordinator_port) argv.
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    proc_id = int(sys.argv[1])
+    n_proc = int(sys.argv[2])
+    port = sys.argv[3]
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        coordinator_address=f"localhost:{port}",
+        num_processes=n_proc, process_id=proc_id)
+    assert len(jax.devices()) == 4 * n_proc, jax.devices()
+    assert len(jax.local_devices()) == 4
+
+    import numpy as np
+
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu.backends import JaxBackend
+    from pipelinedp_tpu.parallel import make_mesh
+
+    mesh = make_mesh()  # all 8 global devices
+    assert mesh.devices.size == 4 * n_proc
+
+    rng = np.random.default_rng(0)  # identical data on every process
+    n = 20_000
+    pid = rng.integers(0, 2_000, n)
+    pk = rng.integers(0, 40, n)
+    vals = rng.uniform(0.0, 10.0, n)
+    # A handful of single-user partitions that selection must drop.
+    pk[:30] = 40 + np.arange(30) % 10
+    ds = pdp.ArrayDataset(privacy_ids=pid, partition_keys=pk,
+                          values=vals)
+    params = pdp.AggregateParams(
+        metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+        max_partitions_contributed=50,
+        max_contributions_per_partition=50,
+        min_value=0.0, max_value=10.0)
+
+    def run(backend):
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=1e8,
+                                        total_delta=1e-6)
+        engine = pdp.DPEngine(acc, backend)
+        res = engine.aggregate(ds, params, pdp.DataExtractors())
+        acc.compute_budgets()
+        return dict(res)
+
+    sharded = run(JaxBackend(mesh=mesh, rng_seed=11))
+    ds.invalidate_cache()
+    local = run(JaxBackend(rng_seed=11))
+
+    # Bit-parity: identical keep decisions across the process boundary.
+    assert set(sharded) == set(local), (
+        f"keep sets differ: {sorted(set(sharded) ^ set(local))}")
+    for k in range(40):
+        m = pk == k
+        assert abs(sharded[k].count - m.sum()) < 1.0
+        assert abs(sharded[k].sum - vals[m].sum()) < 1.0
+        assert abs(sharded[k].count - local[k].count) < 1e-6
+    print(f"proc {proc_id}: OK ({len(sharded)} partitions kept, "
+          f"mesh={mesh.shape})", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -397,6 +397,37 @@ class TestFusedPercentile:
         assert fused["a"].percentile_50 == pytest.approx(
             local["a"].percentile_50, abs=0.2)
 
+    def test_all_equal_values_hit_compaction_fallback(self):
+        """Every row carries the same value, so every kept row lands in
+        each walk's chosen subtree — the sub-histogram compaction prefix
+        overflows and the lax.cond fallback (full-row scatters) must
+        produce the same exact counts."""
+        noise_ops.seed_host_rng(0)
+        data = [(u, "ab"[u % 2], 42.0) for u in range(5000)]
+        params = self._percentile_params([50, 90, 99])
+        fused = run(JaxBackend(rng_seed=19), data, params)
+        for k in ("a", "b"):
+            # All mass at 42: every quantile lands within one leaf width
+            # of it.
+            assert fused[k].percentile_50 == pytest.approx(42.0, abs=0.1)
+            assert fused[k].percentile_99 == pytest.approx(42.0, abs=0.1)
+
+    def test_five_percentiles_cross_packed_group(self):
+        """Q=5 exercises the second packed block-id word (4 ids per
+        int32)."""
+        noise_ops.seed_host_rng(0)
+        rng = np.random.default_rng(7)
+        data = [(u, "a", float(v))
+                for u, v in enumerate(rng.uniform(0, 100, 4000))]
+        params = self._percentile_params([10, 25, 50, 75, 90])
+        fused = run(JaxBackend(rng_seed=20), data, params)
+        vals = [v for _, _, v in data]
+        for p, name in [(10, "percentile_10"), (25, "percentile_25"),
+                        (50, "percentile_50"), (75, "percentile_75"),
+                        (90, "percentile_90")]:
+            assert getattr(fused["a"], name) == pytest.approx(
+                np.percentile(vals, p), abs=0.5)
+
     def test_monotone_across_quantiles_at_small_eps(self):
         noise_ops.seed_host_rng(0)
         rng = np.random.default_rng(3)
