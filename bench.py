@@ -95,8 +95,8 @@ def bench_config(name, params, fused_ds, local_rows, repeats=5):
             (run_once(pdp.LocalBackend(), ds_l, params)
              for _ in range(repeats)), key=lambda r: r[1])
         local_scaling.append((nl, round(nl / dt_l)))
-    local_dt = local_rows / local_scaling[-1][1]
-    local_rps = float(local_scaling[-1][1])
+    local_dt = dt_l  # measured at the largest size, last iteration
+    local_rps = local_rows / local_dt
 
     backend = JaxBackend(rng_seed=0)
     # First run pays compilation + the host->device transfer of the

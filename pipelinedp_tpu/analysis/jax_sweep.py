@@ -72,6 +72,9 @@ _MAX_TABLE = 1 << 16
 # Upper bound on configurations per compiled chunk (tests shrink this to
 # exercise the chunk loop).
 _CHUNK_CAP = 512
+# Row-broadcast budget per chunk: bounds the [n, Cc] stage-B footprint
+# (n_pad * chunk <= this).
+_CHUNK_ROW_BUDGET = 1 << 26
 
 
 def sweep_is_supported(options: data_structures.UtilityAnalysisOptions,
@@ -843,10 +846,16 @@ class LazySweepResult:
         per_partition = self._return_per_partition
         if per_partition:
             # Decide the host fallback BEFORE any device placement: the
-            # fetched [P, C] blocks' budget only needs the encode.
+            # fetched [P, C] blocks' budget only needs the encode, and
+            # the mesh gate needs nothing at all. The config axis is
+            # chunk-padded on device, so budget C + _CHUNK_CAP columns.
             n_metrics = sum(1 for m, _, _ in _METRIC_ORDER
                             if m in params.metrics)
-            if P_pad * C * (5 * n_metrics + 1) * 4 > _PP_BYTE_CAP:
+            pp_bytes = (P_pad * (C + _CHUNK_CAP) *
+                        (5 * n_metrics + 1) * 4)
+            if pp_bytes > _PP_BYTE_CAP or (
+                    self._mesh is not None and
+                    self._mesh.devices.size > 1):
                 return self._host_fallback()
 
         if options.pre_aggregated_data:
@@ -926,7 +935,7 @@ class LazySweepResult:
         # [P, Cc, 2·WINDOW+1] selection-window footprints.
         n_dev = self._mesh.devices.size if self._mesh is not None else 1
         chunk = int(np.clip(
-            min((1 << 26) // max(n_pad, 1),
+            min(_CHUNK_ROW_BUDGET // max(n_pad, 1),
                 (1 << 28) // max(P_pad * (2 * _WINDOW + 1), 1),
                 _pad_pow2(C, minimum=1)),  # don't pad tiny sweeps up
             1, _CHUNK_CAP))
@@ -944,12 +953,6 @@ class LazySweepResult:
             # the chunk's configuration axis.
             chunk = max(chunk // n_dev, 1) * n_dev
         users_in = jnp.where(real_pk, users_pk, -1)
-
-        if per_partition and n_dev > 1:
-            # Defensive: perform_utility_analysis routes mesh-backed
-            # per-partition sweeps to the host graph before any device
-            # work; direct constructors land here.
-            return self._host_fallback()
 
         # Pad every per-config vector to a chunk multiple (repeating the
         # last config) and place it on device ONCE; chunks then slice on
@@ -1182,6 +1185,11 @@ def build_fused_sweep(col, options, data_extractors, public_partitions,
     for metric in params.metrics:
         budgets[metric] = budget_accountant.request_budget(
             mechanism_type, weight=params.budget_weight)
+    if return_per_partition and backend is None:
+        raise ValueError(
+            "return_per_partition needs the pipeline backend (the "
+            "byte-capped host-graph fallback runs on it); pass "
+            "backend=... to build_fused_sweep")
     return LazySweepResult(col, options, data_extractors,
                            public_partitions, budgets, selection_budget,
                            mesh=mesh,

@@ -195,6 +195,79 @@ class TestStreamedDifferential:
         assert len(kept - {0, 1, 2, 3}) < 20
 
 
+class TestStreamedSelectPartitions:
+
+    def test_select_partitions_streams(self):
+        rng = np.random.default_rng(10)
+        n = 9_000
+        pk = np.concatenate([rng.integers(0, 6, n - 60),
+                             6 + np.arange(60) % 30])
+        ds = pdp.ArrayDataset(privacy_ids=np.arange(n),
+                              partition_keys=pk, values=None)
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=5.0,
+                                        total_delta=1e-6)
+        engine = pdp.DPEngine(acc, JaxBackend(rng_seed=0))
+        res = engine.select_partitions(
+            ds, pdp.SelectPartitionsParams(max_partitions_contributed=3),
+            pdp.DataExtractors())
+        acc.compute_budgets()
+        kept = set(res)
+        # ~1500-user partitions always keep; 1-2-user tails drop.
+        assert {0, 1, 2, 3, 4, 5} <= kept
+        assert len(kept - {0, 1, 2, 3, 4, 5}) < 10
+
+
+class TestStreamedFuzz:
+    """Randomized parameter points through the streamed path (the
+    streaming analogue of test_differential_fuzz): huge eps, non-binding
+    caps, public partitions — streamed results must equal the exact
+    aggregates partition by partition."""
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_config(self, seed):
+        rng = np.random.default_rng(2000 + seed)
+        n = int(rng.integers(4_000, 15_000))
+        users = int(rng.integers(200, 3_000))
+        parts = int(rng.integers(3, 25))
+        ds = pdp.ArrayDataset(
+            privacy_ids=rng.integers(0, users, n),
+            partition_keys=rng.integers(0, parts, n),
+            values=rng.uniform(0.0, 10.0, n))
+        combos = [
+            [pdp.Metrics.COUNT],
+            [pdp.Metrics.SUM, pdp.Metrics.COUNT],
+            [pdp.Metrics.MEAN, pdp.Metrics.VARIANCE],
+            [pdp.Metrics.PRIVACY_ID_COUNT, pdp.Metrics.SUM],
+        ]
+        metrics = combos[int(rng.integers(0, len(combos)))]
+        params = pdp.AggregateParams(
+            metrics=metrics,
+            noise_kind=(pdp.NoiseKind.LAPLACE if rng.random() < 0.5
+                        else pdp.NoiseKind.GAUSSIAN),
+            max_partitions_contributed=parts,
+            max_contributions_per_partition=200,
+            min_value=0.0, max_value=10.0)
+        got = run_streamed(ds, params, public=list(range(parts)),
+                           seed=seed)
+        pk, vals = ds.partition_keys, ds.values
+        for p in range(parts):
+            m = pk == p
+            if pdp.Metrics.COUNT in metrics:
+                assert got[p].count == pytest.approx(m.sum(), abs=0.5)
+            if pdp.Metrics.SUM in metrics:
+                assert got[p].sum == pytest.approx(vals[m].sum(),
+                                                   rel=1e-4, abs=0.1)
+            if pdp.Metrics.MEAN in metrics:
+                assert got[p].mean == pytest.approx(vals[m].mean(),
+                                                    abs=1e-3)
+            if pdp.Metrics.VARIANCE in metrics:
+                assert got[p].variance == pytest.approx(vals[m].var(),
+                                                        abs=0.05)
+            if pdp.Metrics.PRIVACY_ID_COUNT in metrics:
+                assert got[p].privacy_id_count == pytest.approx(
+                    len(np.unique(ds.privacy_ids[m])), abs=0.5)
+
+
 class TestStreamingInternals:
 
     def test_pid_batches_are_disjoint(self):
