@@ -1265,17 +1265,8 @@ def _percentile_values(config: FusedConfig, P, qrows, scale, key):
                     sub_hist = jax.lax.cond(n_rel <= cap, compacted,
                                             full, None)
                 else:  # non-default tree shapes: block ids > 8 bits
-                    subs = []
-                    for q in range(Q):
-                        rel = leaf - sub_start[:, q][qpk]
-                        ok = kept & (rel >= 0) & (rel < span)
-                        seg = qpk * span + jnp.clip(rel, 0, span - 1)
-                        subs.append(
-                            jax.ops.segment_sum(ok.astype(jnp.int32),
-                                                seg,
-                                                num_segments=P * span
-                                                ).reshape(P, span))
-                    sub_hist = jnp.stack(subs, axis=1)
+                    sub_hist = _subtree_counts(qpk, leaf, kept,
+                                               sub_start, P, span)
         if not below_hist:
             raw = counts_at(w, base)  # [P, Q, b]
         elif sub_hist is not None:
@@ -1291,16 +1282,41 @@ def _percentile_values(config: FusedConfig, P, qrows, scale, key):
             raw = jnp.take_along_axis(g, idx, axis=2).astype(jnp.float32)
         else:
             raw = counts_at(w, base)
-        node_ids = (level_offset + base)[..., None] + jnp.arange(
-            b, dtype=jnp.int32)
-        noisy = jnp.maximum(
-            raw + _node_noise(config.noise_kind, key, node_ids) * scale,
-            0.0)
-        lo, hi, target, leaf_lo, done = _walk_step(
-            noisy, lo, hi, target, leaf_lo, done, b, w)
+        lo, hi, target, leaf_lo, done = _walk_level(
+            config.noise_kind, key, scale, raw, base, level_offset, lo,
+            hi, target, leaf_lo, done, b, w)
         level_offset += b**(level + 1)
     vals = lo + (hi - lo) * target  # [P, Q]
     return _monotone_in_q(vals, quantiles)
+
+
+def _walk_level(noise_kind, key, scale, raw, base, level_offset, lo, hi,
+                target, leaf_lo, done, b, w):
+    """One walk level from its raw child counts: node-id-keyed noise +
+    descent step. SHARED by the single-batch walk, the owner-sharded
+    walk and the streamed two-pass walk — the streamed/single-batch
+    bit-parity guarantee rests on this being the one copy of the
+    noise-keying + step arithmetic."""
+    node_ids = (level_offset + base)[..., None] + jnp.arange(
+        b, dtype=jnp.int32)
+    noisy = jnp.maximum(
+        raw + _node_noise(noise_kind, key, node_ids) * scale, 0.0)
+    return _walk_step(noisy, lo, hi, target, leaf_lo, done, b, w)
+
+
+def _subtree_counts(qpk, leaf, kept, sub_start, P, span):
+    """Leaf counts of each quantile's chosen subtree from row data:
+    [P, Q, span] int32 (one masked scatter per quantile). Shared by the
+    single-batch generic fallback and the streamed pass-B kernel."""
+    subs = []
+    for q in range(sub_start.shape[1]):
+        rel = leaf - sub_start[:, q][qpk]
+        ok = kept & (rel >= 0) & (rel < span)
+        seg = qpk * span + jnp.clip(rel, 0, span - 1)
+        subs.append(jax.ops.segment_sum(ok.astype(jnp.int32), seg,
+                                        num_segments=P * span
+                                        ).reshape(P, span))
+    return jnp.stack(subs, axis=1)
 
 
 def _walk_step(noisy, lo, hi, target, leaf_lo, done, b, w):
@@ -1757,8 +1773,8 @@ class LazyFusedResult:
             # selection runs once on device, release below as usual.
             keep_np, part64, stream_stats = (
                 streaming.stream_partials_and_select(
-                    config, encoded, keep_table, thr, s_scale, min_count,
-                    rows_per_uid, self._rng_seed))
+                    config, encoded, scales, keep_table, thr, s_scale,
+                    min_count, rows_per_uid, self._rng_seed))
             self.timings["device_s"] = _time.perf_counter() - t1
             self.timings["stream_batches"] = stream_stats["n_batches"]
             t_rel = _time.perf_counter()
@@ -1768,6 +1784,10 @@ class LazyFusedResult:
             metric_arrays = _host_release(config, self._specs, part64,
                                           part64["privacy_id_count_raw"],
                                           rng)
+            for qi, name in enumerate(
+                    _percentile_field_names(config.percentiles)):
+                metric_arrays[name] = (
+                    stream_stats["percentile_values"][:P, qi])
             if self._public is not None:
                 rel_sel = vocab_idx = np.arange(P)
             else:
@@ -1952,8 +1972,8 @@ class LazySelectResult:
         from pipelinedp_tpu import streaming
         if streaming.should_stream(config, encoded.n_rows, self._mesh):
             keep_np, _, _ = streaming.stream_partials_and_select(
-                config, encoded, keep_table, thr, s_scale, min_count,
-                1.0, self._rng_seed)
+                config, encoded, np.zeros(1, np.float32), keep_table,
+                thr, s_scale, min_count, 1.0, self._rng_seed)
             vocab = encoded.pk_vocab
             return [vocab[i] for i in np.flatnonzero(keep_np[:P])]
         keep_pk, _, _ = _run_fused_kernel(

@@ -33,13 +33,20 @@ accumulation stays exact while the GLOBAL total stays below 2^53 steps
 is float64 rounding at relative 2^-53 — far below the per-row
 quantization already accepted by the single-batch kernel.
 
-Percentile metrics are excluded: the quantile-tree walk needs all of a
-partition's rows resident in one pass (``jax_engine._percentile_values``);
-percentile pipelines past the single-batch capacity raise instead.
+Percentile metrics stream in TWO passes (``stream_is_supported``): the
+walk's adaptive descent needs the chosen subtrees' leaf counts, which
+only exist after the top levels are walked — so pass A accumulates the
+additive mid-level tree histogram alongside the scalar partials, the
+top levels walk on it, pass B re-streams the same deterministic batches
+for the subtree leaf histograms, and the bottom levels finish. With the
+engine's seed the streamed walk reproduces the single-batch percentile
+values bit-for-bit (exact histograms + identical (pk, node)-keyed
+noise).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import os
 from typing import Dict, Optional, Tuple
@@ -63,8 +70,16 @@ def stream_chunk_rows() -> int:
 
 
 def stream_is_supported(config) -> bool:
-    """Percentiles need all rows of a partition on device in one pass."""
-    return not config.percentiles
+    """Every fused configuration streams. Percentiles stream in TWO
+    passes (the quantile walk's adaptive descent needs the chosen
+    subtrees' leaf counts, which only exist after the top levels are
+    walked): pass A accumulates the additive mid-level histogram and the
+    scalar partials, the top two levels walk on it, pass B re-streams
+    the same deterministic batches to accumulate the chosen subtrees'
+    leaf histograms, and the bottom levels finish — identical math (and,
+    with the same seed, identical PRNG node noise) to the single-batch
+    walk."""
+    return True
 
 
 def should_stream(config, n_rows: int, mesh) -> bool:
@@ -85,13 +100,22 @@ def _rank1_names(config, fx_bits: int):
     return sorted(names)
 
 
+def _tree_consts():
+    from pipelinedp_tpu.ops import quantile_tree as qt
+    b = qt.DEFAULT_BRANCHING_FACTOR
+    height = qt.DEFAULT_TREE_HEIGHT
+    return b, height, b * b, b**(height - 2)  # (b, height, n_mid, bucket_w)
+
+
 @functools.partial(jax.jit, static_argnames=("config", "num_partitions",
                                              "fx_bits", "n_pid_planes"))
 def _partials_kernel(config, num_partitions, planes, values, n_valid, key,
                      fx_bits, n_pid_planes):
     """One chunk's bounding + per-pk reduction, packed for the fetch:
     a [C+1, P] int32 stack (rank-1 columns in sorted-name order, the
-    privacy-id count last) and the rank-2 vector sums (or None).
+    privacy-id count last), the rank-2 vector sums (or None), and — for
+    percentile configs — the chunk's [P * n_mid] mid-level quantile-tree
+    histogram (additive across chunks; stays device-resident).
 
     Ids arrive as narrow byte planes (the tunneled host link runs at
     tens of MB/s — bytes are wall time, exactly as in
@@ -100,12 +124,101 @@ def _partials_kernel(config, num_partitions, planes, values, n_valid, key,
     pid = je._widen_ids(planes[:n_pid_planes])
     pk = je._widen_ids(planes[n_pid_planes:])
     valid = jnp.arange(pid.shape[0]) < n_valid
-    part, nseg, _ = je._partials(config, num_partitions, pid, pk, values,
-                                 valid, key, fx_bits)
+    part, nseg, qrows = je._partials(config, num_partitions, pid, pk,
+                                     values, valid, key, fx_bits)
     vec = part.pop("vector_sum", None)
     names = sorted(k for k in part)
     packed = jnp.stack([part[k] for k in names] + [nseg])
-    return packed, vec
+    mid = None
+    if config.percentiles:
+        _, _, n_mid, bucket_w = _tree_consts()
+        qpk, leaf, kept = qrows
+        mid = jax.ops.segment_sum(
+            kept.astype(jnp.int32),
+            qpk * n_mid + jnp.minimum(leaf // bucket_w, n_mid - 1),
+            num_segments=num_partitions * n_mid)
+    return packed, vec, mid
+
+
+@functools.partial(jax.jit, static_argnames=("config", "num_partitions",
+                                             "fx_bits", "n_pid_planes"))
+def _pct_sub_kernel(config, num_partitions, planes, values, n_valid, key,
+                    fx_bits, n_pid_planes, sub_start):
+    """Pass B: recompute the chunk's bounded rows (same key -> identical
+    bounding sample as pass A) and count leaves inside each quantile's
+    chosen subtree — [P, Q, span] int32, additive across chunks."""
+    pid = je._widen_ids(planes[:n_pid_planes])
+    pk = je._widen_ids(planes[n_pid_planes:])
+    valid = jnp.arange(pid.shape[0]) < n_valid
+    _, _, qrows = je._partials(config, num_partitions, pid, pk, values,
+                               valid, key, fx_bits)
+    qpk, leaf, kept = qrows
+    _, _, _, span = _tree_consts()
+    return je._subtree_counts(qpk, leaf, kept, sub_start,
+                              num_partitions, span)
+
+
+@functools.partial(jax.jit, static_argnames=("config", "P"))
+def _walk_top_kernel(config, P, mid, key, scale):
+    """Walk the levels the mid histogram serves (node width >= bucket_w)
+    — the streaming twin of ``jax_engine._percentile_values``' top-
+    histogram path, with the SAME node-noise keying so a streamed run
+    with the engine's seed matches the single-batch walk bit-for-bit."""
+    b, height, n_mid, bucket_w = _tree_consts()
+    quantiles = np.asarray([p / 100.0 for p in config.percentiles],
+                           np.float32)
+    Q = quantiles.shape[0]
+    mid = mid.reshape(P, n_mid)
+    lo = jnp.full((P, Q), float(config.min_value), jnp.float32)
+    hi = jnp.full((P, Q), float(config.max_value), jnp.float32)
+    target = jnp.broadcast_to(quantiles[None, :], (P, Q))
+    leaf_lo = jnp.zeros((P, Q), jnp.int32)
+    done = jnp.zeros((P, Q), bool)
+    level_offset = 0
+    # The mid histogram serves exactly the levels whose node width is
+    # >= bucket_w: levels 0 and 1 for ANY tree height (w = b^(h-1-l)
+    # >= b^(h-2) iff l <= 1).
+    for level in range(min(2, height)):
+        w = b**(height - 1 - level)
+        base = leaf_lo // w
+        g = w // bucket_w
+        lvl = mid if g == 1 else mid.T.reshape(n_mid // g, g, P).sum(1).T
+        idx = base[..., None] + jnp.arange(b)
+        raw = lvl[jnp.arange(P)[:, None, None], idx].astype(jnp.float32)
+        lo, hi, target, leaf_lo, done = je._walk_level(
+            config.noise_kind, key, scale, raw, base, level_offset, lo,
+            hi, target, leaf_lo, done, b, w)
+        level_offset += b**(level + 1)
+    return lo, hi, target, leaf_lo, done
+
+
+@functools.partial(jax.jit, static_argnames=("config", "P"))
+def _walk_bottom_kernel(config, P, sub, sub_start, lo, hi, target,
+                        leaf_lo, done, key, scale):
+    """Finish the walk from the accumulated [P, Q, span] subtree leaf
+    histograms (levels below the mid histogram)."""
+    b, height, n_mid, bucket_w = _tree_consts()
+    quantiles = np.asarray([p / 100.0 for p in config.percentiles],
+                           np.float32)
+    span = bucket_w
+    # All remaining levels (node width < bucket_w) read the [P, Q, span]
+    # subtree histograms — any height: within the subtree a width-w node
+    # is a contiguous group of w leaves.
+    level_offset = sum(b**(level + 1) for level in range(min(2, height)))
+    for level in range(min(2, height), height):
+        w = b**(height - 1 - level)
+        base = leaf_lo // w
+        g = sub if w == 1 else sub.reshape(P, sub.shape[1], span // w,
+                                           w).sum(-1)
+        off = (leaf_lo - sub_start) // w
+        idx = off[..., None] + jnp.arange(b)
+        raw = jnp.take_along_axis(g, idx, axis=2).astype(jnp.float32)
+        lo, hi, target, leaf_lo, done = je._walk_level(
+            config.noise_kind, key, scale, raw, base, level_offset, lo,
+            hi, target, leaf_lo, done, b, w)
+        level_offset += b**(level + 1)
+    vals = lo + (hi - lo) * target
+    return je._monotone_in_q(vals, quantiles)
 
 
 @functools.partial(jax.jit, static_argnames=("config", "num_partitions"))
@@ -142,13 +255,16 @@ def _batch_assignment(config, encoded, n_batches: int, seed: int):
     return order, counts
 
 
-def stream_partials_and_select(config, encoded, keep_table, sel_threshold,
-                               sel_scale, sel_min_count, sel_rows_per_uid,
-                               rng_seed: Optional[int]
+def stream_partials_and_select(config, encoded, scales, keep_table,
+                               sel_threshold, sel_scale, sel_min_count,
+                               sel_rows_per_uid, rng_seed: Optional[int]
                                ) -> Tuple[np.ndarray, Dict, Dict]:
     """Runs the streaming aggregation. Returns ``(keep[P_pad] bool,
     part64, stats)`` where ``part64`` holds the combined float64/int64
-    accumulator columns ready for ``jax_engine._host_release``."""
+    accumulator columns ready for ``jax_engine._host_release``; for
+    percentile configs ``stats["percentile_values"]`` carries the
+    [P_pad, Q] walked quantile values (pass B re-streams the batches —
+    see ``stream_is_supported``)."""
     from pipelinedp_tpu.ops import noise as noise_ops
 
     P = len(encoded.pk_vocab)
@@ -161,7 +277,19 @@ def stream_partials_and_select(config, encoded, keep_table, sel_threshold,
     key = jax.random.PRNGKey(seed)
     # Same key topology as the single-batch kernel: one bounding stream
     # (folded per batch), one selection stream.
-    k_bound, k_sel, _ = jax.random.split(key, 3)
+    k_bound, k_sel, k_noise = jax.random.split(key, 3)
+
+    if config.percentiles:
+        # Fail BEFORE streaming anything: the [P, Q, span] subtree block
+        # of pass B is sized by quantities known at entry.
+        _, _, _, span = _tree_consts()
+        sub_bytes = P_pad * len(config.percentiles) * span * 4
+        if sub_bytes > je._SUBHIST_BYTE_CAP:
+            raise NotImplementedError(
+                f"streamed percentiles need a [{P_pad}, "
+                f"{len(config.percentiles)}, {span}] subtree block "
+                f"({sub_bytes >> 20} MiB) — beyond the device budget; "
+                "reduce the partition count or the quantile list")
 
     order, counts = _batch_assignment(config, encoded, n_batches, seed)
     max_rows = int(counts.max()) if len(counts) else 1
@@ -190,54 +318,64 @@ def stream_partials_and_select(config, encoded, keep_table, sel_threshold,
     val_acc = {spec.name: np.zeros(P_pad, np.float64) for spec in layout}
     vec_acc = None
 
-    pid_spec = (je._plane_spec(int(encoded.pid.max(initial=0)))
-                if not config.bounds_already_enforced else "u16")
-    pk_spec = je._plane_spec(int(encoded.pk.max(initial=0)))
-    zeros_dev = None  # shared on-device zero values for COUNT-style runs
-    # Staging buffers are allocated once and reused across batches (only
-    # the stale tail needs re-zeroing); rows past n_valid are masked in
-    # the kernel, so the id content of the padding is irrelevant — but
-    # narrow-plane packing reads the whole buffer, so stale ids must not
-    # widen the plane spec (they can't: the spec is fixed globally).
-    pid_b = np.zeros(pad_rows, np.int32)
-    pk_b = np.zeros(pad_rows, np.int32)
-    values_b = None
-    if config.needs_values:
-        vshape = ((pad_rows, config.vector_size) if config.vector_size
-                  else (pad_rows,))
-        values_b = np.zeros(vshape, np.float32)
-    offset = 0
-    for b in range(n_batches):
-        cnt = int(counts[b])
-        rows = (slice(offset, offset + cnt) if order is None
-                else order[offset:offset + cnt])
-        offset += cnt
-        if cnt == 0:
-            continue
-        # Narrow byte planes, padded on host to the uniform batch shape
-        # (uniform shape = ONE compile for every batch).
-        if not config.bounds_already_enforced:
-            pid_b[:cnt] = encoded.pid[rows]
-        pk_b[:cnt] = encoded.pk[rows]
-        pid_planes = je._narrow_ids(pid_b, pid_spec)
-        pk_planes = je._narrow_ids(pk_b, pk_spec)
-        host = list(pid_planes) + list(pk_planes)
+    def batches():
+        """Ships the deterministic batch sequence to the device; pass A
+        and pass B (percentiles) iterate it identically. Staging buffers
+        are allocated once and reused across batches (only the stale
+        tail needs re-zeroing); rows past n_valid are masked in the
+        kernel, so the id content of the padding is irrelevant — but
+        narrow-plane packing reads the whole buffer, so stale ids must
+        not widen the plane spec (they can't: the spec is fixed
+        globally). Yields (b, planes, values_d, cnt, n_pid_planes)."""
+        pid_spec = (je._plane_spec(int(encoded.pid.max(initial=0)))
+                    if not config.bounds_already_enforced else "u16")
+        pk_spec = je._plane_spec(int(encoded.pk.max(initial=0)))
+        zeros_dev = None  # shared zero values for COUNT-style runs
+        pid_b = np.zeros(pad_rows, np.int32)
+        pk_b = np.zeros(pad_rows, np.int32)
+        values_b = None
         if config.needs_values:
-            values_b[:cnt] = encoded.values[rows]
-            values_b[cnt:] = 0.0
-            host.append(values_b)
-        dev = jax.device_put(tuple(host))  # one batched transfer
-        if config.needs_values:
-            planes, values_d = dev[:-1], dev[-1]
-        else:
-            planes = dev
-            if zeros_dev is None:
-                zeros_dev = jnp.zeros(pad_rows, jnp.float32)
-            values_d = zeros_dev
-        packed, vec = _partials_kernel(
+            vshape = ((pad_rows, config.vector_size)
+                      if config.vector_size else (pad_rows,))
+            values_b = np.zeros(vshape, np.float32)
+        offset = 0
+        for b in range(n_batches):
+            cnt = int(counts[b])
+            rows = (slice(offset, offset + cnt) if order is None
+                    else order[offset:offset + cnt])
+            offset += cnt
+            if cnt == 0:
+                continue
+            # Narrow byte planes, padded on host to the uniform batch
+            # shape (uniform shape = ONE compile for every batch).
+            if not config.bounds_already_enforced:
+                pid_b[:cnt] = encoded.pid[rows]
+            pk_b[:cnt] = encoded.pk[rows]
+            pid_planes = je._narrow_ids(pid_b, pid_spec)
+            pk_planes = je._narrow_ids(pk_b, pk_spec)
+            host = list(pid_planes) + list(pk_planes)
+            if config.needs_values:
+                values_b[:cnt] = encoded.values[rows]
+                values_b[cnt:] = 0.0
+                host.append(values_b)
+            dev = jax.device_put(tuple(host))  # one batched transfer
+            if config.needs_values:
+                planes, values_d = dev[:-1], dev[-1]
+            else:
+                planes = dev
+                if zeros_dev is None:
+                    zeros_dev = jnp.zeros(pad_rows, jnp.float32)
+                values_d = zeros_dev
+            yield b, planes, values_d, cnt, len(pid_planes)
+
+    mid_acc = None  # device [P_pad * n_mid] percentile mid histogram
+    for b, planes, values_d, cnt, n_pid_planes in batches():
+        packed, vec, mid = _partials_kernel(
             config, P_pad, planes, values_d, jnp.int32(cnt),
             jax.random.fold_in(k_bound, b), fx_bits,
-            n_pid_planes=len(pid_planes))
+            n_pid_planes=n_pid_planes)
+        if mid is not None:
+            mid_acc = mid if mid_acc is None else mid_acc + mid
         host = np.asarray(packed)  # [C+1, P_pad] int32, one transfer
         # Loud failure if the kernel's packed column set ever diverges
         # from the host-side name mirror (a silent mismatch would hand
@@ -271,11 +409,47 @@ def stream_partials_and_select(config, encoded, keep_table, sel_threshold,
         if nseg.max(initial=0) >= np.iinfo(np.int32).max:
             raise NotImplementedError(
                 "more than 2^31 privacy units in one partition")
+        # Selection never touches the percentile walk (that runs in
+        # pass B below, from histograms, not rows): strip the percentile
+        # list so _selection_and_metrics skips its row-based walk.
+        sel_config = dataclasses.replace(config, percentiles=())
         keep = np.asarray(_select_kernel(
-            config, P_pad, jnp.asarray(nseg.astype(np.int32)),
+            sel_config, P_pad, jnp.asarray(nseg.astype(np.int32)),
             jnp.asarray(keep_table), jnp.float32(sel_threshold),
             jnp.float32(sel_scale), jnp.float32(sel_min_count),
             jnp.float32(sel_rows_per_uid), k_sel))
     stats = {"n_batches": n_batches, "chunk_rows": chunk,
              "fx_bits": fx_bits, "max_batch_rows": max_rows}
+
+    if config.percentiles:
+        # Pass B: walk the mid histogram's levels, then re-stream the
+        # batches to count the chosen subtrees' leaves, then finish.
+        # Node noise is keyed exactly like the single-batch kernel
+        # (k_tree = fold_in(k_noise, 0x7ee) on the (pk, node) ids), so
+        # with non-binding caps a streamed run reproduces the single-
+        # batch percentile values bit-for-bit for the same seed.
+        # The histograms accumulate across chunks in device int32:
+        # a partition with >= 2^31 kept rows would wrap a bucket, so
+        # guard on the exact host-side per-partition counts.
+        if int(acc["count"].max(initial=0)) >= np.iinfo(np.int32).max:
+            raise NotImplementedError(
+                "streamed percentiles: a partition holds >= 2^31 kept "
+                "rows — beyond the int32 tree-histogram capacity")
+        k_tree = jax.random.fold_in(k_noise, 0x7ee)
+        scale = jnp.float32(np.asarray(scales)[-1])
+        lo, hi, target, leaf_lo, done = _walk_top_kernel(
+            config, P_pad, mid_acc, k_tree, scale)
+        sub_start = leaf_lo
+        sub_acc = None
+        for b, planes, values_d, cnt, n_pid_planes in batches():
+            sub = _pct_sub_kernel(
+                config, P_pad, planes, values_d, jnp.int32(cnt),
+                jax.random.fold_in(k_bound, b), fx_bits,
+                n_pid_planes=n_pid_planes, sub_start=sub_start)
+            sub_acc = sub if sub_acc is None else sub_acc + sub
+        vals = _walk_bottom_kernel(config, P_pad, sub_acc, sub_start,
+                                   lo, hi, target, leaf_lo, done,
+                                   k_tree, scale)
+        stats["percentile_values"] = np.asarray(vals)
+
     return keep, part64, stats

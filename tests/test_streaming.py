@@ -195,6 +195,87 @@ class TestStreamedDifferential:
         assert len(kept - {0, 1, 2, 3}) < 20
 
 
+class TestStreamedPercentiles:
+    """Percentiles stream in two passes (mid histogram + chosen-subtree
+    leaf histograms, both additive across batches); the walk math and
+    PRNG node-noise keying are shared with the single-batch kernel."""
+
+    def test_matches_exact_at_huge_eps(self):
+        rng = np.random.default_rng(20)
+        n = 18_000
+        vals = rng.uniform(0, 10, n)
+        pk = rng.integers(0, 6, n)
+        ds = pdp.ArrayDataset(privacy_ids=rng.integers(0, 4_000, n),
+                              partition_keys=pk, values=vals)
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.PERCENTILE(50), pdp.Metrics.PERCENTILE(90),
+                     pdp.Metrics.VARIANCE, pdp.Metrics.COUNT],
+            max_partitions_contributed=6,
+            max_contributions_per_partition=30,
+            min_value=0.0, max_value=10.0)
+        got = run_streamed(ds, params)
+        for p in range(6):
+            m = pk == p
+            e50, e90 = np.percentile(vals[m], [50, 90])
+            assert got[p].percentile_50 == pytest.approx(e50, abs=0.15)
+            assert got[p].percentile_90 == pytest.approx(e90, abs=0.15)
+            assert got[p].variance == pytest.approx(vals[m].var(),
+                                                    abs=0.05)
+            assert got[p].count == pytest.approx(m.sum(), abs=0.5)
+
+    def test_bit_parity_with_single_batch(self, monkeypatch):
+        """Same seed, non-binding caps: the streamed walk reproduces the
+        single-batch percentile values BIT-FOR-BIT at real noise scales
+        (exact additive histograms + identical (pk, node)-keyed noise)."""
+        rng = np.random.default_rng(21)
+        n = 10_000
+        ds = pdp.ArrayDataset(privacy_ids=rng.integers(0, 2_500, n),
+                              partition_keys=rng.integers(0, 4, n),
+                              values=rng.uniform(0, 10, n))
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.PERCENTILE(50),
+                     pdp.Metrics.PERCENTILE(95)],
+            max_partitions_contributed=4,
+            max_contributions_per_partition=50,
+            min_value=0.0, max_value=10.0)
+
+        def run_with_chunk(chunk):
+            monkeypatch.setenv("PIPELINEDP_TPU_STREAM_CHUNK", str(chunk))
+            ds.invalidate_cache()
+            acc = pdp.NaiveBudgetAccountant(total_epsilon=3.0,
+                                            total_delta=1e-6)
+            eng = pdp.DPEngine(acc, JaxBackend(rng_seed=7))
+            res = eng.aggregate(ds, params, pdp.DataExtractors(),
+                                public_partitions=list(range(4)))
+            acc.compute_budgets()
+            return dict(res), res.timings.get("stream_batches", 0)
+
+        streamed, nb = run_with_chunk(997)
+        single, nb2 = run_with_chunk(1 << 26)
+        assert nb > 5 and nb2 == 0
+        for p in range(4):
+            assert streamed[p].percentile_50 == single[p].percentile_50
+            assert streamed[p].percentile_95 == single[p].percentile_95
+
+    def test_private_selection_with_percentiles(self):
+        rng = np.random.default_rng(22)
+        n = 8_000
+        pk = rng.integers(0, 5, n)
+        vals = rng.uniform(0, 10, n)
+        ds = pdp.ArrayDataset(privacy_ids=np.arange(n),
+                              partition_keys=pk, values=vals)
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.PERCENTILE(50)],
+            max_partitions_contributed=5,
+            max_contributions_per_partition=1,
+            min_value=0.0, max_value=10.0)
+        got = run_streamed(ds, params, eps=1e6, delta=1e-3)
+        assert set(got) == set(range(5))
+        for p in range(5):
+            assert got[p].percentile_50 == pytest.approx(
+                np.percentile(vals[pk == p], 50), abs=0.2)
+
+
 class TestStreamedSelectPartitions:
 
     def test_select_partitions_streams(self):
