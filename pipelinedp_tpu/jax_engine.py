@@ -804,10 +804,10 @@ def _fx_plan(n_rows_total: int) -> Tuple[int, int]:
         raise NotImplementedError(
             f"fixed-point value lanes support up to 2^27 rows per "
             f"BATCH (got {n_rows_total}). The engine streams larger "
-            "pipelines automatically (pipelinedp_tpu.streaming) unless "
-            "percentiles are requested (the quantile walk needs all of "
-            "a partition's rows in one batch) or a mesh is set; split "
-            "the input or drop the percentile metrics")
+            "pipelines automatically (pipelinedp_tpu.streaming, "
+            "including percentiles) unless a mesh is set; reaching this "
+            "from the streaming path means one privacy unit owns that "
+            "many rows (its rows cannot split across batches)")
     return bits, -(-_FX_PAYLOAD_BITS // bits)
 
 
@@ -1151,18 +1151,7 @@ def _percentile_values(config: FusedConfig, P, qrows, scale, key):
         """Noiseless child counts [P, Q, b] of the walk nodes whose
         children have width ``w``."""
         if hist is not None and w >= bucket_w:
-            # Children are contiguous groups of g histogram buckets. The
-            # group sum runs in transposed layout ([groups, g, P]) — a
-            # [P, groups, g] reshape would leave a tiny trailing dim that
-            # TPU tiling pads ~8x.
-            g = w // bucket_w
-            if g == 1:
-                lvl = hist
-            else:
-                lvl = hist.T.reshape(n_mid // g, g, P).sum(1).T
-            idx = base[..., None] + jnp.arange(b)  # [P, Q, b]
-            return lvl[jnp.arange(P)[:, None, None], idx].astype(
-                jnp.float32)
+            return _mid_level_counts(hist, base, w, bucket_w, b)
         # Fallback for the lower levels: per-quantile row passes (an
         # interleaved [n*Q] scatter benches slower than Q separate [n]
         # scatters on TPU).
@@ -1270,16 +1259,7 @@ def _percentile_values(config: FusedConfig, P, qrows, scale, key):
         if not below_hist:
             raw = counts_at(w, base)  # [P, Q, b]
         elif sub_hist is not None:
-            span = sub_hist.shape[-1]
-            if w == 1:
-                g = sub_hist
-            else:
-                g = sub_hist.reshape(P, Q, span // w, w).sum(-1)
-            # Children occupy w-groups [off + c] for c < b, where off is
-            # the current node's group offset inside the subtree.
-            off = (leaf_lo - sub_start) // w  # [P, Q]
-            idx = off[..., None] + jnp.arange(b)  # [P, Q, b]
-            raw = jnp.take_along_axis(g, idx, axis=2).astype(jnp.float32)
+            raw = _sub_level_counts(sub_hist, sub_start, leaf_lo, w, b)
         else:
             raw = counts_at(w, base)
         lo, hi, target, leaf_lo, done = _walk_level(
@@ -1288,6 +1268,33 @@ def _percentile_values(config: FusedConfig, P, qrows, scale, key):
         level_offset += b**(level + 1)
     vals = lo + (hi - lo) * target  # [P, Q]
     return _monotone_in_q(vals, quantiles)
+
+
+def _mid_level_counts(mid, base, w, bucket_w, b):
+    """Child counts [P, Q, b] of width-``w`` walk nodes read from the
+    [P, n_mid] mid-level histogram (``w >= bucket_w``): children are
+    contiguous groups of ``w/bucket_w`` buckets. The group sum runs in
+    transposed layout ([groups, g, P]) — a [P, groups, g] reshape would
+    leave a tiny trailing dim that TPU tiling pads ~8x. SHARED by the
+    single-batch top-histogram path and the streamed top walk."""
+    P, n_mid = mid.shape
+    g = w // bucket_w
+    lvl = mid if g == 1 else mid.T.reshape(n_mid // g, g, P).sum(1).T
+    idx = base[..., None] + jnp.arange(b)  # [P, Q, b]
+    return lvl[jnp.arange(P)[:, None, None], idx].astype(jnp.float32)
+
+
+def _sub_level_counts(sub, sub_start, leaf_lo, w, b):
+    """Child counts [P, Q, b] of width-``w`` nodes read from the
+    [P, Q, span] subtree leaf histograms: children occupy w-groups
+    [off + c] for c < b, where off is the node's group offset inside
+    the subtree. SHARED by the single-batch sub-histogram path and the
+    streamed bottom walk."""
+    P, Q, span = sub.shape
+    g = sub if w == 1 else sub.reshape(P, Q, span // w, w).sum(-1)
+    off = (leaf_lo - sub_start) // w  # [P, Q]
+    idx = off[..., None] + jnp.arange(b)  # [P, Q, b]
+    return jnp.take_along_axis(g, idx, axis=2).astype(jnp.float32)
 
 
 def _walk_level(noise_kind, key, scale, raw, base, level_offset, lo, hi,

@@ -39,9 +39,11 @@ only exist after the top levels are walked — so pass A accumulates the
 additive mid-level tree histogram alongside the scalar partials, the
 top levels walk on it, pass B re-streams the same deterministic batches
 for the subtree leaf histograms, and the bottom levels finish. With the
-engine's seed the streamed walk reproduces the single-batch percentile
-values bit-for-bit (exact histograms + identical (pk, node)-keyed
-noise).
+engine's seed the streamed walk sees the same exact histograms and the
+same (pk, node)-keyed noise as the single-batch walk; values agree up
+to float32 tie-breaking (separate XLA programs may fuse the descent
+arithmetic differently in the last bit, which can flip a child pick
+whose noisy rank sits exactly on a boundary).
 """
 
 from __future__ import annotations
@@ -76,9 +78,8 @@ def stream_is_supported(config) -> bool:
     walked): pass A accumulates the additive mid-level histogram and the
     scalar partials, the top two levels walk on it, pass B re-streams
     the same deterministic batches to accumulate the chosen subtrees'
-    leaf histograms, and the bottom levels finish — identical math (and,
-    with the same seed, identical PRNG node noise) to the single-batch
-    walk."""
+    leaf histograms, and the bottom levels finish — the same math and
+    the same PRNG node noise as the single-batch walk."""
     return True
 
 
@@ -181,10 +182,7 @@ def _walk_top_kernel(config, P, mid, key, scale):
     for level in range(min(2, height)):
         w = b**(height - 1 - level)
         base = leaf_lo // w
-        g = w // bucket_w
-        lvl = mid if g == 1 else mid.T.reshape(n_mid // g, g, P).sum(1).T
-        idx = base[..., None] + jnp.arange(b)
-        raw = lvl[jnp.arange(P)[:, None, None], idx].astype(jnp.float32)
+        raw = je._mid_level_counts(mid, base, w, bucket_w, b)
         lo, hi, target, leaf_lo, done = je._walk_level(
             config.noise_kind, key, scale, raw, base, level_offset, lo,
             hi, target, leaf_lo, done, b, w)
@@ -208,11 +206,7 @@ def _walk_bottom_kernel(config, P, sub, sub_start, lo, hi, target,
     for level in range(min(2, height), height):
         w = b**(height - 1 - level)
         base = leaf_lo // w
-        g = sub if w == 1 else sub.reshape(P, sub.shape[1], span // w,
-                                           w).sum(-1)
-        off = (leaf_lo - sub_start) // w
-        idx = off[..., None] + jnp.arange(b)
-        raw = jnp.take_along_axis(g, idx, axis=2).astype(jnp.float32)
+        raw = je._sub_level_counts(sub, sub_start, leaf_lo, w, b)
         lo, hi, target, leaf_lo, done = je._walk_level(
             config.noise_kind, key, scale, raw, base, level_offset, lo,
             hi, target, leaf_lo, done, b, w)
@@ -426,8 +420,8 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
         # batches to count the chosen subtrees' leaves, then finish.
         # Node noise is keyed exactly like the single-batch kernel
         # (k_tree = fold_in(k_noise, 0x7ee) on the (pk, node) ids), so
-        # with non-binding caps a streamed run reproduces the single-
-        # batch percentile values bit-for-bit for the same seed.
+        # with non-binding caps a streamed run matches the single-batch
+        # percentile values for the same seed, up to f32 tie-breaking.
         # The histograms accumulate across chunks in device int32:
         # a partition with >= 2^31 kept rows would wrap a bucket, so
         # guard on the exact host-side per-partition counts.
