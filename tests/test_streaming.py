@@ -223,16 +223,94 @@ class TestStreamedPercentiles:
                                                     abs=0.05)
             assert got[p].count == pytest.approx(m.sum(), abs=0.5)
 
+    def test_histograms_are_exactly_additive(self, monkeypatch):
+        """The precision claim ("the streamed walk sees the same exact
+        histograms") asserted EXACTLY: the mid-level histogram and the
+        subtree leaf histograms accumulated over many tiny batches equal
+        the single-batch computation bit-for-bit (non-binding caps, so
+        bounding keeps every row on both sides)."""
+        import jax
+        import jax.numpy as jnp
+        from pipelinedp_tpu import jax_engine as je
+        from pipelinedp_tpu import streaming as sm
+
+        rng = np.random.default_rng(30)
+        n = 6_000
+        ds = pdp.ArrayDataset(privacy_ids=rng.integers(0, 1_500, n),
+                              partition_keys=rng.integers(0, 4, n),
+                              values=rng.uniform(0, 10, n))
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.PERCENTILE(50),
+                     pdp.Metrics.PERCENTILE(90)],
+            max_partitions_contributed=4,
+            max_contributions_per_partition=50,
+            min_value=0.0, max_value=10.0)
+        config = je.FusedConfig.from_params(params, public=True)
+        encoded = je._encode_arrays(ds, None, list(range(4)),
+                                    require_pid=True)
+        P_pad = je._pad_pow2(len(encoded.pk_vocab))
+        key = jax.random.PRNGKey(5)
+        _, _, n_mid, span = sm._tree_consts()
+        sub_start = jnp.asarray(
+            (np.arange(P_pad)[:, None] % 4 * span * np.ones(
+                (1, 2))).astype(np.int32))
+
+        def run_chunks(chunk):
+            monkeypatch.setenv("PIPELINEDP_TPU_STREAM_CHUNK", str(chunk))
+            n_batches = max(1, -(-n // chunk))
+            order, counts = sm._batch_assignment(config, encoded,
+                                                 n_batches, 5)
+            pad_rows = je._pad_rows(int(counts.max()))
+            mid_acc = None
+            sub_acc = None
+            offset = 0
+            for b in range(n_batches):
+                cnt = int(counts[b])
+                rows = (slice(offset, offset + cnt) if order is None
+                        else order[offset:offset + cnt])
+                offset += cnt
+                pid_b = np.zeros(pad_rows, np.int32)
+                pk_b = np.zeros(pad_rows, np.int32)
+                pid_b[:cnt] = encoded.pid[rows]
+                pk_b[:cnt] = encoded.pk[rows]
+                vals_b = np.zeros(pad_rows, np.float32)
+                vals_b[:cnt] = encoded.values[rows]
+                planes = (je._narrow_ids(pid_b, "u16") +
+                          je._narrow_ids(pk_b, "u16"))
+                kb = jax.random.fold_in(jax.random.PRNGKey(5), b)
+                _, _, mid = sm._partials_kernel(
+                    config, P_pad, planes, jnp.asarray(vals_b),
+                    jnp.int32(cnt), kb, 12, n_pid_planes=len(planes) - 1)
+                sub = sm._pct_sub_kernel(
+                    config, P_pad, planes, jnp.asarray(vals_b),
+                    jnp.int32(cnt), kb, 12,
+                    n_pid_planes=len(planes) - 1, sub_start=sub_start)
+                mid_acc = mid if mid_acc is None else mid_acc + mid
+                sub_acc = sub if sub_acc is None else sub_acc + sub
+            return np.asarray(mid_acc), np.asarray(sub_acc)
+
+        # Caps non-binding -> bounding keeps every row regardless of the
+        # per-batch sampling keys, so one batch and 10 batches must
+        # produce IDENTICAL integer histograms.
+        mid_many, sub_many = run_chunks(599)
+        mid_one, sub_one = run_chunks(1 << 26)
+        np.testing.assert_array_equal(mid_many, mid_one)
+        np.testing.assert_array_equal(sub_many, sub_one)
+        assert int(mid_one.sum()) == n  # every row counted exactly once
+
     def test_walk_parity_with_single_batch(self, monkeypatch):
         """Same seed, non-binding caps: the streamed walk sees the same
-        exact histograms and the same (pk, node)-keyed noise as the
-        single-batch walk. The two walks are separate XLA programs whose
-        codegen (FMA fusion) may differ in the last float32 bit; when a
-        noisy rank comparison sits within an ulp of a child boundary
-        that last bit can flip the picked child — the same tie quirk
-        ``TestFusedPercentile`` documents — so the tolerance is one
-        level-2 child width (256 leaves ~ 0.04 of the [0, 10] range),
-        not bit equality."""
+        exact histograms (pinned bit-exactly by
+        ``test_histograms_are_exactly_additive``) and the same
+        (pk, node)-keyed noise as the single-batch walk. The two walks
+        are separate XLA programs whose codegen (FMA fusion) may differ
+        in the last float32 bit; when a noisy rank comparison sits
+        within an ulp of a child boundary that last bit can flip the
+        picked child — the same tie quirk ``TestFusedPercentile``
+        documents — and a flip at the top level diverges by up to a
+        4096-leaf parent width (~0.63 on [0, 10]), hence the loose
+        value tolerance here; the precision burden lives in the
+        histogram test above."""
         rng = np.random.default_rng(21)
         n = 10_000
         ds = pdp.ArrayDataset(privacy_ids=rng.integers(0, 2_500, n),
@@ -261,9 +339,9 @@ class TestStreamedPercentiles:
         assert nb > 5 and nb2 == 0
         for p in range(4):
             assert streamed[p].percentile_50 == pytest.approx(
-                single[p].percentile_50, abs=0.05)
+                single[p].percentile_50, abs=0.7)
             assert streamed[p].percentile_95 == pytest.approx(
-                single[p].percentile_95, abs=0.05)
+                single[p].percentile_95, abs=0.7)
 
     def test_private_selection_with_percentiles(self):
         rng = np.random.default_rng(22)
