@@ -297,6 +297,16 @@ class AggregateParams:
                 raise ValueError(f"max_{what} must be a number")
             if lo is not None and hi is not None and lo > hi:
                 raise ValueError(f"min_{what} must be <= max_{what}")
+        # Percentiles subdivide the clip range into quantile-tree
+        # leaves: a zero-width range has no subdivision (the host tree
+        # ctor rejects it too, but deep in the pipeline — fail at
+        # params construction with the cause named).
+        if (any(m.is_percentile for m in (self.metrics or [])) and
+                self.min_value is not None and
+                self.min_value == self.max_value):
+            raise ValueError(
+                "PERCENTILE metrics need min_value < max_value "
+                "(a zero-width clip range has no quantile structure)")
 
     def _validate_vector_params(self):
         if Metrics.VECTOR_SUM not in (self.metrics or []):

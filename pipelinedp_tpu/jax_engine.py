@@ -162,11 +162,23 @@ def params_are_fusable(params: AggregateParams) -> bool:
     # bounds-already-enforced mode no bounding runs anywhere.)
     for m in params.metrics:
         if m.is_percentile:
-            # The quantile walk needs real tree bounds; a degenerate
-            # interval falls through to the generic path, which raises the
-            # same error the host tree would.
+            # The quantile walk needs real tree bounds. min_value may be
+            # None here (sum-per-partition bounds mode); a zero-width
+            # range never arrives (AggregateParams rejects it for
+            # percentiles at construction). A pathologically tiny (but
+            # valid) range falls through to the generic host path: the
+            # fused leaf arithmetic folds n_leaves/range into ONE f32
+            # constant (see ``_qrows`` for why), which overflows for
+            # range < ~1.9e-34 — the host tree computes in f64 and
+            # handles those ranges fine.
             if (params.min_value is None or
                     not params.min_value < params.max_value):
+                return False
+            n_leaves = (quantile_tree_ops.DEFAULT_BRANCHING_FACTOR **
+                        quantile_tree_ops.DEFAULT_TREE_HEIGHT)
+            inv = n_leaves / (float(params.max_value) -
+                              float(params.min_value))
+            if inv > float(np.finfo(np.float32).max):
                 return False
         elif m.name not in FUSABLE_METRICS:
             return False
@@ -974,14 +986,32 @@ def _fold_fixedpoint(config: FusedConfig, part64, fx_bits: int) -> None:
 def _qrows(config: FusedConfig, pk, values, kept):
     """Percentile row view: (pk, leaf index, kept mask) per row, in
     whatever row order the caller is in. The leaf mapping mirrors the host
-    tree (``ops/quantile_tree.py:_leaf_index``)."""
+    tree (``ops/quantile_tree.py:_leaf_index``).
+
+    The leaf arithmetic is one f32 subtract and one f32 multiply by a
+    host-folded constant — deliberately: the streamed pass-A and pass-B
+    kernels are SEPARATE XLA programs that re-derive each row's leaf, and
+    a division (whose lowering can vary with fusion context) or a
+    fusible mul+add pair (FMA) could round differently across programs,
+    silently mis-bucketing boundary values between the passes. Neither
+    op here is re-fusible (sub->mul is not an FMA pattern), so every
+    program computes the identical IEEE sequence."""
     b = quantile_tree_ops.DEFAULT_BRANCHING_FACTOR
     height = quantile_tree_ops.DEFAULT_TREE_HEIGHT
     n_leaves = b**height
     lower, upper = config.min_value, config.max_value
     v = jnp.clip(values, lower, upper)
-    frac = (v - lower) / (upper - lower)
-    leaf = jnp.minimum((frac * n_leaves).astype(jnp.int32), n_leaves - 1)
+    rng = float(upper) - float(lower)
+    inv_range = np.float32(float(n_leaves) / rng) if rng > 0 else None
+    # ``params_are_fusable`` routes degenerate (lower >= upper) and
+    # pathologically tiny ranges (f32-overflowing constant) to the host
+    # path, which computes in f64; a non-finite constant here means a
+    # FusedConfig was constructed around that guard.
+    assert inv_range is not None and np.isfinite(inv_range), (
+        f"fused percentile range [{lower}, {upper}] has no finite f32 "
+        "leaf constant — params_are_fusable should have rejected it")
+    leaf = jnp.minimum(((v - lower) * inv_range).astype(jnp.int32),
+                       n_leaves - 1)
     return (jnp.where(kept, pk, 0), leaf, kept)
 
 

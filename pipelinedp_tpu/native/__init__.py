@@ -11,8 +11,11 @@ against this; this package is the TPU framework's native twin:
   mechanism over a ChaCha20 CSPRNG,
 * ``discrete_laplace(counts, scale)`` — exact two-sided geometric noise
   for integer releases (no float noise bits at all),
-* ``discrete_gaussian(counts, sigma)`` — exact discrete-Gaussian noise
-  (Canonne–Kamath–Steinke sampler) for integer releases,
+* ``discrete_gaussian(counts, sigma)`` — discrete-Gaussian noise
+  (Canonne–Kamath–Steinke sampler) for integer releases; the support is
+  exactly the integers, and the acceptance probabilities are realized
+  to 2^-53 (double-precision Bernoulli coins) rather than CKS's exact
+  rationals — a deviation below any expressible (eps, delta),
 * ``secure_gaussian(values, sigma, bound)`` — granularity-snapped
   discrete-Gaussian release for real values (the Gaussian twin of the
   snapping mechanism),
@@ -194,9 +197,14 @@ def discrete_laplace(counts, scale: float) -> np.ndarray:
 
 
 def discrete_gaussian(counts, sigma: float) -> np.ndarray:
-    """Integer release: counts + exact discrete-Gaussian noise of
-    standard deviation ~``sigma`` (Canonne–Kamath–Steinke sampler) — no
-    floating-point noise bits. ``sigma`` must be in (0, 2^40)."""
+    """Integer release: counts + discrete-Gaussian noise of standard
+    deviation ~``sigma`` (Canonne–Kamath–Steinke sampler) — no
+    floating-point noise bits in the RELEASE (the support is exactly
+    the integers). The sampler's acceptance coins are double-precision
+    Bernoullis, so acceptance probabilities are realized to 2^-53
+    rather than CKS's exact rationals (see ``secure_noise.cc``) — the
+    distributional deviation is negligible for any expressible
+    (eps, delta). ``sigma`` must be in (0, 2^40)."""
     if not 0 < sigma < 2.0**40:
         raise ValueError("sigma must be in (0, 2^40)")
     vals = np.asarray(counts, dtype=np.int64)
@@ -216,8 +224,10 @@ def secure_gaussian(values, sigma: float,
                     bound: Optional[float] = None) -> np.ndarray:
     """Hardened Gaussian release of ``values`` with noise std ``sigma``:
     the value is snapped to a power-of-two granularity g (sized so
-    sigma/g is in (2^39, 2^40]) and g-scaled exact discrete-Gaussian
-    noise is added, so the release's support is the g-grid — the
+    sigma/g is in (2^39, 2^40]) and g-scaled discrete-Gaussian noise
+    (integer-supported; acceptance coins realized to 2^-53 — see
+    :func:`discrete_gaussian`) is added, so the release's support is
+    the g-grid — the
     Gaussian twin of :func:`snapping_laplace`, replacing the reference's
     PyDP secure GaussianMechanism (reference
     ``pipeline_dp/dp_computations.py:127-143``). Same default clamp

@@ -317,10 +317,11 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
         and pass B (percentiles) iterate it identically. Staging buffers
         are allocated once and reused across batches (only the stale
         tail needs re-zeroing); rows past n_valid are masked in the
-        kernel, so the id content of the padding is irrelevant — but
-        narrow-plane packing reads the whole buffer, so stale ids must
-        not widen the plane spec (they can't: the spec is fixed
-        globally). Yields (b, planes, values_d, cnt, n_pid_planes)."""
+        kernel — and the id/value tails are ALSO re-zeroed each batch,
+        so no invariant rests on padding content: neither a future
+        kernel reading ids before masking nor the narrow-plane packing
+        (which reads the whole buffer) can see a stale id. Yields
+        (b, planes, values_d, cnt, n_pid_planes)."""
         pid_spec = (je._plane_spec(int(encoded.pid.max(initial=0)))
                     if not config.bounds_already_enforced else "u16")
         pk_spec = je._plane_spec(int(encoded.pk.max(initial=0)))
@@ -341,10 +342,16 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
             if cnt == 0:
                 continue
             # Narrow byte planes, padded on host to the uniform batch
-            # shape (uniform shape = ONE compile for every batch).
+            # shape (uniform shape = ONE compile for every batch). Id
+            # tails are re-zeroed too: the kernel masks on n_valid, but
+            # a stale id from a larger earlier batch must never be able
+            # to leak into a future kernel that reads ids before
+            # masking (the cost is noise next to the host link).
             if not config.bounds_already_enforced:
                 pid_b[:cnt] = encoded.pid[rows]
+                pid_b[cnt:] = 0
             pk_b[:cnt] = encoded.pk[rows]
+            pk_b[cnt:] = 0
             pid_planes = je._narrow_ids(pid_b, pid_spec)
             pk_planes = je._narrow_ids(pk_b, pk_spec)
             host = list(pid_planes) + list(pk_planes)

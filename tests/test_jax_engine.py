@@ -397,6 +397,26 @@ class TestFusedPercentile:
         assert fused["a"].percentile_50 == pytest.approx(
             local["a"].percentile_50, abs=0.2)
 
+    def test_degenerate_clip_range_rejected_at_params(self):
+        """A zero-width clip range with percentiles fails at params
+        construction with the cause named — not as a trace-time
+        ZeroDivisionError or a ctor error deep in the pipeline."""
+        with pytest.raises(ValueError, match="min_value < max_value"):
+            self._percentile_params([50, 90], min_value=5.0,
+                                    max_value=5.0)
+
+    def test_tiny_clip_range_falls_back_to_host_path(self):
+        """A valid but pathologically tiny range overflows the fused
+        leaf constant in f32 — fusability must route it to the host
+        path (f64), which still produces in-range percentiles."""
+        from pipelinedp_tpu import jax_engine
+        params = self._percentile_params([50], min_value=0.0,
+                                         max_value=1e-35)
+        assert not jax_engine.params_are_fusable(params)
+        data = [(u, "a", 0.5e-35) for u in range(200)]
+        fused = run(JaxBackend(rng_seed=29), data, params)
+        assert 0.0 <= fused["a"].percentile_50 <= 1e-35
+
     def test_all_equal_values_hit_compaction_fallback(self):
         """Every row carries the same value, so every kept row lands in
         each walk's chosen subtree — the sub-histogram compaction prefix
