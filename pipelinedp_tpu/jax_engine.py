@@ -805,6 +805,13 @@ _FX_OFFSET = 1 << 23
 _FX_PAYLOAD_BITS = 24  # offset-shifted u fits 24 bits (u <= 2^24 - 1)
 
 
+def _fx_max_rows() -> int:
+    """Largest per-batch GLOBAL row count the narrowest (4-bit) lane
+    plan accumulates exactly — the streaming chunk sizer caps per-batch
+    targets here so value pipelines never plan an impossible batch."""
+    return ((1 << 31) - 1) // 15
+
+
 def _fx_plan(n_rows_total: int) -> Tuple[int, int]:
     """(lane_bits, n_lanes) for a pipeline with ``n_rows_total`` rows
     across all devices — the cross-device psum adds per-shard lane sums,
@@ -817,9 +824,9 @@ def _fx_plan(n_rows_total: int) -> Tuple[int, int]:
             f"fixed-point value lanes support up to 2^27 rows per "
             f"BATCH (got {n_rows_total}). The engine streams larger "
             "pipelines automatically (pipelinedp_tpu.streaming, "
-            "including percentiles) unless a mesh is set; reaching this "
-            "from the streaming path means one privacy unit owns that "
-            "many rows (its rows cannot split across batches)")
+            "including percentiles, with or without a mesh); reaching "
+            "this from the streaming path means one privacy unit owns "
+            "that many rows (its rows cannot split across batches)")
     return bits, -(-_FX_PAYLOAD_BITS // bits)
 
 
@@ -1811,7 +1818,8 @@ class LazyFusedResult:
             keep_np, part64, stream_stats = (
                 streaming.stream_partials_and_select(
                     config, encoded, scales, keep_table, thr, s_scale,
-                    min_count, rows_per_uid, self._rng_seed))
+                    min_count, rows_per_uid, self._rng_seed,
+                    mesh=self._mesh))
             self.timings["device_s"] = _time.perf_counter() - t1
             self.timings["stream_batches"] = stream_stats["n_batches"]
             t_rel = _time.perf_counter()
@@ -2010,7 +2018,8 @@ class LazySelectResult:
         if streaming.should_stream(config, encoded.n_rows, self._mesh):
             keep_np, _, _ = streaming.stream_partials_and_select(
                 config, encoded, np.zeros(1, np.float32), keep_table,
-                thr, s_scale, min_count, 1.0, self._rng_seed)
+                thr, s_scale, min_count, 1.0, self._rng_seed,
+                mesh=self._mesh)
             vocab = encoded.pk_vocab
             return [vocab[i] for i in np.flatnonzero(keep_np[:P])]
         keep_pk, _, _ = _run_fused_kernel(

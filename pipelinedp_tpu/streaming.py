@@ -83,11 +83,36 @@ def stream_is_supported(config) -> bool:
     return True
 
 
+def chunk_target_rows(config, n_dev: int) -> int:
+    """Per-batch GLOBAL row target: the per-device chunk knob times the
+    mesh size, capped by the per-batch fixed-point lane capacity for
+    value configs — the mesh psum combines int32 shard lanes, so lane
+    capacity is a global per-batch bound that device count cannot
+    raise. Without the cap an 8-device mesh at the default knob would
+    target 2^29-row batches that ``_fx_plan`` must reject. Every
+    config is also capped at int32 capacity: the per-partition count /
+    privacy-id-count columns are int32 psums of per-shard segment
+    sums, so one batch holding >= 2^31 rows of one partition (possible
+    at the default knob on a >= 32-device mesh) would silently wrap
+    them."""
+    chunk = min(stream_chunk_rows() * n_dev, (1 << 31) - 1)
+    if je._fixedpoint_layout(config):
+        chunk = min(chunk, je._fx_max_rows())
+    return chunk
+
+
 def should_stream(config, n_rows: int, mesh) -> bool:
-    """The engine streams when one batch can't hold the pipeline. On a
-    mesh the per-device batch is the shard, which scales with the mesh;
-    streaming composes with sharding in a later round if needed."""
-    return (mesh is None and n_rows > stream_chunk_rows() and
+    """The engine streams when one batch can't hold the pipeline.
+    Streaming COMPOSES with a mesh: each chunk's rows are sharded by
+    privacy id over the mesh exactly like the single-batch sharded
+    kernel, the per-pk partials ride ONE ``psum_scatter`` to owner
+    blocks per chunk, and the owner blocks (additive across chunks)
+    fold into the same host accumulators as the single-device stream.
+    On a mesh the per-chunk row budget scales with the device count
+    (up to the global lane capacity): every device still sees at most
+    ``stream_chunk_rows()`` rows."""
+    n_dev = mesh.devices.size if mesh is not None else 1
+    return (n_rows > chunk_target_rows(config, n_dev) and
             stream_is_supported(config))
 
 
@@ -108,36 +133,59 @@ def _tree_consts():
     return b, height, b * b, b**(height - 2)  # (b, height, n_mid, bucket_w)
 
 
+def _chunk_body(config, num_partitions, planes, values, n_valid, key,
+                fx_bits, n_pid_planes):
+    """The shared per-chunk trace: widen the narrow id planes, derive
+    the validity mask from the row count, bound + reduce. ONE body for
+    all four kernels (single-device / sharded x pass A / pass B) — the
+    mesh-vs-single-device parity contract rests on them tracing
+    identical row math."""
+    pid = je._widen_ids(planes[:n_pid_planes])
+    pk = je._widen_ids(planes[n_pid_planes:])
+    valid = jnp.arange(pid.shape[0]) < n_valid
+    return je._partials(config, num_partitions, pid, pk, values, valid,
+                        key, fx_bits)
+
+
+def _pack_rank1(part, nseg):
+    """[C+1, P] int32 stack: rank-1 columns in sorted-name order, the
+    privacy-id count last (the fetch's host mirror is ``_rank1_names``).
+    Returns (packed, vector_sum | None)."""
+    vec = part.pop("vector_sum", None)
+    names = sorted(k for k in part)
+    return jnp.stack([part[k] for k in names] + [nseg]), vec
+
+
+def _mid_histogram(config, num_partitions, qrows):
+    """The chunk's [P * n_mid] mid-level quantile-tree histogram
+    (additive across chunks and shards)."""
+    _, _, n_mid, bucket_w = _tree_consts()
+    qpk, leaf, kept = qrows
+    return jax.ops.segment_sum(
+        kept.astype(jnp.int32),
+        qpk * n_mid + jnp.minimum(leaf // bucket_w, n_mid - 1),
+        num_segments=num_partitions * n_mid)
+
+
 @functools.partial(jax.jit, static_argnames=("config", "num_partitions",
                                              "fx_bits", "n_pid_planes"))
 def _partials_kernel(config, num_partitions, planes, values, n_valid, key,
                      fx_bits, n_pid_planes):
     """One chunk's bounding + per-pk reduction, packed for the fetch:
-    a [C+1, P] int32 stack (rank-1 columns in sorted-name order, the
-    privacy-id count last), the rank-2 vector sums (or None), and — for
-    percentile configs — the chunk's [P * n_mid] mid-level quantile-tree
-    histogram (additive across chunks; stays device-resident).
+    the ``_pack_rank1`` stack, the rank-2 vector sums (or None), and —
+    for percentile configs — the ``_mid_histogram`` (stays
+    device-resident).
 
     Ids arrive as narrow byte planes (the tunneled host link runs at
     tens of MB/s — bytes are wall time, exactly as in
     ``jax_engine.pad_and_put``) and the row-validity mask is derived on
     device from the scalar row count."""
-    pid = je._widen_ids(planes[:n_pid_planes])
-    pk = je._widen_ids(planes[n_pid_planes:])
-    valid = jnp.arange(pid.shape[0]) < n_valid
-    part, nseg, qrows = je._partials(config, num_partitions, pid, pk,
-                                     values, valid, key, fx_bits)
-    vec = part.pop("vector_sum", None)
-    names = sorted(k for k in part)
-    packed = jnp.stack([part[k] for k in names] + [nseg])
-    mid = None
-    if config.percentiles:
-        _, _, n_mid, bucket_w = _tree_consts()
-        qpk, leaf, kept = qrows
-        mid = jax.ops.segment_sum(
-            kept.astype(jnp.int32),
-            qpk * n_mid + jnp.minimum(leaf // bucket_w, n_mid - 1),
-            num_segments=num_partitions * n_mid)
+    part, nseg, qrows = _chunk_body(config, num_partitions, planes,
+                                    values, n_valid, key, fx_bits,
+                                    n_pid_planes)
+    packed, vec = _pack_rank1(part, nseg)
+    mid = (_mid_histogram(config, num_partitions, qrows)
+           if config.percentiles else None)
     return packed, vec, mid
 
 
@@ -148,15 +196,97 @@ def _pct_sub_kernel(config, num_partitions, planes, values, n_valid, key,
     """Pass B: recompute the chunk's bounded rows (same key -> identical
     bounding sample as pass A) and count leaves inside each quantile's
     chosen subtree — [P, Q, span] int32, additive across chunks."""
-    pid = je._widen_ids(planes[:n_pid_planes])
-    pk = je._widen_ids(planes[n_pid_planes:])
-    valid = jnp.arange(pid.shape[0]) < n_valid
-    _, _, qrows = je._partials(config, num_partitions, pid, pk, values,
-                               valid, key, fx_bits)
+    _, _, qrows = _chunk_body(config, num_partitions, planes, values,
+                              n_valid, key, fx_bits, n_pid_planes)
     qpk, leaf, kept = qrows
     _, _, _, span = _tree_consts()
     return je._subtree_counts(qpk, leaf, kept, sub_start,
                               num_partitions, span)
+
+
+@functools.partial(jax.jit, static_argnames=("config", "num_partitions",
+                                             "mesh", "fx_bits",
+                                             "n_pid_planes"))
+def _sharded_partials_kernel(config, num_partitions, mesh, planes, values,
+                             n_valid_shard, key, fx_bits, n_pid_planes):
+    """Mesh twin of ``_partials_kernel``: each device bounds + reduces
+    ITS shard of the chunk's rows (rows arrive pid-sharded over the
+    mesh axis, so contribution bounding is shard-local exactly as in
+    ``parallel/sharded.py``), then ONE ``psum_scatter`` per output
+    hands every owner its partition block. Outputs come back
+    partition-sharded; summed across chunks they equal the single-batch
+    sharded kernel's accumulators."""
+    from pipelinedp_tpu.parallel import sharded as psh
+    axis = mesh.axis_names[0]
+    has_vec = "VECTOR_SUM" in config.metrics
+
+    def local_fn(planes, values, n_valid, key):
+        k_bound = jax.random.fold_in(key, jax.lax.axis_index(axis))
+        part, nseg, qrows = _chunk_body(config, num_partitions, planes,
+                                        values, n_valid[0], k_bound,
+                                        fx_bits, n_pid_planes)
+        packed, vec = _pack_rank1(part, nseg)
+        outs = [jax.lax.psum_scatter(packed, axis, scatter_dimension=1,
+                                     tiled=True)]
+        if vec is not None:
+            outs.append(jax.lax.psum_scatter(vec, axis,
+                                             scatter_dimension=0,
+                                             tiled=True))
+        if config.percentiles:
+            mid = _mid_histogram(config, num_partitions, qrows)
+            outs.append(jax.lax.psum_scatter(mid, axis,
+                                             scatter_dimension=0,
+                                             tiled=True))
+        return tuple(outs)
+
+    shard, repl = psh.PSpec(axis), psh.PSpec()
+    out_specs = [psh.PSpec(None, axis)]
+    if has_vec:
+        out_specs.append(shard)
+    if config.percentiles:
+        out_specs.append(shard)
+    mapped = psh.shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(tuple(shard for _ in planes), shard, shard, repl),
+        out_specs=tuple(out_specs), **{psh._CHECK_KW: False})
+    outs = list(mapped(planes, values, n_valid_shard, key))
+    packed = outs.pop(0)
+    vec = outs.pop(0) if has_vec else None
+    mid = outs.pop(0) if config.percentiles else None
+    return packed, vec, mid
+
+
+@functools.partial(jax.jit, static_argnames=("config", "num_partitions",
+                                             "mesh", "fx_bits",
+                                             "n_pid_planes"))
+def _sharded_pct_sub_kernel(config, num_partitions, mesh, planes, values,
+                            n_valid_shard, key, fx_bits, n_pid_planes,
+                            sub_start):
+    """Mesh twin of ``_pct_sub_kernel``: recompute this shard's bounded
+    rows (same per-shard key derivation as pass A -> identical bounding
+    sample) and psum_scatter the [P, Q, span] subtree-leaf counts to
+    owner blocks."""
+    from pipelinedp_tpu.parallel import sharded as psh
+    axis = mesh.axis_names[0]
+    _, _, _, span = _tree_consts()
+
+    def local_fn(planes, values, n_valid, key, sub_start):
+        k_bound = jax.random.fold_in(key, jax.lax.axis_index(axis))
+        _, _, qrows = _chunk_body(config, num_partitions, planes,
+                                  values, n_valid[0], k_bound, fx_bits,
+                                  n_pid_planes)
+        qpk, leaf, kept = qrows
+        sub = je._subtree_counts(qpk, leaf, kept, sub_start,
+                                 num_partitions, span)
+        return jax.lax.psum_scatter(sub, axis, scatter_dimension=0,
+                                    tiled=True)
+
+    shard, repl = psh.PSpec(axis), psh.PSpec()
+    mapped = psh.shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(tuple(shard for _ in planes), shard, shard, repl, repl),
+        out_specs=shard, **{psh._CHECK_KW: False})
+    return mapped(planes, values, n_valid_shard, key, sub_start)
 
 
 @functools.partial(jax.jit, static_argnames=("config", "P"))
@@ -228,49 +358,78 @@ def _select_kernel(config, num_partitions, part_nseg, keep_table,
     return keep_pk
 
 
-def _batch_assignment(config, encoded, n_batches: int, seed: int):
-    """Row order + per-batch counts such that each privacy unit's rows
-    are contiguous within one batch. Without privacy ids every row is
-    its own unit, so plain contiguous slices suffice (no reorder)."""
+def _batch_assignment(config, encoded, n_batches: int, seed: int,
+                      n_dev: int = 1):
+    """Row order + per-(batch, shard) counts such that each privacy
+    unit's rows are contiguous within ONE shard of one batch (bounding
+    must see all of a unit's rows together; the shard hash matches
+    ``parallel/sharded.py`` so mesh streaming bounds identically to the
+    single-batch mesh kernel). Without privacy ids every row is its own
+    unit, so plain contiguous slices suffice (no reorder). Returns
+    ``(order | None, counts[n_batches, n_dev])``."""
     n = encoded.n_rows
+    cells = n_batches * n_dev
     if config.bounds_already_enforced:
-        base = n // n_batches
-        rem = n % n_batches
-        counts = np.full(n_batches, base, np.int64)
+        base, rem = divmod(n, cells)
+        counts = np.full(cells, base, np.int64)
         counts[:rem] += 1
-        return None, counts
+        return None, counts.reshape(n_batches, n_dev)
     # Hash before the bucketing (id families sharing low bits would pile
     # into one batch), salt by the run seed so adversarial id sets can't
     # target a batch across runs.
     h = fmix32(encoded.pid.astype(np.uint32) ^ np.uint32(seed & 0xFFFFFFFF))
-    batch_of_row = ((h.astype(np.uint64) * np.uint64(n_batches)) >> np.uint64(32)).astype(np.int64)
-    order = np.argsort(batch_of_row, kind="stable")
-    counts = np.bincount(batch_of_row, minlength=n_batches)
-    return order, counts
+    batch_of_row = ((h.astype(np.uint64) * np.uint64(n_batches)) >>
+                    np.uint64(32)).astype(np.int64)
+    if n_dev > 1:
+        # UNsalted shard hash — the same ``fmix32(pid) % n_dev`` as
+        # ``sharded_fused_aggregate``, independent of the batch hash.
+        shard = (fmix32(encoded.pid.astype(np.uint32)) %
+                 np.uint32(n_dev)).astype(np.int64)
+        cell_of_row = batch_of_row * n_dev + shard
+    else:
+        cell_of_row = batch_of_row
+    order = np.argsort(cell_of_row, kind="stable")
+    counts = np.bincount(cell_of_row, minlength=cells)
+    return order, counts.reshape(n_batches, n_dev)
 
 
 def stream_partials_and_select(config, encoded, scales, keep_table,
                                sel_threshold, sel_scale, sel_min_count,
-                               sel_rows_per_uid, rng_seed: Optional[int]
-                               ) -> Tuple[np.ndarray, Dict, Dict]:
+                               sel_rows_per_uid, rng_seed: Optional[int],
+                               mesh=None) -> Tuple[np.ndarray, Dict, Dict]:
     """Runs the streaming aggregation. Returns ``(keep[P_pad] bool,
     part64, stats)`` where ``part64`` holds the combined float64/int64
     accumulator columns ready for ``jax_engine._host_release``; for
     percentile configs ``stats["percentile_values"]`` carries the
     [P_pad, Q] walked quantile values (pass B re-streams the batches —
-    see ``stream_is_supported``)."""
+    see ``stream_is_supported``).
+
+    With a ``mesh``, every chunk is itself pid-sharded over the mesh
+    and reduced by the sharded kernels; host accumulation, selection
+    and release are IDENTICAL to the single-device stream (the owner
+    blocks concatenate to the same [C+1, P] layout). Fetches gather
+    the owner-sharded outputs through the single-controller runtime;
+    a true multi-host deployment would fetch only the process-local
+    blocks (``jax.experimental.multihost_utils``), which this harness
+    cannot exercise."""
     from pipelinedp_tpu.ops import noise as noise_ops
 
+    n_dev = mesh.devices.size if mesh is not None else 1
     P = len(encoded.pk_vocab)
     P_pad = je._pad_pow2(P)
+    if mesh is not None:
+        # Owner blocks must tile the pk axis (same rounding + replay
+        # caveat as ``sharded_fused_aggregate``: a pow2 mesh is a no-op).
+        P_pad = -(-P_pad // n_dev) * n_dev
     n = encoded.n_rows
-    chunk = stream_chunk_rows()
+    chunk = chunk_target_rows(config, n_dev)
     n_batches = max(1, -(-n // chunk))
     seed = (rng_seed if rng_seed is not None else
             int(noise_ops._host_rng.integers(0, 2**31 - 1)))
     key = jax.random.PRNGKey(seed)
     # Same key topology as the single-batch kernel: one bounding stream
-    # (folded per batch), one selection stream.
+    # (folded per batch, then per shard inside the sharded kernel), one
+    # selection stream.
     k_bound, k_sel, k_noise = jax.random.split(key, 3)
 
     if config.percentiles:
@@ -285,9 +444,13 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                 f"({sub_bytes >> 20} MiB) — beyond the device budget; "
                 "reduce the partition count or the quantile list")
 
-    order, counts = _batch_assignment(config, encoded, n_batches, seed)
-    max_rows = int(counts.max()) if len(counts) else 1
-    pad_rows = je._pad_rows(max_rows)
+    order, counts = _batch_assignment(config, encoded, n_batches, seed,
+                                      n_dev)
+    batch_rows = counts.sum(axis=1)
+    # Lane capacity is bounded by the largest chunk's GLOBAL row count
+    # (shard lane sums combine by psum); padding is per shard cell.
+    max_rows = int(batch_rows.max()) if len(batch_rows) else 1
+    pad_rows = je._pad_rows(int(counts.max()) if counts.size else 1)
     layout = je._fixedpoint_layout(config)
     # Lane capacity is a PER-BATCH bound here — that is the whole point:
     # the plan depends on the largest chunk, not the global row count.
@@ -312,6 +475,13 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
     val_acc = {spec.name: np.zeros(P_pad, np.float64) for spec in layout}
     vec_acc = None
 
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as _PSpec
+        row_sharding = NamedSharding(mesh, _PSpec(mesh.axis_names[0]))
+    else:
+        row_sharding = None
+
     def batches():
         """Ships the deterministic batch sequence to the device; pass A
         and pass B (percentiles) iterate it identically. Staging buffers
@@ -320,61 +490,82 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
         kernel — and the id/value tails are ALSO re-zeroed each batch,
         so no invariant rests on padding content: neither a future
         kernel reading ids before masking nor the narrow-plane packing
-        (which reads the whole buffer) can see a stale id. Yields
-        (b, planes, values_d, cnt, n_pid_planes)."""
+        (which reads the whole buffer) can see a stale id.
+
+        On a mesh the staging layout is [n_dev * pad_rows]: shard d's
+        rows occupy cell d, and the one ``device_put`` places the
+        arrays row-sharded over the mesh (cell boundaries = shard
+        boundaries, so placement is a pure scatter). Yields
+        (b, planes, values_d, nv, n_pid_planes) where ``nv`` is the
+        device-ready valid-row count (scalar, or [n_dev] sharded)."""
         pid_spec = (je._plane_spec(int(encoded.pid.max(initial=0)))
                     if not config.bounds_already_enforced else "u16")
         pk_spec = je._plane_spec(int(encoded.pk.max(initial=0)))
+        buf_len = n_dev * pad_rows
         zeros_dev = None  # shared zero values for COUNT-style runs
-        pid_b = np.zeros(pad_rows, np.int32)
-        pk_b = np.zeros(pad_rows, np.int32)
+        pid_b = np.zeros(buf_len, np.int32)
+        pk_b = np.zeros(buf_len, np.int32)
         values_b = None
         if config.needs_values:
-            vshape = ((pad_rows, config.vector_size)
-                      if config.vector_size else (pad_rows,))
+            vshape = ((buf_len, config.vector_size)
+                      if config.vector_size else (buf_len,))
             values_b = np.zeros(vshape, np.float32)
         offset = 0
         for b in range(n_batches):
-            cnt = int(counts[b])
-            rows = (slice(offset, offset + cnt) if order is None
-                    else order[offset:offset + cnt])
-            offset += cnt
-            if cnt == 0:
+            ccounts = counts[b]
+            if int(ccounts.sum()) == 0:
                 continue
             # Narrow byte planes, padded on host to the uniform batch
-            # shape (uniform shape = ONE compile for every batch). Id
-            # tails are re-zeroed too: the kernel masks on n_valid, but
-            # a stale id from a larger earlier batch must never be able
-            # to leak into a future kernel that reads ids before
-            # masking (the cost is noise next to the host link).
-            if not config.bounds_already_enforced:
-                pid_b[:cnt] = encoded.pid[rows]
-                pid_b[cnt:] = 0
-            pk_b[:cnt] = encoded.pk[rows]
-            pk_b[cnt:] = 0
+            # shape (uniform shape = ONE compile for every batch).
+            for d in range(n_dev):
+                cnt = int(ccounts[d])
+                rows = (slice(offset, offset + cnt) if order is None
+                        else order[offset:offset + cnt])
+                offset += cnt
+                s0 = d * pad_rows
+                if not config.bounds_already_enforced:
+                    pid_b[s0:s0 + cnt] = encoded.pid[rows]
+                    pid_b[s0 + cnt:s0 + pad_rows] = 0
+                pk_b[s0:s0 + cnt] = encoded.pk[rows]
+                pk_b[s0 + cnt:s0 + pad_rows] = 0
+                if config.needs_values:
+                    values_b[s0:s0 + cnt] = encoded.values[rows]
+                    values_b[s0 + cnt:s0 + pad_rows] = 0.0
             pid_planes = je._narrow_ids(pid_b, pid_spec)
             pk_planes = je._narrow_ids(pk_b, pk_spec)
             host = list(pid_planes) + list(pk_planes)
             if config.needs_values:
-                values_b[:cnt] = encoded.values[rows]
-                values_b[cnt:] = 0.0
                 host.append(values_b)
-            dev = jax.device_put(tuple(host))  # one batched transfer
+            if row_sharding is None:
+                dev = jax.device_put(tuple(host))  # one batched transfer
+                nv = jnp.int32(int(ccounts[0]))
+            else:
+                dev = jax.device_put(tuple(host), row_sharding)
+                nv = jax.device_put(ccounts.astype(np.int32),
+                                    row_sharding)
             if config.needs_values:
                 planes, values_d = dev[:-1], dev[-1]
             else:
                 planes = dev
                 if zeros_dev is None:
-                    zeros_dev = jnp.zeros(pad_rows, jnp.float32)
+                    zeros_dev = jnp.zeros(buf_len, jnp.float32)
+                    if row_sharding is not None:
+                        zeros_dev = jax.device_put(zeros_dev,
+                                                   row_sharding)
                 values_d = zeros_dev
-            yield b, planes, values_d, cnt, len(pid_planes)
+            yield b, planes, values_d, nv, len(pid_planes)
 
     mid_acc = None  # device [P_pad * n_mid] percentile mid histogram
-    for b, planes, values_d, cnt, n_pid_planes in batches():
-        packed, vec, mid = _partials_kernel(
-            config, P_pad, planes, values_d, jnp.int32(cnt),
-            jax.random.fold_in(k_bound, b), fx_bits,
-            n_pid_planes=n_pid_planes)
+    for b, planes, values_d, nv, n_pid_planes in batches():
+        kb = jax.random.fold_in(k_bound, b)
+        if mesh is None:
+            packed, vec, mid = _partials_kernel(
+                config, P_pad, planes, values_d, nv, kb, fx_bits,
+                n_pid_planes=n_pid_planes)
+        else:
+            packed, vec, mid = _sharded_partials_kernel(
+                config, P_pad, mesh, planes, values_d, nv, kb, fx_bits,
+                n_pid_planes=n_pid_planes)
         if mid is not None:
             mid_acc = mid if mid_acc is None else mid_acc + mid
         host = np.asarray(packed)  # [C+1, P_pad] int32, one transfer
@@ -420,7 +611,8 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
             jnp.float32(sel_scale), jnp.float32(sel_min_count),
             jnp.float32(sel_rows_per_uid), k_sel))
     stats = {"n_batches": n_batches, "chunk_rows": chunk,
-             "fx_bits": fx_bits, "max_batch_rows": max_rows}
+             "fx_bits": fx_bits, "max_batch_rows": max_rows,
+             "mesh_devices": n_dev}
 
     if config.percentiles:
         # Pass B: walk the mid histogram's levels, then re-stream the
@@ -440,17 +632,31 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
         scale = jnp.float32(np.asarray(scales)[-1])
         lo, hi, target, leaf_lo, done = _walk_top_kernel(
             config, P_pad, mid_acc, k_tree, scale)
+        if mesh is not None:
+            # The walk state is tiny ([P, Q]); host-fetch it once and
+            # re-feed replicated — the sharded pass-B kernel's in_specs
+            # stay simple and independent of what sharding GSPMD chose
+            # for the top walk's outputs.
+            lo, hi, target, leaf_lo, done = (
+                np.asarray(lo), np.asarray(hi), np.asarray(target),
+                np.asarray(leaf_lo), np.asarray(done))
         sub_start = leaf_lo
         sub_acc = None
-        for b, planes, values_d, cnt, n_pid_planes in batches():
-            sub = _pct_sub_kernel(
-                config, P_pad, planes, values_d, jnp.int32(cnt),
-                jax.random.fold_in(k_bound, b), fx_bits,
-                n_pid_planes=n_pid_planes, sub_start=sub_start)
+        for b, planes, values_d, nv, n_pid_planes in batches():
+            kb = jax.random.fold_in(k_bound, b)
+            if mesh is None:
+                sub = _pct_sub_kernel(
+                    config, P_pad, planes, values_d, nv, kb, fx_bits,
+                    n_pid_planes=n_pid_planes, sub_start=sub_start)
+            else:
+                sub = _sharded_pct_sub_kernel(
+                    config, P_pad, mesh, planes, values_d, nv, kb,
+                    fx_bits, n_pid_planes=n_pid_planes,
+                    sub_start=jnp.asarray(sub_start))
             sub_acc = sub if sub_acc is None else sub_acc + sub
-        vals = _walk_bottom_kernel(config, P_pad, sub_acc, sub_start,
-                                   lo, hi, target, leaf_lo, done,
-                                   k_tree, scale)
+        vals = _walk_bottom_kernel(config, P_pad, sub_acc,
+                                   jnp.asarray(sub_start), lo, hi,
+                                   target, leaf_lo, done, k_tree, scale)
         stats["percentile_values"] = np.asarray(vals)
 
     return keep, part64, stats

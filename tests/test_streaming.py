@@ -266,7 +266,7 @@ class TestStreamedPercentiles:
             sub_acc = None
             offset = 0
             for b in range(n_batches):
-                cnt = int(counts[b])
+                cnt = int(counts[b, 0])
                 rows = (slice(offset, offset + cnt) if order is None
                         else order[offset:offset + cnt])
                 offset += cnt
@@ -436,6 +436,147 @@ class TestStreamedFuzz:
                     len(np.unique(ds.privacy_ids[m])), abs=0.5)
 
 
+class TestStreamedOnMesh:
+    """Streaming composed with the device mesh (VERDICT r4 #3): chunks
+    shard over the 8-device CPU mesh, owner-block partials fold into
+    the same host accumulators, results match the oracle."""
+
+    def _mesh_backend(self, seed=0):
+        from pipelinedp_tpu.parallel import make_mesh
+        return JaxBackend(rng_seed=seed, mesh=make_mesh())
+
+    def run_mesh_streamed(self, ds, params, public=None, eps=BIG_EPS,
+                          min_batches=3):
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=eps,
+                                        total_delta=1e-2)
+        engine = pdp.DPEngine(acc, self._mesh_backend())
+        res = engine.aggregate(ds, params, pdp.DataExtractors(),
+                               public_partitions=public)
+        acc.compute_budgets()
+        got = dict(res)
+        assert res.timings.get("stream_batches", 0) >= min_batches, (
+            "dataset did not stream over enough chunks on the mesh")
+        return got
+
+    def test_matches_exact_on_mesh(self, monkeypatch):
+        """≥3 chunks over the 8-device mesh match the exact aggregates
+        (the verdict's Done criterion)."""
+        # Mesh chunk budget is per device: 8 devices x 500 rows/chunk
+        # over 23k rows -> >= 5 batches.
+        monkeypatch.setenv("PIPELINEDP_TPU_STREAM_CHUNK", "500")
+        rng = np.random.default_rng(40)
+        n, parts = 23_000, 15
+        ds = pdp.ArrayDataset(
+            privacy_ids=rng.integers(0, 3_000, n),
+            partition_keys=rng.integers(0, parts, n),
+            values=rng.uniform(0.0, 10.0, n))
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM, pdp.Metrics.MEAN,
+                     pdp.Metrics.PRIVACY_ID_COUNT],
+            max_partitions_contributed=parts,
+            max_contributions_per_partition=50,
+            min_value=0.0, max_value=10.0)
+        got = self.run_mesh_streamed(ds, params)
+        pk, vals, pid = ds.partition_keys, ds.values, ds.privacy_ids
+        assert len(got) == parts
+        for p in range(parts):
+            m = pk == p
+            assert got[p].count == pytest.approx(m.sum(), abs=0.5)
+            assert got[p].sum == pytest.approx(vals[m].sum(), rel=1e-5)
+            assert got[p].mean == pytest.approx(vals[m].mean(), abs=1e-4)
+            assert got[p].privacy_id_count == pytest.approx(
+                len(np.unique(pid[m])), abs=0.5)
+
+    def test_percentiles_stream_on_mesh(self, monkeypatch):
+        monkeypatch.setenv("PIPELINEDP_TPU_STREAM_CHUNK", "400")
+        rng = np.random.default_rng(41)
+        n = 10_000
+        ds = pdp.ArrayDataset(
+            privacy_ids=rng.integers(0, 2_000, n),
+            partition_keys=rng.integers(0, 4, n),
+            values=rng.uniform(0.0, 100.0, n))
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.PERCENTILE(50), pdp.Metrics.PERCENTILE(90)],
+            max_partitions_contributed=4,
+            max_contributions_per_partition=50,
+            min_value=0.0, max_value=100.0)
+        got = self.run_mesh_streamed(ds, params, public=list(range(4)))
+        for p in range(4):
+            true = np.percentile(ds.values[ds.partition_keys == p],
+                                 [50, 90])
+            assert got[p].percentile_50 == pytest.approx(true[0], abs=0.5)
+            assert got[p].percentile_90 == pytest.approx(true[1], abs=0.5)
+
+    def test_vector_sum_streams_on_mesh(self, monkeypatch):
+        monkeypatch.setenv("PIPELINEDP_TPU_STREAM_CHUNK", "300")
+        rng = np.random.default_rng(42)
+        n, d = 6_000, 3
+        ds = pdp.ArrayDataset(
+            privacy_ids=rng.integers(0, 1_500, n),
+            partition_keys=rng.integers(0, 5, n),
+            values=rng.normal(0.0, 1.0, (n, d)).astype(np.float32))
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.VECTOR_SUM], vector_size=d,
+            vector_max_norm=1e9,
+            vector_norm_kind=pdp.NormKind.Linf,
+            max_partitions_contributed=5,
+            max_contributions_per_partition=50)
+        got = self.run_mesh_streamed(ds, params, public=list(range(5)))
+        for p in range(5):
+            true = ds.values[ds.partition_keys == p].sum(axis=0)
+            np.testing.assert_allclose(got[p].vector_sum, true,
+                                       rtol=1e-4, atol=1e-2)
+
+    def test_select_partitions_streams_on_mesh(self, monkeypatch):
+        monkeypatch.setenv("PIPELINEDP_TPU_STREAM_CHUNK", "500")
+        rng = np.random.default_rng(43)
+        n = 12_000
+        # 5 heavy partitions + a long tail of single-user partitions:
+        # selection at moderate eps keeps the heavy ones.
+        pid = rng.integers(0, 4_000, n)
+        pk = np.where(np.arange(n) % 10 < 9, rng.integers(0, 5, n),
+                      5 + rng.integers(0, 200, n))
+        ds = pdp.ArrayDataset(privacy_ids=pid,
+                              partition_keys=pk.astype(np.int64),
+                              values=None)
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=10.0,
+                                        total_delta=1e-4)
+        engine = pdp.DPEngine(acc, self._mesh_backend())
+        params = pdp.SelectPartitionsParams(max_partitions_contributed=3)
+        res = engine.select_partitions(ds, params, pdp.DataExtractors())
+        acc.compute_budgets()
+        kept = set(res)
+        assert set(range(5)) <= kept
+
+    def test_mesh_streamed_matches_single_device_streamed(self,
+                                                          monkeypatch):
+        """Same seed, same dataset: mesh streaming and single-device
+        streaming agree exactly on the deterministic aggregates at huge
+        eps with non-binding caps (different bounding subsample is
+        irrelevant when nothing is dropped)."""
+        monkeypatch.setenv("PIPELINEDP_TPU_STREAM_CHUNK", "600")
+        rng = np.random.default_rng(44)
+        n, parts = 11_000, 8
+        ds = pdp.ArrayDataset(
+            privacy_ids=rng.integers(0, 2_500, n),
+            partition_keys=rng.integers(0, parts, n),
+            values=rng.uniform(0.0, 10.0, n))
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            max_partitions_contributed=parts,
+            max_contributions_per_partition=50,
+            min_value=0.0, max_value=10.0)
+        mesh_got = self.run_mesh_streamed(ds, params,
+                                          public=list(range(parts)))
+        ds.invalidate_cache()
+        single_got = run_streamed(ds, params, public=list(range(parts)))
+        for p in range(parts):
+            assert mesh_got[p].count == pytest.approx(
+                single_got[p].count, abs=1e-3)
+            assert mesh_got[p].sum == pytest.approx(
+                single_got[p].sum, rel=1e-5)
+
+
 class TestStreamingInternals:
 
     def test_pid_batches_are_disjoint(self):
@@ -453,15 +594,52 @@ class TestStreamingInternals:
                                 max_partitions_contributed=1,
                                 max_contributions_per_partition=1),
             public=True)
-        order, counts = streaming._batch_assignment(config, enc, 7, 123)
-        seen = {}
-        offset = 0
-        for b, c in enumerate(counts):
-            batch_pids = set(pid[order[offset:offset + c]].tolist())
-            for u in batch_pids:
-                assert seen.setdefault(u, b) == b
-            offset += c
-        assert offset == n
+        for n_dev in (1, 8):
+            order, counts = streaming._batch_assignment(config, enc, 7,
+                                                        123, n_dev)
+            assert counts.shape == (7, n_dev)
+            seen = {}
+            offset = 0
+            for cell, c in enumerate(counts.ravel()):
+                # A unit's rows must stay within ONE (batch, shard) cell.
+                cell_pids = set(
+                    pid[order[offset:offset + int(c)]].tolist())
+                for u in cell_pids:
+                    assert seen.setdefault(u, cell) == cell
+                offset += int(c)
+            assert offset == n
+
+    def test_chunk_target_capped_by_lane_capacity(self, monkeypatch):
+        """A big mesh must not scale value-config batches past the
+        global fixed-point lane capacity (the psum makes lane capacity
+        a per-batch GLOBAL bound) — and the capped target must itself
+        be plannable."""
+        from pipelinedp_tpu import jax_engine as je
+        monkeypatch.setenv("PIPELINEDP_TPU_STREAM_CHUNK", str(1 << 26))
+        value_config = je.FusedConfig.from_params(
+            pdp.AggregateParams(
+                metrics=[pdp.Metrics.SUM], max_partitions_contributed=1,
+                max_contributions_per_partition=1, min_value=0.0,
+                max_value=1.0), public=True)
+        count_config = je.FusedConfig.from_params(
+            pdp.AggregateParams(
+                metrics=[pdp.Metrics.COUNT],
+                max_partitions_contributed=1,
+                max_contributions_per_partition=1), public=True)
+        capped = streaming.chunk_target_rows(value_config, 8)
+        assert capped <= je._fx_max_rows() < (1 << 26) * 8
+        je._fx_plan(capped)  # must not raise
+        assert streaming.chunk_target_rows(count_config, 8) == (1 << 26) * 8
+        # Count columns are int32 psums: a giant mesh must not form a
+        # batch that could wrap them.
+        assert streaming.chunk_target_rows(count_config, 64) < (1 << 31)
+        # And therefore: every row count above the single-batch lane cap
+        # streams on a mesh — no dead zone between the caps.
+        class _FakeMesh:
+            class devices:
+                size = 8
+        assert streaming.should_stream(value_config,
+                                       je._fx_max_rows() + 1, _FakeMesh)
 
     def test_exact_lane_accumulation_across_batches(self):
         """Adversarial equal values summed across many batches stay
