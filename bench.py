@@ -325,6 +325,12 @@ def bench_streaming(n_rows):
         "local_prefix_rows": prefix,
         "stream_batches": (timings or {}).get("stream_batches"),
         "device_s": round((timings or {}).get("device_s", 0.0), 3),
+        # Transfer/compute split: host staging+enqueue wall vs time
+        # blocked on kernel results — near-zero fold_wait means the
+        # link (not the TPU) is the bottleneck and the overlap works.
+        "stage_s": round((timings or {}).get("stream_stage_s", 0.0), 3),
+        "fold_wait_s": round(
+            (timings or {}).get("stream_fold_wait_s", 0.0), 3),
     }
     log(f"## streaming ingest: {n_rows} rows ({rec['stream_batches']} "
         f"batches) in {total:.1f}s ({rps:.0f} rows/s, cold incl. "

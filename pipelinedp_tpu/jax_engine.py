@@ -1822,6 +1822,12 @@ class LazyFusedResult:
                     mesh=self._mesh))
             self.timings["device_s"] = _time.perf_counter() - t1
             self.timings["stream_batches"] = stream_stats["n_batches"]
+            # Transfer/compute split: staging+enqueue wall vs the time
+            # blocked waiting for kernel results (the overlap evidence).
+            self.timings["stream_stage_s"] = stream_stats["stage_s"]
+            self.timings["stream_fold_wait_s"] = stream_stats["fold_wait_s"]
+            if "pass_b_source" in stream_stats:
+                self.timings["stream_pass_b"] = stream_stats["pass_b_source"]
             t_rel = _time.perf_counter()
             part64 = {k: v[:P] for k, v in part64.items()}
             rng = (np.random.default_rng(self._rng_seed)

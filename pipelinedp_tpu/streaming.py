@@ -51,6 +51,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import os
+import time as _time
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -69,6 +70,16 @@ _CHUNK_ENV = "PIPELINEDP_TPU_STREAM_CHUNK"
 
 def stream_chunk_rows() -> int:
     return int(os.environ.get(_CHUNK_ENV, 1 << 26))
+
+
+#: HBM budget for keeping shipped batches device-resident so percentile
+#: pass B re-reads them from HBM instead of re-shipping every byte over
+#: the host link. 0 disables the cache.
+_CACHE_ENV = "PIPELINEDP_TPU_STREAM_CACHE"
+
+
+def stream_cache_bytes() -> int:
+    return int(os.environ.get(_CACHE_ENV, 4 << 30))
 
 
 def stream_is_supported(config) -> bool:
@@ -482,6 +493,8 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
     else:
         row_sharding = None
 
+    t_stage = 0.0  # host staging + enqueue time across both passes
+
     def batches():
         """Ships the deterministic batch sequence to the device; pass A
         and pass B (percentiles) iterate it identically. Staging buffers
@@ -498,6 +511,7 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
         boundaries, so placement is a pure scatter). Yields
         (b, planes, values_d, nv, n_pid_planes) where ``nv`` is the
         device-ready valid-row count (scalar, or [n_dev] sharded)."""
+        nonlocal t_stage
         pid_spec = (je._plane_spec(int(encoded.pid.max(initial=0)))
                     if not config.bounds_already_enforced else "u16")
         pk_spec = je._plane_spec(int(encoded.pk.max(initial=0)))
@@ -505,16 +519,23 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
         zeros_dev = None  # shared zero values for COUNT-style runs
         pid_b = np.zeros(buf_len, np.int32)
         pk_b = np.zeros(buf_len, np.int32)
-        values_b = None
-        if config.needs_values:
-            vshape = ((buf_len, config.vector_size)
-                      if config.vector_size else (buf_len,))
-            values_b = np.zeros(vshape, np.float32)
+        vshape = ((buf_len, config.vector_size)
+                  if config.vector_size else (buf_len,))
         offset = 0
         for b in range(n_batches):
             ccounts = counts[b]
             if int(ccounts.sum()) == 0:
                 continue
+            t0 = _time.perf_counter()
+            # Values stage into a FRESH buffer every batch (fresh zeros
+            # also make tail re-zeroing moot): ``jax.device_put`` may
+            # zero-copy a numpy array on some backends, and with the
+            # fold delayed one batch (and pass B never folding) the
+            # previous batch's kernel can still be reading its input
+            # when this batch stages — nothing a pending kernel might
+            # alias is ever mutated.
+            values_b = (np.zeros(vshape, np.float32)
+                        if config.needs_values else None)
             # Narrow byte planes, padded on host to the uniform batch
             # shape (uniform shape = ONE compile for every batch).
             for d in range(n_dev):
@@ -530,10 +551,14 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                 pk_b[s0 + cnt:s0 + pad_rows] = 0
                 if config.needs_values:
                     values_b[s0:s0 + cnt] = encoded.values[rows]
-                    values_b[s0 + cnt:s0 + pad_rows] = 0.0
+            # _narrow_ids returns fresh plane arrays except in "i32"
+            # mode, where it returns the staging buffer itself — copy
+            # those so the ship list never aliases a reused buffer.
             pid_planes = je._narrow_ids(pid_b, pid_spec)
-            pk_planes = je._narrow_ids(pk_b, pk_spec)
-            host = list(pid_planes) + list(pk_planes)
+            n_pid_planes = len(pid_planes)
+            host = [p.copy() if (p is pid_b or p is pk_b) else p
+                    for p in (*pid_planes,
+                              *je._narrow_ids(pk_b, pk_spec))]
             if config.needs_values:
                 host.append(values_b)
             if row_sharding is None:
@@ -553,21 +578,16 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                         zeros_dev = jax.device_put(zeros_dev,
                                                    row_sharding)
                 values_d = zeros_dev
-            yield b, planes, values_d, nv, len(pid_planes)
+            t_stage += _time.perf_counter() - t0
+            yield b, planes, values_d, nv, n_pid_planes
 
-    mid_acc = None  # device [P_pad * n_mid] percentile mid histogram
-    for b, planes, values_d, nv, n_pid_planes in batches():
-        kb = jax.random.fold_in(k_bound, b)
-        if mesh is None:
-            packed, vec, mid = _partials_kernel(
-                config, P_pad, planes, values_d, nv, kb, fx_bits,
-                n_pid_planes=n_pid_planes)
-        else:
-            packed, vec, mid = _sharded_partials_kernel(
-                config, P_pad, mesh, planes, values_d, nv, kb, fx_bits,
-                n_pid_planes=n_pid_planes)
-        if mid is not None:
-            mid_acc = mid if mid_acc is None else mid_acc + mid
+    def fold_packed(packed, vec):
+        """Fetch one batch's [C+1, P] block and fold it on host —
+        BLOCKS on that batch's kernel, so the caller delays it by one
+        batch: while batch b-1's fetch waits, batch b's host->device
+        transfer and kernel are already in flight (the device runtime
+        overlaps the copy stream with compute)."""
+        nonlocal vec_acc
         host = np.asarray(packed)  # [C+1, P_pad] int32, one transfer
         # Loud failure if the kernel's packed column set ever diverges
         # from the host-side name mirror (a silent mismatch would hand
@@ -588,6 +608,47 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
         if vec is not None:
             v64 = np.asarray(vec).astype(np.float64)
             vec_acc = v64 if vec_acc is None else vec_acc + v64
+
+    # Device-resident batch cache: percentile pass B re-reads shipped
+    # batches from HBM instead of paying the host link twice. Bounded
+    # by ``stream_cache_bytes()``; overflow drops the WHOLE cache (a
+    # partial cache would split pass B across two iteration sources).
+    cache: Optional[list] = [] if config.percentiles else None
+    cache_bytes = 0
+    cache_cap = stream_cache_bytes()
+    t_fold = 0.0
+    mid_acc = None  # device [P_pad * n_mid] percentile mid histogram
+    pending = None  # previous batch's (packed, vec), folded one late
+    for b, planes, values_d, nv, n_pid_planes in batches():
+        kb = jax.random.fold_in(k_bound, b)
+        if mesh is None:
+            packed, vec, mid = _partials_kernel(
+                config, P_pad, planes, values_d, nv, kb, fx_bits,
+                n_pid_planes=n_pid_planes)
+        else:
+            packed, vec, mid = _sharded_partials_kernel(
+                config, P_pad, mesh, planes, values_d, nv, kb, fx_bits,
+                n_pid_planes=n_pid_planes)
+        if mid is not None:
+            mid_acc = mid if mid_acc is None else mid_acc + mid
+        if cache is not None:
+            # The budget is PER-DEVICE HBM: on a mesh the arrays are
+            # row-sharded, so each device holds 1/n_dev of the bytes.
+            cache_bytes += (sum(int(p.nbytes) for p in planes) +
+                            int(values_d.nbytes)) // n_dev
+            if cache_bytes <= cache_cap:
+                cache.append((b, planes, values_d, nv, n_pid_planes))
+            else:
+                cache = None
+        if pending is not None:
+            t0 = _time.perf_counter()
+            fold_packed(*pending)
+            t_fold += _time.perf_counter() - t0
+        pending = (packed, vec)
+    if pending is not None:
+        t0 = _time.perf_counter()
+        fold_packed(*pending)
+        t_fold += _time.perf_counter() - t0
 
     part64: Dict[str, np.ndarray] = dict(acc)
     part64.update(val_acc)
@@ -612,7 +673,7 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
             jnp.float32(sel_rows_per_uid), k_sel))
     stats = {"n_batches": n_batches, "chunk_rows": chunk,
              "fx_bits": fx_bits, "max_batch_rows": max_rows,
-             "mesh_devices": n_dev}
+             "mesh_devices": n_dev, "fold_wait_s": t_fold}
 
     if config.percentiles:
         # Pass B: walk the mid histogram's levels, then re-stream the
@@ -642,21 +703,29 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                 np.asarray(leaf_lo), np.asarray(done))
         sub_start = leaf_lo
         sub_acc = None
-        for b, planes, values_d, nv, n_pid_planes in batches():
+        # Re-read shipped batches from the device cache when they all
+        # fit (same (b, arrays) tuples -> identical kernel inputs, zero
+        # extra link traffic); otherwise re-stream from host.
+        stats["pass_b_source"] = ("device_cache" if cache is not None
+                                  else "reship")
+        pass_b = iter(cache) if cache is not None else batches()
+        sub_start_dev = jnp.asarray(sub_start)
+        for b, planes, values_d, nv, n_pid_planes in pass_b:
             kb = jax.random.fold_in(k_bound, b)
             if mesh is None:
                 sub = _pct_sub_kernel(
                     config, P_pad, planes, values_d, nv, kb, fx_bits,
-                    n_pid_planes=n_pid_planes, sub_start=sub_start)
+                    n_pid_planes=n_pid_planes, sub_start=sub_start_dev)
             else:
                 sub = _sharded_pct_sub_kernel(
                     config, P_pad, mesh, planes, values_d, nv, kb,
                     fx_bits, n_pid_planes=n_pid_planes,
-                    sub_start=jnp.asarray(sub_start))
+                    sub_start=sub_start_dev)
             sub_acc = sub if sub_acc is None else sub_acc + sub
         vals = _walk_bottom_kernel(config, P_pad, sub_acc,
                                    jnp.asarray(sub_start), lo, hi,
                                    target, leaf_lo, done, k_tree, scale)
         stats["percentile_values"] = np.asarray(vals)
 
+    stats["stage_s"] = t_stage
     return keep, part64, stats

@@ -344,6 +344,44 @@ class TestStreamedPercentiles:
             assert streamed[p].percentile_95 == pytest.approx(
                 single[p].percentile_95, abs=0.7)
 
+    def test_pass_b_reship_matches_device_cache(self, monkeypatch):
+        """Pass B over the device-resident batch cache and pass B
+        re-shipping every batch must produce IDENTICAL percentiles
+        (same (b, arrays) -> same kernels), and both sources must be
+        observable in timings."""
+        rng = np.random.default_rng(77)
+        n = 6_000
+        ds = pdp.ArrayDataset(
+            privacy_ids=rng.integers(0, 1_500, n),
+            partition_keys=rng.integers(0, 6, n),
+            values=rng.uniform(0.0, 50.0, n))
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.PERCENTILE(50), pdp.Metrics.PERCENTILE(95)],
+            max_partitions_contributed=6,
+            max_contributions_per_partition=50,
+            min_value=0.0, max_value=50.0)
+
+        def run(cache_bytes):
+            monkeypatch.setenv("PIPELINEDP_TPU_STREAM_CACHE",
+                               str(cache_bytes))
+            ds.invalidate_cache()
+            acc = pdp.NaiveBudgetAccountant(total_epsilon=BIG_EPS,
+                                            total_delta=1e-2)
+            engine = pdp.DPEngine(acc, JaxBackend(rng_seed=3))
+            res = engine.aggregate(ds, params, pdp.DataExtractors(),
+                                   public_partitions=list(range(6)))
+            acc.compute_budgets()
+            got = dict(res)
+            assert res.timings["stream_batches"] > 1
+            return got, res.timings["stream_pass_b"]
+
+        cached, src_c = run(1 << 30)
+        reshipped, src_r = run(0)
+        assert src_c == "device_cache" and src_r == "reship"
+        for p in range(6):
+            assert cached[p].percentile_50 == reshipped[p].percentile_50
+            assert cached[p].percentile_95 == reshipped[p].percentile_95
+
     def test_private_selection_with_percentiles(self):
         rng = np.random.default_rng(22)
         n = 8_000
