@@ -1828,6 +1828,8 @@ class LazyFusedResult:
             self.timings["stream_fold_wait_s"] = stream_stats["fold_wait_s"]
             if "pass_b_source" in stream_stats:
                 self.timings["stream_pass_b"] = stream_stats["pass_b_source"]
+                self.timings["stream_pass_b_rounds"] = (
+                    stream_stats["pass_b_rounds"])
             t_rel = _time.perf_counter()
             part64 = {k: v[:P] for k, v in part64.items()}
             rng = (np.random.default_rng(self._rng_seed)

@@ -334,15 +334,17 @@ def _walk_top_kernel(config, P, mid, key, scale):
 @functools.partial(jax.jit, static_argnames=("config", "P"))
 def _walk_bottom_kernel(config, P, sub, sub_start, lo, hi, target,
                         leaf_lo, done, key, scale):
-    """Finish the walk from the accumulated [P, Q, span] subtree leaf
-    histograms (levels below the mid histogram)."""
+    """Finish the walk from the accumulated [P, Qc, span] subtree leaf
+    histograms (levels below the mid histogram). ``Qc`` may be a CHUNK
+    of the quantile list (the over-cap fallback walks quantile groups
+    independently — valid because node noise is a pure function of
+    (partition, node id), so each quantile's descent is identical
+    whether its neighbors walk alongside it or not); the caller applies
+    the cross-quantile monotone step over the full list."""
     b, height, n_mid, bucket_w = _tree_consts()
-    quantiles = np.asarray([p / 100.0 for p in config.percentiles],
-                           np.float32)
-    span = bucket_w
-    # All remaining levels (node width < bucket_w) read the [P, Q, span]
-    # subtree histograms — any height: within the subtree a width-w node
-    # is a contiguous group of w leaves.
+    # All remaining levels (node width < bucket_w) read the [P, Qc,
+    # span] subtree histograms — any height: within the subtree a
+    # width-w node is a contiguous group of w leaves.
     level_offset = sum(b**(level + 1) for level in range(min(2, height)))
     for level in range(min(2, height), height):
         w = b**(height - 1 - level)
@@ -352,8 +354,7 @@ def _walk_bottom_kernel(config, P, sub, sub_start, lo, hi, target,
             config.noise_kind, key, scale, raw, base, level_offset, lo,
             hi, target, leaf_lo, done, b, w)
         level_offset += b**(level + 1)
-    vals = lo + (hi - lo) * target
-    return je._monotone_in_q(vals, quantiles)
+    return lo + (hi - lo) * target
 
 
 @functools.partial(jax.jit, static_argnames=("config", "num_partitions"))
@@ -444,16 +445,20 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
     k_bound, k_sel, k_noise = jax.random.split(key, 3)
 
     if config.percentiles:
-        # Fail BEFORE streaming anything: the [P, Q, span] subtree block
-        # of pass B is sized by quantities known at entry.
+        # Size pass B's [P, Qc, span] subtree blocks BEFORE streaming
+        # anything: quantiles walk in groups of ``q_chunk`` so the
+        # block never exceeds the device budget — past the cap,
+        # capacity becomes extra pass-B rounds (a time cost), not a
+        # refusal. Only a partition axis so wide that ONE quantile's
+        # block overflows is refused.
         _, _, _, span = _tree_consts()
-        sub_bytes = P_pad * len(config.percentiles) * span * 4
-        if sub_bytes > je._SUBHIST_BYTE_CAP:
+        per_q_bytes = P_pad * span * 4
+        q_chunk = max(1, je._SUBHIST_BYTE_CAP // per_q_bytes)
+        if per_q_bytes > je._SUBHIST_BYTE_CAP:
             raise NotImplementedError(
-                f"streamed percentiles need a [{P_pad}, "
-                f"{len(config.percentiles)}, {span}] subtree block "
-                f"({sub_bytes >> 20} MiB) — beyond the device budget; "
-                "reduce the partition count or the quantile list")
+                f"streamed percentiles need a [{P_pad}, 1, {span}] "
+                f"subtree block per quantile ({per_q_bytes >> 20} MiB) "
+                "— beyond the device budget; reduce the partition count")
 
     order, counts = _batch_assignment(config, encoded, n_batches, seed,
                                       n_dev)
@@ -497,13 +502,16 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
 
     def batches():
         """Ships the deterministic batch sequence to the device; pass A
-        and pass B (percentiles) iterate it identically. Staging buffers
-        are allocated once and reused across batches (only the stale
-        tail needs re-zeroing); rows past n_valid are masked in the
-        kernel — and the id/value tails are ALSO re-zeroed each batch,
-        so no invariant rests on padding content: neither a future
-        kernel reading ids before masking nor the narrow-plane packing
-        (which reads the whole buffer) can see a stale id.
+        and pass B (percentiles) iterate it identically. The ID staging
+        buffers are allocated once and reused across batches with their
+        tails re-zeroed (rows past n_valid are masked in the kernel, so
+        no invariant rests on padding content) — safe because what
+        ships is a fresh narrowed copy of them. Everything that is
+        ACTUALLY shipped must be an array no later iteration mutates
+        (``device_put`` may zero-copy a numpy array while the previous
+        batch's kernel is still reading it — the fold runs one batch
+        late and pass B never folds): values stage into a fresh buffer
+        every batch, and i32-mode id planes are copied.
 
         On a mesh the staging layout is [n_dev * pad_rows]: shard d's
         rows occupy cell d, and the one ``device_put`` places the
@@ -702,30 +710,44 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                 np.asarray(lo), np.asarray(hi), np.asarray(target),
                 np.asarray(leaf_lo), np.asarray(done))
         sub_start = leaf_lo
-        sub_acc = None
         # Re-read shipped batches from the device cache when they all
         # fit (same (b, arrays) tuples -> identical kernel inputs, zero
         # extra link traffic); otherwise re-stream from host.
         stats["pass_b_source"] = ("device_cache" if cache is not None
                                   else "reship")
-        pass_b = iter(cache) if cache is not None else batches()
-        sub_start_dev = jnp.asarray(sub_start)
-        for b, planes, values_d, nv, n_pid_planes in pass_b:
-            kb = jax.random.fold_in(k_bound, b)
-            if mesh is None:
-                sub = _pct_sub_kernel(
-                    config, P_pad, planes, values_d, nv, kb, fx_bits,
-                    n_pid_planes=n_pid_planes, sub_start=sub_start_dev)
-            else:
-                sub = _sharded_pct_sub_kernel(
-                    config, P_pad, mesh, planes, values_d, nv, kb,
-                    fx_bits, n_pid_planes=n_pid_planes,
-                    sub_start=sub_start_dev)
-            sub_acc = sub if sub_acc is None else sub_acc + sub
-        vals = _walk_bottom_kernel(config, P_pad, sub_acc,
-                                   jnp.asarray(sub_start), lo, hi,
-                                   target, leaf_lo, done, k_tree, scale)
-        stats["percentile_values"] = np.asarray(vals)
+        Q = len(config.percentiles)
+        vals_groups = []
+        for q0 in range(0, Q, q_chunk):
+            qsl = slice(q0, min(q0 + q_chunk, Q))
+            ss_dev = jnp.asarray(sub_start[:, qsl])
+            sub_acc = None
+            pass_b = iter(cache) if cache is not None else batches()
+            for b, planes, values_d, nv, n_pid_planes in pass_b:
+                kb = jax.random.fold_in(k_bound, b)
+                if mesh is None:
+                    sub = _pct_sub_kernel(
+                        config, P_pad, planes, values_d, nv, kb,
+                        fx_bits, n_pid_planes=n_pid_planes,
+                        sub_start=ss_dev)
+                else:
+                    sub = _sharded_pct_sub_kernel(
+                        config, P_pad, mesh, planes, values_d, nv, kb,
+                        fx_bits, n_pid_planes=n_pid_planes,
+                        sub_start=ss_dev)
+                sub_acc = sub if sub_acc is None else sub_acc + sub
+            vals_g = _walk_bottom_kernel(
+                config, P_pad, sub_acc, ss_dev, lo[:, qsl], hi[:, qsl],
+                target[:, qsl], leaf_lo[:, qsl], done[:, qsl], k_tree,
+                scale)
+            vals_groups.append(np.asarray(vals_g))
+        stats["pass_b_rounds"] = len(vals_groups)
+        vals = np.concatenate(vals_groups, axis=1)
+        # The cross-quantile monotone step runs ONCE over the full
+        # list (chunked walks must compose to the single-walk result).
+        quantiles = np.asarray([p / 100.0 for p in config.percentiles],
+                               np.float32)
+        stats["percentile_values"] = np.asarray(
+            je._monotone_in_q(jnp.asarray(vals), quantiles))
 
     stats["stage_s"] = t_stage
     return keep, part64, stats

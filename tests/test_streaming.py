@@ -344,6 +344,56 @@ class TestStreamedPercentiles:
             assert streamed[p].percentile_95 == pytest.approx(
                 single[p].percentile_95, abs=0.7)
 
+    def test_tiny_subhist_cap_chunks_quantiles(self, monkeypatch):
+        """Past _SUBHIST_BYTE_CAP, pass B walks quantile GROUPS instead
+        of refusing — and because node noise is a pure function of
+        (partition, node id), the chunked walk must be BIT-IDENTICAL to
+        the unchunked one."""
+        from pipelinedp_tpu import jax_engine as je
+        rng = np.random.default_rng(88)
+        n = 5_000
+        ds = pdp.ArrayDataset(
+            privacy_ids=rng.integers(0, 1_200, n),
+            partition_keys=rng.integers(0, 5, n),
+            values=rng.uniform(0.0, 20.0, n))
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.PERCENTILE(25), pdp.Metrics.PERCENTILE(50),
+                     pdp.Metrics.PERCENTILE(75), pdp.Metrics.PERCENTILE(95)],
+            max_partitions_contributed=5,
+            max_contributions_per_partition=50,
+            min_value=0.0, max_value=20.0)
+
+        def run(want_rounds):
+            ds.invalidate_cache()
+            acc = pdp.NaiveBudgetAccountant(total_epsilon=BIG_EPS,
+                                            total_delta=1e-2)
+            engine = pdp.DPEngine(acc, JaxBackend(rng_seed=7))
+            res = engine.aggregate(ds, params, pdp.DataExtractors(),
+                                   public_partitions=list(range(5)))
+            acc.compute_budgets()
+            got = dict(res)
+            assert res.timings["stream_batches"] > 1
+            # Guard against the test going vacuous: the chunking must
+            # actually have happened (or actually not have).
+            assert res.timings["stream_pass_b_rounds"] == want_rounds
+            return got
+
+        full = run(want_rounds=1)
+        # Cap fits exactly ONE quantile's [P_pad, 1, span] block ->
+        # 4 pass-B rounds.
+        _, _, _, span = streaming._tree_consts()
+        monkeypatch.setattr(je, "_SUBHIST_BYTE_CAP", 8 * span * 4)
+        chunked = run(want_rounds=4)
+        for p in range(5):
+            for f in ("percentile_25", "percentile_50", "percentile_75",
+                      "percentile_95"):
+                assert getattr(chunked[p], f) == getattr(full[p], f), (
+                    p, f)
+        # One quantile over the cap is still refused with the cause.
+        monkeypatch.setattr(je, "_SUBHIST_BYTE_CAP", 4)
+        with pytest.raises(NotImplementedError, match="partition count"):
+            run(want_rounds=0)
+
     def test_pass_b_reship_matches_device_cache(self, monkeypatch):
         """Pass B over the device-resident batch cache and pass B
         re-shipping every batch must produce IDENTICAL percentiles
