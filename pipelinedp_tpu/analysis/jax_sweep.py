@@ -478,6 +478,8 @@ def _metric_chunk(metric_name, x_u, marker, pk_safe, p_u, bounds_lo,
 
     pp = {}
     if per_partition:
+        # Field names must stay in sync with _PP_FIELDS (the split
+        # helper keys the per-partition extraction on that list).
         pp = {"pp_sum": psum, "pp_err_min": e_min, "pp_err_max": e_max,
               "pp_exp_l0": exp_l0, "pp_var_l0": var_l0}
 
@@ -588,21 +590,42 @@ _sweep_chunk_kernel = functools.partial(
     static_argnames=("metric_names", "strategy", "noise_kind", "P",
                      "public", "chunk", "per_partition"))(_sweep_chunk_body)
 
+#: The [P, Cc] per-partition blocks _metric_chunk emits (plus the
+#: metric-independent "_pp_keep") — ONE list for the emission, the
+#: single-device extraction and the mesh extraction.
+_PP_FIELDS = ("pp_sum", "pp_err_min", "pp_err_max", "pp_exp_l0",
+              "pp_var_l0")
+
+
+def _split_pp(out, metric_names):
+    """Pops the per-partition blocks out of a chunk's output dict into
+    the flat-keyed dict (``_pp_keep`` / ``<metric>.<field>``) the
+    driver accumulates."""
+    pp = {"_pp_keep": out.pop("_pp_keep")}
+    for nm in metric_names:
+        for f in _PP_FIELDS:
+            pp[f"{nm}.{f}"] = out[nm].pop(f)
+    return pp
+
 
 @functools.partial(
     jax.jit,
     static_argnames=("metric_names", "strategy", "noise_kind", "P",
-                     "public", "chunk", "mesh"))
+                     "public", "chunk", "mesh", "per_partition"))
 def _sweep_chunk_sharded(metric_names, strategy, noise_kind, P, public,
                          chunk, mesh, start, marker, pk_safe, count_u,
                          sum_u, npart_u, users_pk, l0, linf, min_sum,
                          max_sum, noise_std_rows, table, thr, scale,
-                         is_tg, is_lap, is_gauss, log_rs, t_table):
+                         is_tg, is_lap, is_gauss, log_rs, t_table,
+                         per_partition=False):
     """The chunk body over a device mesh: rows and the (padded) config
     vectors replicated, the chunk's configuration axis SPLIT — device d
     slices its chunk/n_dev configs at ``start + d*(chunk/n_dev)`` on
     device; outputs come back sharded along the config axis (no
-    collectives needed)."""
+    collectives needed). With ``per_partition`` the [P, Cc] blocks come
+    back as a third pytree sharded along their CONFIG axis (dim 1) —
+    ``return_per_partition`` stays fused on the mesh; the keys match
+    the single-device driver's (``_pp_keep`` / ``<metric>.pp_*``)."""
     from jax.sharding import PartitionSpec as PSpec
 
     from pipelinedp_tpu.parallel.sharded import _CHECK_KW, shard_map
@@ -616,13 +639,17 @@ def _sweep_chunk_sharded(metric_names, strategy, noise_kind, P, public,
 
     def body(start, *args):
         my_start = start + jax.lax.axis_index(axis) * local
-        return _sweep_chunk_body(metric_names, strategy, noise_kind, P,
-                                 public, local, my_start, *args)
+        out, sel = _sweep_chunk_body(metric_names, strategy, noise_kind,
+                                     P, public, local, my_start, *args,
+                                     per_partition=per_partition)
+        pp = _split_pp(out, metric_names) if per_partition else {}
+        return out, sel, pp
 
     mapped = shard_map(
         body, mesh=mesh,
         in_specs=(repl,) * 20,
-        out_specs=shard, **{check_kw: False})
+        out_specs=(shard, shard, PSpec(None, axis)),
+        **{check_kw: False})
     return mapped(start, marker, pk_safe, count_u, sum_u, npart_u,
                   users_pk, l0, linf, min_sum, max_sum, noise_std_rows,
                   table, thr, scale, is_tg, is_lap, is_gauss, log_rs,
@@ -846,16 +873,16 @@ class LazySweepResult:
         per_partition = self._return_per_partition
         if per_partition:
             # Decide the host fallback BEFORE any device placement: the
-            # fetched [P, C] blocks' budget only needs the encode, and
-            # the mesh gate needs nothing at all. The config axis is
-            # chunk-padded on device, so budget C + _CHUNK_CAP columns.
+            # fetched [P, C] blocks' budget only needs the encode. The
+            # config axis is chunk-padded on device, so budget
+            # C + _CHUNK_CAP columns. (A mesh changes nothing here: the
+            # blocks come back config-axis-sharded and gather to the
+            # same host footprint.)
             n_metrics = sum(1 for m, _, _ in _METRIC_ORDER
                             if m in params.metrics)
             pp_bytes = (P_pad * (C + _CHUNK_CAP) *
                         (5 * n_metrics + 1) * 4)
-            if pp_bytes > _PP_BYTE_CAP or (
-                    self._mesh is not None and
-                    self._mesh.devices.size > 1):
+            if pp_bytes > _PP_BYTE_CAP:
                 return self._host_fallback()
 
         if options.pre_aggregated_data:
@@ -995,24 +1022,21 @@ class LazySweepResult:
         pp_chunks = []
         for start in range(0, C, chunk):
             if self._mesh is not None and n_dev > 1:
-                out, sel = _sweep_chunk_sharded(
+                out, sel, pp = _sweep_chunk_sharded(
                     metric_names, strategy, noise_kind, P_pad, public,
                     chunk, self._mesh, np.int32(start), marker, pk_safe,
                     count_u, sum_u, npart_u, users_in, *cfg, dlog_rs,
-                    dt_table)
+                    dt_table, per_partition=per_partition)
+                if per_partition:
+                    pp_chunks.append(pp)
             else:
                 out, sel = _sweep_chunk_kernel(
                     metric_names, strategy, noise_kind, P_pad, public,
                     chunk, np.int32(start), marker, pk_safe, count_u,
                     sum_u, npart_u, users_in, *cfg, dlog_rs, dt_table,
                     per_partition=per_partition)
-            if per_partition:
-                pp = {"_pp_keep": out.pop("_pp_keep")}
-                for nm in metric_names:
-                    for f in ("pp_sum", "pp_err_min", "pp_err_max",
-                              "pp_exp_l0", "pp_var_l0"):
-                        pp[f"{nm}.{f}"] = out[nm].pop(f)
-                pp_chunks.append(pp)
+                if per_partition:
+                    pp_chunks.append(_split_pp(out, metric_names))
             chunk_outs.append((out, sel))
 
         out_cat = jax.tree.map(lambda *xs: jnp.concatenate(xs, 0),

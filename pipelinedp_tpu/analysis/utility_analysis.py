@@ -27,16 +27,9 @@ def perform_utility_analysis(col, backend,
     ``return_per_partition``, whose [P, C] error blocks are fetched from
     the same stage-B pass the aggregate reduction consumes (reference
     emits per-partition metrics from the same pass,
-    ``analysis/utility_analysis.py:60-77``); the host graph below remains
-    the oracle and the fallback."""
-    mesh = getattr(backend, "mesh", None)
-    if (return_per_partition and mesh is not None and
-            mesh.devices.size > 1):
-        # The per-partition fetch is single-device (its [P, C] blocks
-        # would need partition-axis out_specs on a mesh); decide here,
-        # before any encode/device work.
-        return _host_analysis(col, backend, options, data_extractors,
-                              public_partitions, return_per_partition)
+    ``analysis/utility_analysis.py:60-77``), on one device AND on a
+    mesh (the blocks come back config-axis-sharded); the host graph
+    below remains the oracle and the fallback."""
     if getattr(backend, "supports_fused_aggregation", False):
         from pipelinedp_tpu.analysis import jax_sweep
         if jax_sweep.sweep_is_supported(options, data_extractors,
