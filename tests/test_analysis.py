@@ -952,15 +952,15 @@ class TestFusedSweepPerPartition:
     _dataset = staticmethod(TestFusedSweep._dataset)
 
     @staticmethod
-    def _run_both_pp(ds, options, public=None):
+    def _run_both_pp(ds, options, public=None, backend=None):
         from pipelinedp_tpu.backends import JaxBackend
         ex = pdp.DataExtractors()
         _, host_pp = analysis.perform_utility_analysis(
             ds, pdp.LocalBackend(), options, ex, public_partitions=public,
             return_per_partition=True)
         fused_res, fused_pp = analysis.perform_utility_analysis(
-            ds, JaxBackend(), options, ex, public_partitions=public,
-            return_per_partition=True)
+            ds, backend or JaxBackend(), options, ex,
+            public_partitions=public, return_per_partition=True)
         return dict(host_pp), dict(fused_pp), fused_res
 
     @staticmethod
@@ -1016,9 +1016,13 @@ class TestFusedSweepPerPartition:
         """return_per_partition stays FUSED on a multi-device mesh
         (VERDICT r4 #7): the config-axis-sharded [P, C] blocks gather
         to the same rows the host oracle produces."""
+        import jax
         from pipelinedp_tpu.backends import JaxBackend
         from pipelinedp_tpu.parallel import make_mesh
         from pipelinedp_tpu.analysis import jax_sweep
+        # A 1-device mesh would take the single-device branch and make
+        # everything below vacuous.
+        assert len(jax.devices()) >= 8
         # Fail LOUDLY if the mesh run reroutes to the host graph — the
         # rows would trivially match the oracle and mask the regression.
         monkeypatch.setattr(
@@ -1033,18 +1037,11 @@ class TestFusedSweepPerPartition:
             epsilon=2.0, delta=1e-6,
             aggregate_params=count_params(l0=4, linf=2),
             multi_param_configuration=multi)
-        ex = pdp.DataExtractors()
-        _, host_pp = analysis.perform_utility_analysis(
-            ds, pdp.LocalBackend(), options, ex,
-            return_per_partition=True)
-        fused_res, fused_pp = analysis.perform_utility_analysis(
-            ds, JaxBackend(mesh=make_mesh(8)), options, ex,
-            return_per_partition=True)
-        from pipelinedp_tpu.analysis import jax_sweep
+        host, fused, fused_res = self._run_both_pp(
+            ds, options, backend=JaxBackend(mesh=make_mesh(8)))
         assert isinstance(fused_res, jax_sweep.LazySweepResult), (
             "mesh + return_per_partition fell back to the host graph")
-        self._assert_rows_match(dict(host_pp), dict(fused_pp),
-                                private=True)
+        self._assert_rows_match(host, fused, private=True)
 
     def test_byte_cap_falls_back_to_host(self, monkeypatch):
         from pipelinedp_tpu.analysis import jax_sweep
