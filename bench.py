@@ -386,13 +386,15 @@ def roofline_probe(ds):
         return best
 
     # Quantile-walk pieces at bench shape: the per-quantile relevance
-    # flags + compaction sort (the rewritten sub-histogram path: one
-    # packed-block gather + byte compares per 4 quantiles, one stable
-    # 1-key argsort) and one [P, 256] top-histogram scatter. Traffic
-    # models: flags read qpk+leaf+1 gather word and write 1 byte
-    # (~13 B/row); the top-hist scatter reads key+payload and
-    # read-modify-writes its output (~16 B/row); the argsort is a
-    # bitonic network over (flag, index).
+    # flags + prefix-sum compaction (the r5 sub-histogram path: one
+    # packed-block gather + byte compares per 4 quantiles, a cumsum of
+    # the flags and two monotone int32 scatters into the n/8 prefix —
+    # replacing the former stable argsort's bitonic network) and one
+    # [P, 256] top-histogram scatter. Traffic models: flags read
+    # qpk+leaf+1 gather word and write 1 byte (~13 B/row); cumsum +
+    # dest + 2 scatters are ~4 more int32 passes (~16 B/row); the
+    # top-hist scatter reads key+payload and read-modify-writes its
+    # output (~16 B/row).
     P_walk = 1 << 17
     Q = 3
     blk = jax.random.randint(jax.random.fold_in(key, 1), (P_walk, Q), 0,
@@ -408,7 +410,12 @@ def roofline_probe(ds):
         mid = leaf >> 8
         rel_any = ((mid == (pr & 0xFF)) | (mid == ((pr >> 8) & 0xFF)) |
                    (mid == ((pr >> 16) & 0xFF)))
-        return jnp.argsort(~rel_any, stable=True)[0]
+        cap = max(8192, n // 8)
+        dest = jnp.where(rel_any,
+                         jnp.cumsum(rel_any.astype(jnp.int32)) - 1, cap)
+        qpk_c = jnp.zeros(cap, jnp.int32).at[dest].set(qpk, mode="drop")
+        row_c = jnp.zeros(cap, jnp.int32).at[dest].set(leaf, mode="drop")
+        return qpk_c[0] + row_c[0]
 
     @jax.jit
     def top_hist(qpk, leaf):
@@ -427,7 +434,7 @@ def roofline_probe(ds):
     stages = math.log2(n) * (math.log2(n) + 1) / 2
     sort_bytes = stages * n * 16 * 2
     hbm_peak = 810e9
-    walk_bytes = n * 13 + stages * n * 8 * 2  # flags + 2-word bitonic
+    walk_bytes = n * (13 + 16)  # flags + cumsum/dest/2-scatter passes
     hist_bytes = n * 16
     rec = {
         "metric": "roofline",

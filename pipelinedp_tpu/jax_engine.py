@@ -1264,32 +1264,63 @@ def _percentile_values(config: FusedConfig, P, qrows, scale, key):
                         for ok in row_masks(qpk_r, mid_r, kept_r)
                     ], axis=1)  # [P, Q, span] int32
 
-                if n_blocks <= 256:
+                if n_blocks <= 256 and shift <= 22:
                     # The chosen subtrees jointly cover ~Q/n_blocks of
                     # the leaf space, so typically ~1% of rows land in
                     # ANY sub-histogram — yet a full scatter scans every
                     # row. Compact the relevant rows to a static n/8
-                    # prefix with one stable single-key sort and scatter
-                    # the prefix (~free); data concentrated enough to
-                    # overflow the prefix (e.g. all-equal values) falls
-                    # back to full-row scatters via lax.cond.
+                    # prefix by PREFIX-SUM scatter: each relevant row's
+                    # destination is its rank among relevant rows
+                    # (cumsum), so two O(n) passes replace the former
+                    # stable argsort's ~log^2 n bitonic stages (the
+                    # walk's furthest-from-roofline op, r4 README). The
+                    # destinations are unique and monotone — the
+                    # scatter coalesces; irrelevant rows target index
+                    # ``cap`` and drop out of bounds, as do relevant
+                    # rows past the cap (data concentrated enough to
+                    # overflow falls back to full-row scatters via
+                    # lax.cond). The three row fields pack into one
+                    # int32 (mid <= 8 bits by the n_blocks gate,
+                    # lo_bits < span = 2^shift <= 2^22 by the shift
+                    # gate, kept 1 bit), so compaction is exactly two
+                    # int32 scatters.
                     n_rows = leaf.shape[0]
                     cap = max(8192, n_rows // 8)
                     rel_any = jnp.zeros(n_rows, bool)
                     for ok in row_masks(qpk, mid, kept):
                         rel_any |= ok
-                    order = jnp.argsort(~rel_any, stable=True)[:cap]
                     n_rel = jnp.sum(rel_any.astype(jnp.int32))
 
                     def compacted(_):
-                        return subs_over(qpk[order], mid[order],
-                                         lo_bits[order], kept[order])
+                        # Built INSIDE the branch: cond operands are
+                        # computed unconditionally, so hoisting these
+                        # would make the overflow fallback pay for
+                        # both paths.
+                        dest = jnp.where(
+                            rel_any,
+                            jnp.cumsum(rel_any.astype(jnp.int32)) - 1,
+                            cap)
+                        packed_row = (
+                            mid | (lo_bits << 8) |
+                            (kept.astype(jnp.int32) << (8 + shift)))
+                        qpk_c = jnp.zeros(cap, jnp.int32).at[dest].set(
+                            qpk, mode="drop")
+                        row_c = jnp.zeros(cap, jnp.int32).at[dest].set(
+                            packed_row, mode="drop")
+                        return subs_over(qpk_c, row_c & 0xFF,
+                                         (row_c >> 8) & (span - 1),
+                                         (row_c >> (8 + shift)
+                                          ).astype(bool))
 
                     def full(_):
                         return subs_over(qpk, mid, lo_bits, kept)
 
                     sub_hist = jax.lax.cond(n_rel <= cap, compacted,
                                             full, None)
+                elif n_blocks <= 256:
+                    # Exotic tree shapes whose packed row would overflow
+                    # int32: no compaction, full-row scatters.
+                    sub_hist = subs_over(qpk, mid, lo_bits, kept)
                 else:  # non-default tree shapes: block ids > 8 bits
                     sub_hist = _subtree_counts(qpk, leaf, kept,
                                                sub_start, P, span)
