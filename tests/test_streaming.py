@@ -697,6 +697,36 @@ class TestStreamingInternals:
                 offset += int(c)
             assert offset == n
 
+    def test_wide_id_space_streams_exactly(self):
+        """Privacy ids >= 2^24 force the "i32" plane spec, whose narrow
+        planes ARE the reused staging buffer — the ship path must copy
+        them (the delayed fold means the previous batch's kernel may
+        still be reading its input when the next batch stages)."""
+        from pipelinedp_tpu import jax_engine as je
+        rng = np.random.default_rng(55)
+        n = 9_000
+        pid = rng.integers((1 << 24) + 1, 1 << 30, n)
+        ds = pdp.ArrayDataset(
+            privacy_ids=pid,
+            partition_keys=rng.integers(0, 10, n),
+            values=rng.uniform(0.0, 10.0, n))
+        enc = je.encode(ds, pdp.DataExtractors(), None, None)
+        # The guard must hold on the ENCODED ids (what ships): if a
+        # future encode densifies pids this test must fail loudly
+        # rather than silently stop covering the i32 path.
+        assert je._plane_spec(int(enc.pid.max())) == "i32"
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            max_partitions_contributed=10,
+            max_contributions_per_partition=50,
+            min_value=0.0, max_value=10.0)
+        got = run_streamed(ds, params, public=list(range(10)))
+        for p in range(10):
+            m = ds.partition_keys == p
+            assert got[p].count == pytest.approx(m.sum(), abs=0.5)
+            assert got[p].sum == pytest.approx(ds.values[m].sum(),
+                                               rel=1e-5)
+
     def test_chunk_target_capped_by_lane_capacity(self, monkeypatch):
         """A big mesh must not scale value-config batches past the
         global fixed-point lane capacity (the psum makes lane capacity
