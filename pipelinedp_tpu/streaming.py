@@ -116,8 +116,10 @@ def should_stream(config, n_rows: int, mesh) -> bool:
     """The engine streams when one batch can't hold the pipeline.
     Streaming COMPOSES with a mesh: each chunk's rows are sharded by
     privacy id over the mesh exactly like the single-batch sharded
-    kernel, the per-pk partials ride ONE ``psum_scatter`` to owner
-    blocks per chunk, and the owner blocks (additive across chunks)
+    kernel, and the per-pk partials combine in ONE collective per chunk
+    — a ``psum_scatter`` to owner blocks (state/ICI O(P/n_dev)) on a
+    single-controller mesh, a replicating ``psum`` (O(P) per device,
+    every process fetches its own copy) on a multi-process mesh — then
     fold into the same host accumulators as the single-device stream.
     On a mesh the per-chunk row budget scales with the device count
     (up to the global lane capacity): every device still sees at most
@@ -230,6 +232,18 @@ def _sharded_partials_kernel(config, num_partitions, mesh, planes, values,
     from pipelinedp_tpu.parallel import sharded as psh
     axis = mesh.axis_names[0]
     has_vec = "VECTOR_SUM" in config.metrics
+    # Single-controller meshes keep owner blocks (state and ICI traffic
+    # O(P/n_dev)); a multi-PROCESS mesh replicates the combined
+    # accumulators instead (full psum) so every process can fetch its
+    # own copy — host-fetching another process's owner block is not
+    # addressable. O(P) per device, the classic allreduce tradeoff.
+    multiproc = mesh.is_multi_process
+
+    def _combine(x, dim):
+        if multiproc:
+            return jax.lax.psum(x, axis)
+        return jax.lax.psum_scatter(x, axis, scatter_dimension=dim,
+                                    tiled=True)
 
     def local_fn(planes, values, n_valid, key):
         k_bound = jax.random.fold_in(key, jax.lax.axis_index(axis))
@@ -237,25 +251,21 @@ def _sharded_partials_kernel(config, num_partitions, mesh, planes, values,
                                         values, n_valid[0], k_bound,
                                         fx_bits, n_pid_planes)
         packed, vec = _pack_rank1(part, nseg)
-        outs = [jax.lax.psum_scatter(packed, axis, scatter_dimension=1,
-                                     tiled=True)]
+        outs = [_combine(packed, 1)]
         if vec is not None:
-            outs.append(jax.lax.psum_scatter(vec, axis,
-                                             scatter_dimension=0,
-                                             tiled=True))
+            outs.append(_combine(vec, 0))
         if config.percentiles:
             mid = _mid_histogram(config, num_partitions, qrows)
-            outs.append(jax.lax.psum_scatter(mid, axis,
-                                             scatter_dimension=0,
-                                             tiled=True))
+            outs.append(_combine(mid, 0))
         return tuple(outs)
 
     shard, repl = psh.PSpec(axis), psh.PSpec()
-    out_specs = [psh.PSpec(None, axis)]
+    own = repl if multiproc else shard
+    out_specs = [repl if multiproc else psh.PSpec(None, axis)]
     if has_vec:
-        out_specs.append(shard)
+        out_specs.append(own)
     if config.percentiles:
-        out_specs.append(shard)
+        out_specs.append(own)
     mapped = psh.shard_map(
         local_fn, mesh=mesh,
         in_specs=(tuple(shard for _ in planes), shard, shard, repl),
@@ -280,6 +290,7 @@ def _sharded_pct_sub_kernel(config, num_partitions, mesh, planes, values,
     from pipelinedp_tpu.parallel import sharded as psh
     axis = mesh.axis_names[0]
     _, _, _, span = _tree_consts()
+    multiproc = mesh.is_multi_process  # see _sharded_partials_kernel
 
     def local_fn(planes, values, n_valid, key, sub_start):
         k_bound = jax.random.fold_in(key, jax.lax.axis_index(axis))
@@ -289,6 +300,8 @@ def _sharded_pct_sub_kernel(config, num_partitions, mesh, planes, values,
         qpk, leaf, kept = qrows
         sub = je._subtree_counts(qpk, leaf, kept, sub_start,
                                  num_partitions, span)
+        if multiproc:
+            return jax.lax.psum(sub, axis)
         return jax.lax.psum_scatter(sub, axis, scatter_dimension=0,
                                     tiled=True)
 
@@ -296,7 +309,8 @@ def _sharded_pct_sub_kernel(config, num_partitions, mesh, planes, values,
     mapped = psh.shard_map(
         local_fn, mesh=mesh,
         in_specs=(tuple(shard for _ in planes), shard, shard, repl, repl),
-        out_specs=shard, **{psh._CHECK_KW: False})
+        out_specs=repl if multiproc else shard,
+        **{psh._CHECK_KW: False})
     return mapped(planes, values, n_valid_shard, key, sub_start)
 
 
@@ -420,10 +434,12 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
     and reduced by the sharded kernels; host accumulation, selection
     and release are IDENTICAL to the single-device stream (the owner
     blocks concatenate to the same [C+1, P] layout). Fetches gather
-    the owner-sharded outputs through the single-controller runtime;
-    a true multi-host deployment would fetch only the process-local
-    blocks (``jax.experimental.multihost_utils``), which this harness
-    cannot exercise."""
+    the owner-sharded outputs through the single-controller runtime.
+    On a multi-PROCESS mesh (``jax.distributed``) the kernels switch
+    from owner-block ``psum_scatter`` to a replicating ``psum`` so
+    every process fetches its own complete copy and runs the identical
+    host fold/selection — proven across a two-process gloo mesh by
+    ``tests/test_multihost.py``."""
     from pipelinedp_tpu.ops import noise as noise_ops
 
     n_dev = mesh.devices.size if mesh is not None else 1

@@ -77,8 +77,46 @@ def main() -> None:
         assert abs(sharded[k].count - m.sum()) < 1.0
         assert abs(sharded[k].sum - vals[m].sum()) < 1.0
         assert abs(sharded[k].count - local[k].count) < 1e-6
+
+    # STREAMING over the cross-process mesh: force tiny per-device
+    # chunks so the same dataset streams through >= 3 sharded chunks
+    # (replicated-psum exchange — every process folds its own copy).
+    # PERCENTILE is included deliberately: its two-pass walk host-
+    # fetches the top-walk state and the pass-B subtree histograms,
+    # the exact fetch class that breaks on non-addressable shards —
+    # this run proves those fetches across the process boundary too.
+    os.environ["PIPELINEDP_TPU_STREAM_CHUNK"] = "500"
+    try:
+        ds.invalidate_cache()
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=1e8,
+                                        total_delta=1e-6)
+        engine = pdp.DPEngine(acc, JaxBackend(mesh=mesh, rng_seed=11))
+        stream_params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM,
+                     pdp.Metrics.PERCENTILE(50)],
+            max_partitions_contributed=50,
+            max_contributions_per_partition=50,
+            min_value=0.0, max_value=10.0)
+        res = engine.aggregate(ds, stream_params, pdp.DataExtractors(),
+                               public_partitions=list(range(40)))
+        acc.compute_budgets()
+        streamed = dict(res)
+        n_batches = res.timings.get("stream_batches", 0)
+        assert n_batches >= 3, (
+            f"dataset did not stream over the 2-process mesh "
+            f"({n_batches} batches)")
+        for k in range(40):
+            m = pk == k
+            assert abs(streamed[k].count - m.sum()) < 1.0
+            assert abs(streamed[k].sum - vals[m].sum()) < 1.0
+            true_med = float(np.percentile(vals[m], 50))
+            assert abs(streamed[k].percentile_50 - true_med) < 0.5, (
+                k, streamed[k].percentile_50, true_med)
+    finally:
+        del os.environ["PIPELINEDP_TPU_STREAM_CHUNK"]
+
     print(f"proc {proc_id}: OK ({len(sharded)} partitions kept, "
-          f"mesh={mesh.shape})", flush=True)
+          f"streamed {n_batches} chunks, mesh={mesh.shape})", flush=True)
 
 
 if __name__ == "__main__":
