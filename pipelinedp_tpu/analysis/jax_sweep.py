@@ -636,6 +636,11 @@ def _sweep_chunk_sharded(metric_names, strategy, noise_kind, P, public,
     shard = PSpec(axis)
     repl = PSpec()
     check_kw = _CHECK_KW
+    # Multi-process meshes replicate the (small, [Cc]-sized) outputs
+    # with one all_gather so every process fetches its own copy —
+    # config-axis shards on another process are not host-addressable
+    # (same tradeoff as the streaming kernels' psum switch).
+    multiproc = mesh.is_multi_process
 
     def body(start, *args):
         my_start = start + jax.lax.axis_index(axis) * local
@@ -643,12 +648,19 @@ def _sweep_chunk_sharded(metric_names, strategy, noise_kind, P, public,
                                      P, public, local, my_start, *args,
                                      per_partition=per_partition)
         pp = _split_pp(out, metric_names) if per_partition else {}
+        if multiproc:
+            def ag(x, dim):
+                return jax.lax.all_gather(x, axis, axis=dim, tiled=True)
+            out = jax.tree.map(lambda x: ag(x, 0), out)
+            sel = jax.tree.map(lambda x: ag(x, 0), sel)
+            pp = jax.tree.map(lambda x: ag(x, 1), pp)
         return out, sel, pp
 
     mapped = shard_map(
         body, mesh=mesh,
         in_specs=(repl,) * 20,
-        out_specs=(shard, shard, PSpec(None, axis)),
+        out_specs=((repl, repl, repl) if multiproc else
+                   (shard, shard, PSpec(None, axis))),
         **{check_kw: False})
     return mapped(start, marker, pk_safe, count_u, sum_u, npart_u,
                   users_pk, l0, linf, min_sum, max_sum, noise_std_rows,

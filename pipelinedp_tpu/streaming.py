@@ -146,6 +146,18 @@ def _tree_consts():
     return b, height, b * b, b**(height - 2)  # (b, height, n_mid, bucket_w)
 
 
+def _combine_shards(x, axis, dim, multiproc):
+    """The ONE cross-shard exchange policy for every streaming kernel:
+    owner-block ``psum_scatter`` along ``dim`` (state/ICI O(P/n_dev))
+    on a single-controller mesh; replicating ``psum`` (every process
+    fetches its own copy — another process's owner block is not
+    host-addressable) on a multi-process mesh."""
+    if multiproc:
+        return jax.lax.psum(x, axis)
+    return jax.lax.psum_scatter(x, axis, scatter_dimension=dim,
+                                tiled=True)
+
+
 def _chunk_body(config, num_partitions, planes, values, n_valid, key,
                 fx_bits, n_pid_planes):
     """The shared per-chunk trace: widen the narrow id planes, derive
@@ -232,18 +244,10 @@ def _sharded_partials_kernel(config, num_partitions, mesh, planes, values,
     from pipelinedp_tpu.parallel import sharded as psh
     axis = mesh.axis_names[0]
     has_vec = "VECTOR_SUM" in config.metrics
-    # Single-controller meshes keep owner blocks (state and ICI traffic
-    # O(P/n_dev)); a multi-PROCESS mesh replicates the combined
-    # accumulators instead (full psum) so every process can fetch its
-    # own copy — host-fetching another process's owner block is not
-    # addressable. O(P) per device, the classic allreduce tradeoff.
     multiproc = mesh.is_multi_process
 
     def _combine(x, dim):
-        if multiproc:
-            return jax.lax.psum(x, axis)
-        return jax.lax.psum_scatter(x, axis, scatter_dimension=dim,
-                                    tiled=True)
+        return _combine_shards(x, axis, dim, multiproc)
 
     def local_fn(planes, values, n_valid, key):
         k_bound = jax.random.fold_in(key, jax.lax.axis_index(axis))
@@ -300,10 +304,7 @@ def _sharded_pct_sub_kernel(config, num_partitions, mesh, planes, values,
         qpk, leaf, kept = qrows
         sub = je._subtree_counts(qpk, leaf, kept, sub_start,
                                  num_partitions, span)
-        if multiproc:
-            return jax.lax.psum(sub, axis)
-        return jax.lax.psum_scatter(sub, axis, scatter_dimension=0,
-                                    tiled=True)
+        return _combine_shards(sub, axis, 0, multiproc)
 
     shard, repl = psh.PSpec(axis), psh.PSpec()
     mapped = psh.shard_map(

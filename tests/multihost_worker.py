@@ -115,8 +115,35 @@ def main() -> None:
     finally:
         del os.environ["PIPELINEDP_TPU_STREAM_CHUNK"]
 
+    # The analysis sweep over the cross-process mesh: config axis split
+    # across processes, outputs all_gathered so each process packs its
+    # own copy; must match the single-device sweep.
+    from pipelinedp_tpu import analysis
+    multi = analysis.MultiParameterConfiguration(
+        max_partitions_contributed=list(range(1, 9)),
+        max_contributions_per_partition=[2] * 8)
+    options = analysis.UtilityAnalysisOptions(
+        epsilon=1.0, delta=1e-6,
+        aggregate_params=pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT], max_partitions_contributed=4,
+            max_contributions_per_partition=2),
+        multi_param_configuration=multi)
+    ds.invalidate_cache()
+    sweep_mesh = list(analysis.perform_utility_analysis(
+        ds, JaxBackend(mesh=mesh, rng_seed=11), options,
+        pdp.DataExtractors()))[0]
+    ds.invalidate_cache()
+    sweep_one = list(analysis.perform_utility_analysis(
+        ds, JaxBackend(rng_seed=11), options, pdp.DataExtractors()))[0]
+    assert len(sweep_mesh) == len(sweep_one) == 8
+    for a, b in zip(sweep_one, sweep_mesh):
+        av = a.count_metrics.error_expected
+        bv = b.count_metrics.error_expected
+        assert abs(av - bv) <= 1e-4 * max(1.0, abs(av)), (av, bv)
+
     print(f"proc {proc_id}: OK ({len(sharded)} partitions kept, "
-          f"streamed {n_batches} chunks, mesh={mesh.shape})", flush=True)
+          f"streamed {n_batches} chunks, 8-config sweep, "
+          f"mesh={mesh.shape})", flush=True)
 
 
 if __name__ == "__main__":
