@@ -12,8 +12,13 @@ end. Percentiles stream too, in two passes (see the module docstring).
 
 Nothing in the user code changes — this demo just forces a small chunk
 so a 2M-row dataset visibly streams. With the default chunk a dataset
-only streams past 67M rows (the bench's ``--stream-rows`` record runs
-150M).
+only streams past 67M rows per device (the bench's ``--stream-rows``
+record runs 150M). Streaming composes with a device mesh
+(``JaxBackend(mesh=make_mesh())``): each chunk shards by privacy id
+over the mesh and the per-chunk budget scales with the device count.
+Batch transfer overlaps the previous batch's kernel, and percentile
+pass B re-reads shipped batches from a device cache
+(``PIPELINEDP_TPU_STREAM_CACHE``) instead of re-shipping them.
 
 Usage: python examples/streaming_ingest.py
 """
