@@ -595,6 +595,33 @@ class TestStreamedOnMesh:
             assert got[p].percentile_50 == pytest.approx(true[0], abs=0.5)
             assert got[p].percentile_90 == pytest.approx(true[1], abs=0.5)
 
+    def test_private_selection_with_percentiles_on_mesh(self,
+                                                        monkeypatch):
+        """Private selection + two-pass percentiles, streamed over the
+        mesh: heavy partitions survive selection and carry accurate
+        medians; single-user tail partitions are dropped."""
+        monkeypatch.setenv("PIPELINEDP_TPU_STREAM_CHUNK", "400")
+        rng = np.random.default_rng(45)
+        n = 9_000
+        pid = rng.integers(0, 2_500, n)
+        # 4 heavy partitions + a tail of single-user partitions.
+        pk = np.where(np.arange(n) % 20 < 19, rng.integers(0, 4, n),
+                      4 + (np.arange(n) % 150))
+        ds = pdp.ArrayDataset(privacy_ids=pid,
+                              partition_keys=pk.astype(np.int64),
+                              values=rng.uniform(0.0, 40.0, n))
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.PERCENTILE(50)],
+            max_partitions_contributed=4,
+            max_contributions_per_partition=50,
+            min_value=0.0, max_value=40.0)
+        got = self.run_mesh_streamed(ds, params, eps=1e6)
+        assert set(range(4)) <= set(got)
+        for p in range(4):
+            m = pk == p
+            true = float(np.percentile(ds.values[m], 50))
+            assert got[p].percentile_50 == pytest.approx(true, abs=1.0)
+
     def test_vector_sum_streams_on_mesh(self, monkeypatch):
         monkeypatch.setenv("PIPELINEDP_TPU_STREAM_CHUNK", "300")
         rng = np.random.default_rng(42)
