@@ -597,14 +597,16 @@ class TestStreamedOnMesh:
 
     def test_private_selection_with_percentiles_on_mesh(self,
                                                         monkeypatch):
-        """Private selection + two-pass percentiles, streamed over the
-        mesh: heavy partitions survive selection and carry accurate
-        medians; single-user tail partitions are dropped."""
+        """PRIVATE selection + two-pass percentiles, streamed over the
+        mesh: the selection kernel runs (not the public bypass) and the
+        kept partitions carry accurate medians. At huge eps selection
+        keeps everything it sees — the DROPPING behavior on the mesh
+        stream is pinned at moderate eps by
+        ``TestStreamedSelectPartitions.test_select_partitions_streams_on_mesh``."""
         monkeypatch.setenv("PIPELINEDP_TPU_STREAM_CHUNK", "400")
         rng = np.random.default_rng(45)
         n = 9_000
         pid = rng.integers(0, 2_500, n)
-        # 4 heavy partitions + a tail of single-user partitions.
         pk = np.where(np.arange(n) % 20 < 19, rng.integers(0, 4, n),
                       4 + (np.arange(n) % 150))
         ds = pdp.ArrayDataset(privacy_ids=pid,
@@ -646,11 +648,14 @@ class TestStreamedOnMesh:
         monkeypatch.setenv("PIPELINEDP_TPU_STREAM_CHUNK", "500")
         rng = np.random.default_rng(43)
         n = 12_000
-        # 5 heavy partitions + a long tail of single-user partitions:
-        # selection at moderate eps keeps the heavy ones.
+        # 5 heavy partitions + a long tail of SINGLE-user partitions
+        # (every tail row is its own partition): selection at moderate
+        # eps must keep the heavy ones AND drop the tail — both sides
+        # asserted, so a selection regression that keeps everything
+        # cannot pass.
         pid = rng.integers(0, 4_000, n)
         pk = np.where(np.arange(n) % 10 < 9, rng.integers(0, 5, n),
-                      5 + rng.integers(0, 200, n))
+                      5 + np.arange(n))
         ds = pdp.ArrayDataset(privacy_ids=pid,
                               partition_keys=pk.astype(np.int64),
                               values=None)
@@ -662,6 +667,11 @@ class TestStreamedOnMesh:
         acc.compute_budgets()
         kept = set(res)
         assert set(range(5)) <= kept
+        tail_kept = [p for p in kept if p >= 5]
+        # ~1200 single-user partitions; DP selection at eps=10 keeps a
+        # single-user partition with vanishing probability (measured: 0
+        # kept for this seed; allow a handful of probabilistic strays).
+        assert len(tail_kept) <= 5, tail_kept
 
     def test_mesh_streamed_matches_single_device_streamed(self,
                                                           monkeypatch):
