@@ -33,7 +33,7 @@ accumulation stays exact while the GLOBAL total stays below 2^53 steps
 is float64 rounding at relative 2^-53 — far below the per-row
 quantization already accepted by the single-batch kernel.
 
-Percentile metrics stream in TWO passes (``stream_is_supported``): the
+Percentile metrics stream in TWO passes: the
 walk's adaptive descent needs the chosen subtrees' leaf counts, which
 only exist after the top levels are walked — so pass A accumulates the
 additive mid-level tree histogram alongside the scalar partials, the
@@ -82,18 +82,6 @@ def stream_cache_bytes() -> int:
     return int(os.environ.get(_CACHE_ENV, 4 << 30))
 
 
-def stream_is_supported(config) -> bool:
-    """Every fused configuration streams. Percentiles stream in TWO
-    passes (the quantile walk's adaptive descent needs the chosen
-    subtrees' leaf counts, which only exist after the top levels are
-    walked): pass A accumulates the additive mid-level histogram and the
-    scalar partials, the top two levels walk on it, pass B re-streams
-    the same deterministic batches to accumulate the chosen subtrees'
-    leaf histograms, and the bottom levels finish — the same math and
-    the same PRNG node noise as the single-batch walk."""
-    return True
-
-
 def chunk_target_rows(config, n_dev: int) -> int:
     """Per-batch GLOBAL row target: the per-device chunk knob times the
     mesh size, capped by the per-batch fixed-point lane capacity for
@@ -123,10 +111,11 @@ def should_stream(config, n_rows: int, mesh) -> bool:
     fold into the same host accumulators as the single-device stream.
     On a mesh the per-chunk row budget scales with the device count
     (up to the global lane capacity): every device still sees at most
-    ``stream_chunk_rows()`` rows."""
+    ``stream_chunk_rows()`` rows. EVERY fused configuration streams —
+    percentiles included, in two passes (see the module docstring) —
+    so size is the only criterion."""
     n_dev = mesh.devices.size if mesh is not None else 1
-    return (n_rows > chunk_target_rows(config, n_dev) and
-            stream_is_supported(config))
+    return n_rows > chunk_target_rows(config, n_dev)
 
 
 def _rank1_names(config, fx_bits: int):
@@ -429,7 +418,7 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
     accumulator columns ready for ``jax_engine._host_release``; for
     percentile configs ``stats["percentile_values"]`` carries the
     [P_pad, Q] walked quantile values (pass B re-streams the batches —
-    see ``stream_is_supported``).
+    see the module docstring).
 
     With a ``mesh``, every chunk is itself pid-sharded over the mesh
     and reduced by the sharded kernels; host accumulation, selection

@@ -602,7 +602,7 @@ class TestStreamedOnMesh:
         kept partitions carry accurate medians. At huge eps selection
         keeps everything it sees — the DROPPING behavior on the mesh
         stream is pinned at moderate eps by
-        ``TestStreamedSelectPartitions.test_select_partitions_streams_on_mesh``."""
+        ``TestStreamedOnMesh.test_select_partitions_streams_on_mesh``."""
         monkeypatch.setenv("PIPELINEDP_TPU_STREAM_CHUNK", "400")
         rng = np.random.default_rng(45)
         n = 9_000
