@@ -1366,16 +1366,28 @@ def _sub_level_counts(sub, sub_start, leaf_lo, w, b):
 
 
 def _walk_level(noise_kind, key, scale, raw, base, level_offset, lo, hi,
-                target, leaf_lo, done, b, w):
+                target, leaf_lo, done, b, w, pk_index=None):
     """One walk level from its raw child counts: node-id-keyed noise +
     descent step. SHARED by the single-batch walk, the owner-sharded
-    walk and the streamed two-pass walk — the streamed/single-batch
-    bit-parity guarantee rests on this being the one copy of the
-    noise-keying + step arithmetic."""
+    walk (which passes its GLOBAL partition ids as ``pk_index``) and
+    the streamed two-pass walk — the mesh/streamed/single-batch
+    bit-parity guarantees rest on this being the one copy of the
+    noise-keying + step arithmetic.
+
+    At the ROOT level every quantile shares base 0, so the [P, Q, b]
+    node ids are Q identical copies — and node noise is a pure function
+    of (partition, node id), so the draws are too: draw once per
+    (partition, child) and broadcast, skipping (Q-1)/Q of the root's
+    threefry work with bit-identical values."""
     node_ids = (level_offset + base)[..., None] + jnp.arange(
         b, dtype=jnp.int32)
-    noisy = jnp.maximum(
-        raw + _node_noise(noise_kind, key, node_ids) * scale, 0.0)
+    if level_offset == 0:
+        noise = jnp.broadcast_to(
+            _node_noise(noise_kind, key, node_ids[:, :1, :], pk_index),
+            node_ids.shape)
+    else:
+        noise = _node_noise(noise_kind, key, node_ids, pk_index)
+    noisy = jnp.maximum(raw + noise * scale, 0.0)
     return _walk_step(noisy, lo, hi, target, leaf_lo, done, b, w)
 
 
@@ -1471,13 +1483,9 @@ def _percentile_values_owned(config: FusedConfig, P_own, qrows, scale,
         raw = jax.lax.psum_scatter(jnp.stack(counts, axis=1), axis,
                                    scatter_dimension=0,
                                    tiled=True).astype(jnp.float32)
-        node_ids = (level_offset + base_own)[..., None] + jnp.arange(
-            b, dtype=jnp.int32)
-        noisy = jnp.maximum(
-            raw + _node_noise(config.noise_kind, key, node_ids,
-                              pk_index) * scale, 0.0)
-        lo, hi, target, leaf_lo, done = _walk_step(
-            noisy, lo, hi, target, leaf_lo, done, b, w)
+        lo, hi, target, leaf_lo, done = _walk_level(
+            config.noise_kind, key, scale, raw, base_own, level_offset,
+            lo, hi, target, leaf_lo, done, b, w, pk_index=pk_index)
         level_offset += b**(level + 1)
     vals = lo + (hi - lo) * target  # [P_own, Q]
     return _monotone_in_q(vals, quantiles)
