@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast bench smoke multichip lint dev clean
+.PHONY: test test-fast bench smoke multichip lint dev clean faultcheck nosleep
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -21,6 +21,25 @@ multichip:
 	# fewer real devices exist; it owns the platform selection (the env
 	# var alone loses to auto-registered TPU plugins).
 	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+# Fault-injection suite (includes the end-to-end degraded-bench run)
+# + the no-direct-sleep invariant.
+faultcheck: nosleep
+	$(PYTHON) -m pytest tests/test_resilience.py tests/test_faults.py -q
+
+# Lint-style check: no library/bench code path may call time.sleep
+# directly — waits must route through the injectable
+# pipelinedp_tpu.resilience.clock so fault tests stay fast and
+# deterministic. (tests/test_resilience.py enforces the same in-tree.)
+nosleep:
+	@bad=$$(grep -rn "time\.sleep *(" --include='*.py' pipelinedp_tpu bench.py \
+	  | grep -v "resilience/clock\.py" || true); \
+	if [ -n "$$bad" ]; then \
+	  echo "$$bad"; \
+	  echo "ERROR: direct time.sleep — use pipelinedp_tpu.resilience.clock"; \
+	  exit 1; \
+	fi; \
+	echo "nosleep: OK"
 
 lint:
 	@if $(PYTHON) -c "import pyflakes" 2>/dev/null; then \

@@ -13,6 +13,14 @@ Multi-chip execution goes through ``pipelinedp_tpu.parallel`` (shard rows
 over a ``jax.sharding.Mesh``, per-shard segment reduction, ``psum`` for
 the per-partition accumulator exchange); construct the backend with a
 mesh to enable it.
+
+Fault tolerance goes through ``pipelinedp_tpu.resilience``: pass
+``health_policy`` to probe the accelerator (with bounded retry +
+backoff) before the first kernel and degrade to CPU — flagged on
+``backend.degraded``, never silently — when the runtime is wedged; pass
+``checkpoint`` (a path or ``CheckpointStore``) to persist streamed
+per-chunk state so a killed run resumes bit-identically without
+re-drawing noise (requires ``rng_seed``).
 """
 
 from __future__ import annotations
@@ -28,11 +36,42 @@ class JaxBackend(LocalBackend):
     Attributes:
       mesh: optional ``jax.sharding.Mesh`` for multi-chip runs (rows are
         sharded by privacy id over the first mesh axis).
-      rng_seed: optional fixed seed for reproducible runs (tests).
+      rng_seed: optional fixed seed for reproducible runs (tests,
+        checkpointed runs).
+      checkpoint: optional checkpoint path or
+        ``resilience.checkpoint.CheckpointStore`` — enables budget-safe
+        resume of streamed aggregations.
+      degraded: True when the device-health probe exhausted its retries
+        and execution fell back to CPU. Results produced in this mode
+        must be flagged by callers (bench emits ``"degraded": true``).
+      health: the ``resilience.health.HealthReport`` of the probe, or
+        None when no ``health_policy`` was requested.
     """
 
     supports_fused_aggregation = True
 
-    def __init__(self, mesh=None, rng_seed: Optional[int] = None):
+    def __init__(self, mesh=None, rng_seed: Optional[int] = None,
+                 checkpoint=None, health_policy=None, clock=None,
+                 probe_timeout_s: Optional[float] = None):
+        import os
+
+        from pipelinedp_tpu.resilience.health import DEGRADED_ENV
+
         self.mesh = mesh
         self.rng_seed = rng_seed
+        self.checkpoint = checkpoint
+        # A prior degradation in this process pinned the platform to
+        # CPU for EVERY later backend — the flag must say so even when
+        # this construction ran no probe of its own.
+        self.degraded = bool(os.environ.get(DEGRADED_ENV))
+        self.health = None
+        if health_policy is not None:
+            from pipelinedp_tpu.resilience import health as _health
+            policy = (None if health_policy is True else health_policy)
+            self.health = _health.ensure_device_or_degrade(
+                policy=policy, clock=clock, timeout_s=probe_timeout_s)
+            self.degraded = self.health.degraded
+            if self.degraded:
+                # A wedged-device mesh is unusable; the CPU fallback
+                # runs single-device. NEVER silent: ``degraded`` says so.
+                self.mesh = None

@@ -117,16 +117,17 @@ class DPEngine:
     _supports_fused_dispatch = True
 
     def _fused_backend_options(self):
-        """(fused?, rng_seed, mesh) — the one place probing the backend's
-        fused capability and options."""
+        """(fused?, rng_seed, mesh, checkpoint) — the one place probing
+        the backend's fused capability and options."""
         if not (self._supports_fused_dispatch and getattr(
                 self._backend, "supports_fused_aggregation", False)):
-            return False, None, None
+            return False, None, None, None
         return (True, getattr(self._backend, "rng_seed", None),
-                getattr(self._backend, "mesh", None))
+                getattr(self._backend, "mesh", None),
+                getattr(self._backend, "checkpoint", None))
 
     def _aggregate(self, col, params, data_extractors, public_partitions):
-        fused, rng_seed, mesh = self._fused_backend_options()
+        fused, rng_seed, mesh, checkpoint = self._fused_backend_options()
         if fused:
             from pipelinedp_tpu import jax_engine
             if jax_engine.params_are_fusable(params):
@@ -134,7 +135,7 @@ class DPEngine:
                     col, params, data_extractors, public_partitions,
                     self._budget_accountant,
                     self._current_report_generator,
-                    rng_seed=rng_seed, mesh=mesh)
+                    rng_seed=rng_seed, mesh=mesh, checkpoint=checkpoint)
         from pipelinedp_tpu import jax_engine
         if isinstance(col, jax_engine.ArrayDataset):
             col, data_extractors = jax_engine.array_dataset_to_rows(
@@ -221,7 +222,7 @@ class DPEngine:
                                           budget=budget)
 
     def _select_partitions(self, col, params, data_extractors):
-        fused, rng_seed, mesh = self._fused_backend_options()
+        fused, rng_seed, mesh, _ = self._fused_backend_options()
         if fused:
             from pipelinedp_tpu import jax_engine
             return jax_engine.build_fused_select_partitions(

@@ -1794,7 +1794,7 @@ class LazyFusedResult:
     def __init__(self, rows, params: AggregateParams, config: FusedConfig,
                  data_extractors, public_partitions, specs,
                  selection_spec, rng_seed: Optional[int] = None,
-                 mesh=None):
+                 mesh=None, checkpoint=None):
         self._rows = rows
         self._params = params
         self._config = config
@@ -1804,6 +1804,7 @@ class LazyFusedResult:
         self._selection_spec = selection_spec
         self._rng_seed = rng_seed
         self._mesh = mesh
+        self._checkpoint = checkpoint
         self._cache = None
         #: host/device timing split of the last _execute, for bench.py.
         self.timings: Optional[Dict[str, float]] = None
@@ -1858,9 +1859,14 @@ class LazyFusedResult:
                 streaming.stream_partials_and_select(
                     config, encoded, scales, keep_table, thr, s_scale,
                     min_count, rows_per_uid, self._rng_seed,
-                    mesh=self._mesh))
+                    mesh=self._mesh, checkpoint=self._checkpoint))
             self.timings["device_s"] = _time.perf_counter() - t1
             self.timings["stream_batches"] = stream_stats["n_batches"]
+            if "resumed_from_batch" in stream_stats:
+                self.timings["stream_resumed_from"] = (
+                    stream_stats["resumed_from_batch"])
+                self.timings["stream_checkpoint_saves"] = (
+                    stream_stats["checkpoint_saves"])
             # Transfer/compute split: staging+enqueue wall vs the time
             # blocked waiting for kernel results (the overlap evidence).
             self.timings["stream_stage_s"] = stream_stats["stage_s"]
@@ -2113,7 +2119,7 @@ def build_fused_select_partitions(col, params, data_extractors,
 def build_fused_aggregation(col, params: AggregateParams, data_extractors,
                             public_partitions, budget_accountant,
                             report_gen, rng_seed=None,
-                            mesh=None) -> LazyFusedResult:
+                            mesh=None, checkpoint=None) -> LazyFusedResult:
     """Engine entry point for the fused plane: requests budgets (same
     pattern as the generic path, so the privacy semantics are identical),
     registers report stages, returns the lazy result."""
@@ -2160,4 +2166,5 @@ def build_fused_aggregation(col, params: AggregateParams, data_extractors,
         "XLA program")
     return LazyFusedResult(col, params, config, data_extractors,
                            public_partitions, specs, selection_spec,
-                           rng_seed=rng_seed, mesh=mesh)
+                           rng_seed=rng_seed, mesh=mesh,
+                           checkpoint=checkpoint)

@@ -1,4 +1,10 @@
-"""Multi-chip execution: shard_map over a jax.sharding.Mesh."""
+"""Multi-chip execution: shard_map over a jax.sharding.Mesh.
+
+``resilient_make_mesh`` is the fault-tolerant entry: ``make_mesh``
+under bounded retry/backoff, degrading to a flagged CPU mesh when the
+accelerator runtime is wedged (see ``pipelinedp_tpu.resilience``).
+"""
 
 from pipelinedp_tpu.parallel.sharded import (make_mesh,
                                              sharded_fused_aggregate)
+from pipelinedp_tpu.resilience.health import resilient_make_mesh

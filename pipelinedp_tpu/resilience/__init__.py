@@ -1,0 +1,54 @@
+"""Resilient execution layer: device-health probing, deterministic
+retry/backoff, seeded fault injection, and budget-safe checkpoint/resume
+for the streamed DP aggregation.
+
+Design invariant — **retry is deterministic-key replay**: the privacy
+budget is consumed the moment noise is *drawn*, not when a job succeeds.
+A naive retry that re-samples noise after a failure releases two
+different noisy views of the same data and silently double-spends the
+budget. Every recovery path here therefore replays the SAME threefry
+key material (bounding keys ``fold_in(k_bound, batch)``, one selection
+key, one node-noise key — all pure functions of the run seed), so a
+resumed run is bit-identical to the uninterrupted one: same noise
+draws, same kept-partition set, one budget charge.
+
+Modules:
+
+* ``clock`` — the injectable clock. No library code may call
+  ``time.sleep`` directly (``make faultcheck`` enforces this), so fault
+  tests run real backoff *schedules* in zero wall time.
+* ``retry`` — bounded retry with exponential backoff + deterministic
+  seeded jitter.
+* ``faults`` — seeded fault-injection harness: wedged device/mesh init,
+  chunk-level stream failures, coordinator timeouts.
+* ``health`` — device-health probing with timeout, retry, and graceful
+  (flagged, never silent) degradation to a CPU mesh.
+* ``checkpoint`` — per-chunk monoid-state persistence for
+  ``streaming.stream_partials_and_select`` and bit-identical resume.
+"""
+
+from pipelinedp_tpu.resilience.clock import Clock, FakeClock, SystemClock
+from pipelinedp_tpu.resilience.retry import (RetriesExhausted, RetryPolicy,
+                                             call_with_retry)
+from pipelinedp_tpu.resilience.faults import (ChunkFailure,
+                                              CoordinatorTimeout,
+                                              FaultInjected, FaultPlan,
+                                              injected_faults)
+from pipelinedp_tpu.resilience.health import (HealthReport,
+                                              ensure_device_or_degrade,
+                                              probe_devices,
+                                              resilient_distributed_initialize,
+                                              resilient_make_mesh)
+from pipelinedp_tpu.resilience.checkpoint import (CheckpointMismatch,
+                                                  CheckpointStore,
+                                                  StreamCheckpoint)
+
+__all__ = [
+    "Clock", "FakeClock", "SystemClock",
+    "RetryPolicy", "RetriesExhausted", "call_with_retry",
+    "FaultPlan", "FaultInjected", "ChunkFailure", "CoordinatorTimeout",
+    "injected_faults",
+    "HealthReport", "probe_devices", "ensure_device_or_degrade",
+    "resilient_make_mesh", "resilient_distributed_initialize",
+    "CheckpointStore", "StreamCheckpoint", "CheckpointMismatch",
+]

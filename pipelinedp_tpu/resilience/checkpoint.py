@@ -1,0 +1,172 @@
+"""Budget-safe checkpoint/resume state for the streamed aggregation.
+
+The streaming loop's per-chunk state is a pure monoid fold: integer
+count accumulators (int64), folded fixed-point value columns (float64,
+each fold exactly representable), vector sums (float64), and — for
+percentile configs — the additive device mid-histogram (int32). All
+randomness downstream of the fold (bounding keys ``fold_in(k_bound, b)``,
+the selection key, node noise) is a pure function of the run seed, so
+persisting ``(next_batch, accumulators)`` after each fold lets a killed
+run resume *bit-identically*: the same noise draws, the same
+kept-partition set, ONE privacy-budget charge. That is why resuming
+requires the original fingerprint to match — resuming a different
+(config, data, seed) tuple would replay the wrong keys, and silently
+re-running from scratch would re-draw noise and double-spend the
+budget.
+
+The store is a single ``.npz`` file written atomically (tmp +
+``os.replace``), so a kill mid-write leaves the previous checkpoint
+intact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import tempfile
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class CheckpointMismatch(Exception):
+    """The checkpoint on disk was written by a different (config, data,
+    seed) run — resuming it would replay the wrong noise keys."""
+
+
+#: Arrays up to this many elements are digested in full; larger ones by
+#: head + strided sample + tail + dtype/shape (a different same-shape
+#: dataset still collides only if it agrees on every sampled element).
+_FULL_DIGEST_ELEMS = 1 << 22
+
+
+def _digest_array(h, arr: np.ndarray) -> None:
+    arr = np.ascontiguousarray(arr)
+    h.update(str((arr.dtype.str, arr.shape)).encode())
+    if arr.size <= _FULL_DIGEST_ELEMS:
+        h.update(arr.data)
+        return
+    flat = arr.reshape(-1)
+    k = _FULL_DIGEST_ELEMS // 4
+    h.update(np.ascontiguousarray(flat[:k]).data)
+    h.update(np.ascontiguousarray(flat[::max(1, arr.size // k)]).data)
+    h.update(np.ascontiguousarray(flat[-k:]).data)
+
+
+def data_digest(encoded) -> str:
+    """Content identity of the encoded dataset (pid / pk / values / the
+    pk vocabulary): a checkpoint must never resume onto DIFFERENT data
+    that merely shares the row count — the fold would splice two
+    datasets into one release. Full hash below ~4M elements per array,
+    head+sample+tail digest above (keeps the cost per multi-GB stream
+    to milliseconds, not tens of seconds)."""
+    h = hashlib.blake2b(digest_size=16)
+    for arr in (encoded.pid, encoded.pk, encoded.values):
+        if arr is None:
+            h.update(b"none")
+        else:
+            _digest_array(h, np.asarray(arr))
+    h.update(repr(list(encoded.pk_vocab[:1000])).encode())
+    return h.hexdigest()
+
+
+def run_fingerprint(config, n_rows: int, n_batches: int, seed: int,
+                    num_partitions: int, n_dev: int, fx_bits: int,
+                    data: str = "") -> str:
+    """Identity of one streamed run: everything that determines the
+    batch assignment, the kernel trace, and the noise key topology,
+    plus the ``data_digest`` content identity."""
+    blob = json.dumps({
+        "config": repr(config),
+        "n_rows": int(n_rows),
+        "n_batches": int(n_batches),
+        "seed": int(seed),
+        "num_partitions": int(num_partitions),
+        "n_dev": int(n_dev),
+        "fx_bits": int(fx_bits),
+        "data": data,
+    }, sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+@dataclasses.dataclass
+class StreamCheckpoint:
+    fingerprint: str
+    #: first batch index NOT yet folded into the accumulators.
+    next_batch: int
+    #: host accumulator arrays, keyed ``acc:<name>`` / ``val:<name>`` /
+    #: ``vec`` / ``mid`` (all numpy; device state is host-fetched).
+    arrays: Dict[str, np.ndarray]
+
+
+class CheckpointStore:
+    """File-backed checkpoint: one atomic ``.npz`` per streamed run."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        #: how the last load/save went, for observability in tests/logs.
+        self.last_event: str = ""
+
+    def exists(self) -> bool:
+        return os.path.exists(self.path)
+
+    def save(self, ckpt: StreamCheckpoint) -> None:
+        payload = dict(ckpt.arrays)
+        payload["__meta__"] = np.frombuffer(json.dumps({
+            "fingerprint": ckpt.fingerprint,
+            "next_batch": int(ckpt.next_batch),
+        }).encode(), dtype=np.uint8)
+        d = os.path.dirname(os.path.abspath(self.path)) or "."
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".ckpt.tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez(f, **payload)
+            os.replace(tmp, self.path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        self.last_event = f"saved next_batch={ckpt.next_batch}"
+
+    def load(self) -> Optional[StreamCheckpoint]:
+        if not self.exists():
+            self.last_event = "no checkpoint"
+            return None
+        with np.load(self.path) as z:
+            meta = json.loads(bytes(z["__meta__"]).decode())
+            arrays = {k: z[k] for k in z.files if k != "__meta__"}
+        self.last_event = f"loaded next_batch={meta['next_batch']}"
+        return StreamCheckpoint(fingerprint=meta["fingerprint"],
+                                next_batch=int(meta["next_batch"]),
+                                arrays=arrays)
+
+    def load_for(self, fingerprint: str) -> Optional[StreamCheckpoint]:
+        """Load and validate against the current run's fingerprint.
+        A mismatch RAISES rather than silently restarting: a silent
+        restart would re-draw noise and double-spend the budget without
+        the operator ever learning the checkpoint was discarded."""
+        ckpt = self.load()
+        if ckpt is None:
+            return None
+        if ckpt.fingerprint != fingerprint:
+            raise CheckpointMismatch(
+                f"checkpoint at {self.path} was written by a different "
+                "run (config/data/seed fingerprint mismatch); refusing "
+                "to resume — delete it explicitly to start fresh")
+        return ckpt
+
+    def clear(self) -> None:
+        if self.exists():
+            os.unlink(self.path)
+        self.last_event = "cleared"
+
+
+def as_store(checkpoint) -> Optional[CheckpointStore]:
+    """Accept a ``CheckpointStore`` or a path string."""
+    if checkpoint is None:
+        return None
+    if isinstance(checkpoint, CheckpointStore):
+        return checkpoint
+    return CheckpointStore(checkpoint)

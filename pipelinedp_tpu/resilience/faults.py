@@ -1,0 +1,138 @@
+"""Deterministic, seeded fault injection for tests and the bench.
+
+A ``FaultPlan`` names the faults to inject; instrumented sites in the
+library consult the active plan:
+
+* ``wedged("device.probe")`` / ``wedged("mesh.init")`` — the first
+  ``wedged_init`` probe/mesh attempts behave as a wedged runtime
+  (timeout) without spending real wall time;
+* ``check_chunk(b)`` — raise ``ChunkFailure`` when the streaming loop
+  reaches chunk ``b`` (kills a streamed run mid-flight);
+* ``check_coordinator()`` — the first ``coordinator_timeouts`` calls
+  raise ``CoordinatorTimeout`` (a hung ``jax.distributed`` handshake).
+
+Plans install either in-process (``injected_faults(plan)`` context
+manager) or across a process boundary via the ``PIPELINEDP_TPU_FAULTS``
+env var (``wedged_init=2,fail_chunks=3:5,coordinator_timeouts=1``) so
+subprocess harnesses (bench, multihost workers) inject the same faults.
+Counters are deterministic: the Nth call to a site always sees the same
+verdict for a given plan.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+from typing import Dict, Optional, Tuple
+
+ENV_VAR = "PIPELINEDP_TPU_FAULTS"
+
+
+class FaultInjected(Exception):
+    """Base class for injected faults."""
+
+
+class ChunkFailure(FaultInjected):
+    """Injected failure while processing one streaming chunk."""
+
+
+class CoordinatorTimeout(FaultInjected):
+    """Injected ``jax.distributed`` coordinator timeout."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    #: first N device-probe / mesh-init attempts wedge (per site).
+    wedged_init: int = 0
+    #: streaming chunk indices whose processing raises ``ChunkFailure``.
+    fail_chunks: Tuple[int, ...] = ()
+    #: first N coordinator connections raise ``CoordinatorTimeout``.
+    coordinator_timeouts: int = 0
+
+    def to_env(self) -> str:
+        parts = []
+        if self.wedged_init:
+            parts.append(f"wedged_init={self.wedged_init}")
+        if self.fail_chunks:
+            parts.append("fail_chunks=" +
+                         ":".join(str(c) for c in self.fail_chunks))
+        if self.coordinator_timeouts:
+            parts.append(f"coordinator_timeouts={self.coordinator_timeouts}")
+        return ",".join(parts)
+
+
+def plan_from_env(spec: str) -> FaultPlan:
+    kw: Dict = {}
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        k, _, v = item.partition("=")
+        if k == "fail_chunks":
+            kw[k] = tuple(int(c) for c in v.split(":") if c)
+        else:
+            kw[k] = int(v)
+    return FaultPlan(**kw)
+
+
+_plan: Optional[FaultPlan] = None
+_counters: Dict[str, int] = {}
+
+
+def install(plan: FaultPlan) -> None:
+    global _plan
+    _plan = plan
+    _counters.clear()
+
+
+def clear() -> None:
+    global _plan
+    _plan = None
+    _counters.clear()
+
+
+@contextlib.contextmanager
+def injected_faults(plan: FaultPlan):
+    """Install ``plan`` for the duration of the block."""
+    install(plan)
+    try:
+        yield plan
+    finally:
+        clear()
+
+
+def active() -> Optional[FaultPlan]:
+    if _plan is not None:
+        return _plan
+    spec = os.environ.get(ENV_VAR)
+    if spec:
+        return plan_from_env(spec)
+    return None
+
+
+def _consume(site: str) -> int:
+    n = _counters.get(site, 0)
+    _counters[site] = n + 1
+    return n
+
+
+def wedged(site: str) -> bool:
+    """True when this attempt at ``site`` should behave as a wedged
+    runtime (counted per site, deterministic)."""
+    plan = active()
+    return plan is not None and _consume(site) < plan.wedged_init
+
+
+def check_chunk(index: int) -> None:
+    plan = active()
+    if plan is not None and index in plan.fail_chunks:
+        raise ChunkFailure(f"injected failure at streaming chunk {index}")
+
+
+def check_coordinator() -> None:
+    plan = active()
+    if (plan is not None and
+            _consume("distributed.init") < plan.coordinator_timeouts):
+        raise CoordinatorTimeout(
+            "injected coordinator timeout (hung jax.distributed handshake)")

@@ -412,13 +412,24 @@ def _batch_assignment(config, encoded, n_batches: int, seed: int,
 def stream_partials_and_select(config, encoded, scales, keep_table,
                                sel_threshold, sel_scale, sel_min_count,
                                sel_rows_per_uid, rng_seed: Optional[int],
-                               mesh=None) -> Tuple[np.ndarray, Dict, Dict]:
+                               mesh=None, checkpoint=None
+                               ) -> Tuple[np.ndarray, Dict, Dict]:
     """Runs the streaming aggregation. Returns ``(keep[P_pad] bool,
     part64, stats)`` where ``part64`` holds the combined float64/int64
     accumulator columns ready for ``jax_engine._host_release``; for
     percentile configs ``stats["percentile_values"]`` carries the
     [P_pad, Q] walked quantile values (pass B re-streams the batches —
     see the module docstring).
+
+    ``checkpoint`` (a ``resilience.checkpoint.CheckpointStore`` or path)
+    enables budget-safe resume: the host accumulators are pure monoid
+    state and every noise key is a pure function of the run seed, so
+    persisting ``(next_batch, accumulators)`` after each fold lets a
+    killed run resume bit-identically — same noise draws, same
+    kept-partition set, one budget charge. Requires a fixed
+    ``rng_seed`` (resume must replay identical keys). A checkpoint
+    written by a different (config, data, seed) run raises
+    ``CheckpointMismatch`` instead of silently restarting.
 
     With a ``mesh``, every chunk is itself pid-sharded over the mesh
     and reduced by the sharded kernels; host accumulation, selection
@@ -431,6 +442,8 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
     host fold/selection — proven across a two-process gloo mesh by
     ``tests/test_multihost.py``."""
     from pipelinedp_tpu.ops import noise as noise_ops
+    from pipelinedp_tpu.resilience import checkpoint as ckpt_mod
+    from pipelinedp_tpu.resilience import faults
 
     n_dev = mesh.devices.size if mesh is not None else 1
     P = len(encoded.pk_vocab)
@@ -497,6 +510,35 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
     val_acc = {spec.name: np.zeros(P_pad, np.float64) for spec in layout}
     vec_acc = None
 
+    # Budget-safe resume: restore the monoid accumulators and skip the
+    # already-folded batch prefix. The fold is left-associative, so
+    # restoring the prefix sum and continuing reproduces the EXACT
+    # float64 operation sequence of an uninterrupted run.
+    ckpt_store = ckpt_mod.as_store(checkpoint)
+    start_batch = 0
+    ckpt_fp = None
+    mid_restore = None
+    if ckpt_store is not None:
+        if rng_seed is None:
+            raise ValueError(
+                "checkpointing requires a fixed rng_seed: resume must "
+                "replay the identical noise keys (the privacy budget is "
+                "consumed at noise draw, not at job success)")
+        ckpt_fp = ckpt_mod.run_fingerprint(
+            config, n, n_batches, seed, P_pad, n_dev, fx_bits,
+            data=ckpt_mod.data_digest(encoded))
+        saved = ckpt_store.load_for(ckpt_fp)
+        if saved is not None:
+            start_batch = saved.next_batch
+            for name in acc:
+                acc[name] = saved.arrays[f"acc:{name}"]
+            for name in val_acc:
+                val_acc[name] = saved.arrays[f"val:{name}"]
+            if "vec" in saved.arrays:
+                vec_acc = saved.arrays["vec"]
+            if "mid" in saved.arrays:
+                mid_restore = saved.arrays["mid"]
+
     if mesh is not None:
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as _PSpec
@@ -506,7 +548,7 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
 
     t_stage = 0.0  # host staging + enqueue time across both passes
 
-    def batches():
+    def batches(start_at=0):
         """Ships the deterministic batch sequence to the device; pass A
         and pass B (percentiles) iterate it identically. The ID staging
         buffers are allocated once and reused across batches with their
@@ -538,6 +580,11 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
         offset = 0
         for b in range(n_batches):
             ccounts = counts[b]
+            if b < start_at:
+                # Resume skip: already folded from the checkpoint —
+                # advance the row cursor without staging or shipping.
+                offset += int(ccounts.sum())
+                continue
             if int(ccounts.sum()) == 0:
                 continue
             t0 = _time.perf_counter()
@@ -627,13 +674,52 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
     # batches from HBM instead of paying the host link twice. Bounded
     # by ``stream_cache_bytes()``; overflow drops the WHOLE cache (a
     # partial cache would split pass B across two iteration sources).
-    cache: Optional[list] = [] if config.percentiles else None
+    # A RESUMED run never caches: the skipped batch prefix is absent, so
+    # a partial cache would silently drop those rows from pass B.
+    cache: Optional[list] = ([] if config.percentiles and
+                             start_batch == 0 else None)
     cache_bytes = 0
     cache_cap = stream_cache_bytes()
     t_fold = 0.0
-    mid_acc = None  # device [P_pad * n_mid] percentile mid histogram
-    pending = None  # previous batch's (packed, vec), folded one late
-    for b, planes, values_d, nv, n_pid_planes in batches():
+    n_saves = 0
+    # Folds between checkpoint writes; clamped to >= 1 (0 would divide
+    # by zero below — disable checkpointing by not passing a store).
+    ckpt_every = max(1, int(os.environ.get("PIPELINEDP_TPU_CKPT_EVERY",
+                                           "1")))
+    # The mid histogram accumulates at FOLD time (not launch time) so a
+    # checkpoint written after folding batch j never includes batch
+    # j+1's in-flight histogram — the left-fold order is unchanged.
+    mid_acc = (jnp.asarray(mid_restore) if mid_restore is not None
+               else None)
+    pending = None  # previous batch's (b, packed, vec, mid), folded late
+
+    def save_ckpt(next_batch):
+        nonlocal n_saves
+        arrays = {f"acc:{k}": v for k, v in acc.items()}
+        arrays.update({f"val:{k}": v for k, v in val_acc.items()})
+        if vec_acc is not None:
+            arrays["vec"] = vec_acc
+        if mid_acc is not None:
+            arrays["mid"] = np.asarray(mid_acc)
+        ckpt_store.save(ckpt_mod.StreamCheckpoint(ckpt_fp, next_batch,
+                                                  arrays))
+        n_saves += 1
+
+    def fold_pending():
+        nonlocal t_fold, mid_acc
+        pb, packed, vec, mid = pending
+        t0 = _time.perf_counter()
+        fold_packed(packed, vec)
+        t_fold += _time.perf_counter() - t0
+        if mid is not None:
+            mid_acc = mid if mid_acc is None else mid_acc + mid
+        if ckpt_store is not None and (pb + 1) % ckpt_every == 0:
+            save_ckpt(pb + 1)
+
+    for b, planes, values_d, nv, n_pid_planes in batches(start_batch):
+        # Injectable kill point: tests sever the run at chunk b and
+        # assert the checkpointed resume is bit-identical.
+        faults.check_chunk(b)
         kb = jax.random.fold_in(k_bound, b)
         if mesh is None:
             packed, vec, mid = _partials_kernel(
@@ -643,8 +729,6 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
             packed, vec, mid = _sharded_partials_kernel(
                 config, P_pad, mesh, planes, values_d, nv, kb, fx_bits,
                 n_pid_planes=n_pid_planes)
-        if mid is not None:
-            mid_acc = mid if mid_acc is None else mid_acc + mid
         if cache is not None:
             # The budget is PER-DEVICE HBM: on a mesh the arrays are
             # row-sharded, so each device holds 1/n_dev of the bytes.
@@ -655,14 +739,10 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
             else:
                 cache = None
         if pending is not None:
-            t0 = _time.perf_counter()
-            fold_packed(*pending)
-            t_fold += _time.perf_counter() - t0
-        pending = (packed, vec)
+            fold_pending()
+        pending = (b, packed, vec, mid)
     if pending is not None:
-        t0 = _time.perf_counter()
-        fold_packed(*pending)
-        t_fold += _time.perf_counter() - t0
+        fold_pending()
 
     part64: Dict[str, np.ndarray] = dict(acc)
     part64.update(val_acc)
@@ -688,6 +768,9 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
     stats = {"n_batches": n_batches, "chunk_rows": chunk,
              "fx_bits": fx_bits, "max_batch_rows": max_rows,
              "mesh_devices": n_dev, "fold_wait_s": t_fold}
+    if ckpt_store is not None:
+        stats["resumed_from_batch"] = start_batch
+        stats["checkpoint_saves"] = n_saves
 
     if config.percentiles:
         # Pass B: walk the mid histogram's levels, then re-stream the
@@ -756,4 +839,10 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
             je._monotone_in_q(jnp.asarray(vals), quantiles))
 
     stats["stage_s"] = t_stage
+    if ckpt_store is not None:
+        # The run released its outputs: the checkpoint must not survive
+        # (resuming a FINISHED run into a fresh aggregation would skip
+        # every batch and re-release — clear it so the next run with
+        # this path starts clean).
+        ckpt_store.clear()
     return keep, part64, stats

@@ -24,12 +24,30 @@ def main() -> None:
     n_proc = int(sys.argv[2])
     port = sys.argv[3]
 
+    # Self-deadline: if the parent test process is killed (suite
+    # timeout, operator ^C) before its own worker-kill deadline fires,
+    # an orphaned worker would spin in a gloo collective forever. The
+    # watchdog makes the worker ITS OWN hard deadline.
+    import threading
+    watchdog = threading.Timer(480.0, lambda: os._exit(3))
+    watchdog.daemon = True  # never keeps a FINISHED worker alive
+    watchdog.start()
+
     import jax
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_cpu_collectives_implementation", "gloo")
-    jax.distributed.initialize(
+    # Bounded-retry init: coordinator handshakes lose races on loaded
+    # hosts, and a second attempt (jittered per process id) usually
+    # lands. Exhausted retries raise — a hard failure the parent test
+    # reports, never a silent hang.
+    from pipelinedp_tpu.resilience import (RetryPolicy,
+                                           resilient_distributed_initialize)
+    resilient_distributed_initialize(
         coordinator_address=f"localhost:{port}",
-        num_processes=n_proc, process_id=proc_id)
+        num_processes=n_proc, process_id=proc_id,
+        policy=RetryPolicy(max_attempts=2, base_delay_s=1.0,
+                           multiplier=2.0, max_delay_s=10.0,
+                           jitter=0.25, seed=proc_id))
     assert len(jax.devices()) == 4 * n_proc, jax.devices()
     assert len(jax.local_devices()) == 4
 

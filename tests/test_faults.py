@@ -1,0 +1,305 @@
+"""Fault-injection integration tests: kill a streamed run mid-flight,
+resume from checkpoint, assert BIT-IDENTICAL outputs — the acceptance
+oracle for budget-safe retry (same noise draws, same kept-partition set,
+one budget charge). Plus the bench's wedged-device degradation path.
+
+Fast and CPU-only throughout — the end-to-end bench subprocess runs in
+smoke mode (~20s). ``make faultcheck`` runs this file plus
+``test_resilience.py``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu.backends import JaxBackend
+from pipelinedp_tpu.resilience import (CheckpointMismatch, CheckpointStore,
+                                       FaultPlan, injected_faults)
+from pipelinedp_tpu.resilience.faults import ChunkFailure
+
+
+@pytest.fixture(autouse=True)
+def tiny_chunks(monkeypatch):
+    monkeypatch.setenv("PIPELINEDP_TPU_STREAM_CHUNK", "997")
+
+
+def run_streamed(ds, params, seed=0, eps=5.0, delta=1e-6, public=None,
+                 checkpoint=None, mesh=None):
+    ds.invalidate_cache()
+    acc = pdp.NaiveBudgetAccountant(total_epsilon=eps, total_delta=delta)
+    engine = pdp.DPEngine(acc, JaxBackend(rng_seed=seed, mesh=mesh,
+                                          checkpoint=checkpoint))
+    res = engine.aggregate(ds, params, pdp.DataExtractors(),
+                           public_partitions=public)
+    acc.compute_budgets()
+    got = dict(res)
+    assert res.timings.get("stream_batches", 0) > 1, (
+        "dataset did not stream — the kill/resume path was not exercised")
+    return got, res.timings
+
+
+def make_ds(seed=1, n=9_000, users=2_000, parts=12):
+    rng = np.random.default_rng(seed)
+    return pdp.ArrayDataset(privacy_ids=rng.integers(0, users, n),
+                            partition_keys=rng.integers(0, parts, n),
+                            values=rng.uniform(0.0, 10.0, n)), parts
+
+
+def assert_bit_identical(got_a, got_b):
+    """EXACT equality of every released metric — noisy floats included —
+    and of the kept-partition sets: the bit-parity contract."""
+    assert set(got_a) == set(got_b), (
+        f"kept sets differ: {sorted(set(got_a) ^ set(got_b))}")
+    for k in got_a:
+        ta, tb = got_a[k], got_b[k]
+        assert ta._fields == tb._fields
+        for f in ta._fields:
+            va, vb = getattr(ta, f), getattr(tb, f)
+            np.testing.assert_array_equal(np.asarray(va), np.asarray(vb),
+                                          err_msg=f"partition {k}.{f}")
+
+
+class TestCheckpointResumeBitParity:
+    """Kill after chunk k via fault injection, resume, compare against
+    the uninterrupted run at MODERATE eps — real noise, real private
+    selection, so any key-replay drift shows up as a float mismatch."""
+
+    def test_killed_and_resumed_run_is_bit_identical(self, tmp_path):
+        ds, parts = make_ds(seed=1)
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM, pdp.Metrics.MEAN,
+                     pdp.Metrics.PRIVACY_ID_COUNT],
+            max_partitions_contributed=parts,
+            max_contributions_per_partition=50,
+            min_value=0.0, max_value=10.0)
+        # Ground truth: one uninterrupted run, NO checkpointing at all.
+        baseline, _ = run_streamed(ds, params, seed=42)
+
+        # Kill at chunk 3 (checkpoints for chunks 0-1 are on disk; chunk
+        # 2's fold is still pending — deliberately mid-pipeline).
+        store = CheckpointStore(str(tmp_path / "stream.ckpt"))
+        with injected_faults(FaultPlan(fail_chunks=(3,))):
+            with pytest.raises(ChunkFailure):
+                run_streamed(ds, params, seed=42, checkpoint=store)
+        assert store.exists(), "no checkpoint survived the kill"
+
+        # Resume: restores the fold prefix, replays the SAME keys.
+        resumed, timings = run_streamed(ds, params, seed=42,
+                                        checkpoint=store)
+        assert timings["stream_resumed_from"] >= 1
+        assert_bit_identical(baseline, resumed)
+        # Success cleared the checkpoint: the budget cannot be re-spent
+        # by accidentally resuming a finished run.
+        assert not store.exists()
+
+    def test_resume_with_private_selection_same_kept_set(self, tmp_path):
+        """Selection at modest eps — partitions genuinely on the keep
+        boundary — must come out IDENTICAL after kill + resume."""
+        rng = np.random.default_rng(9)
+        n = 8_000
+        pid = np.arange(n)
+        pk = np.where(np.arange(n) < 7_600,
+                      rng.integers(0, 4, n), 4 + np.arange(n) % 120)
+        ds = pdp.ArrayDataset(privacy_ids=pid, partition_keys=pk,
+                              values=None)
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.PRIVACY_ID_COUNT],
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1)
+        baseline, _ = run_streamed(ds, params, seed=5, eps=5.0,
+                                   delta=1e-5)
+        store = CheckpointStore(str(tmp_path / "sel.ckpt"))
+        with injected_faults(FaultPlan(fail_chunks=(4,))):
+            with pytest.raises(ChunkFailure):
+                run_streamed(ds, params, seed=5, eps=5.0, delta=1e-5,
+                             checkpoint=store)
+        resumed, _ = run_streamed(ds, params, seed=5, eps=5.0,
+                                  delta=1e-5, checkpoint=store)
+        assert_bit_identical(baseline, resumed)
+
+    def test_resume_with_percentiles_is_bit_identical(self, tmp_path):
+        """Percentile configs carry extra checkpoint state (the additive
+        device mid-histogram) and a resumed run must disable the pass-B
+        device cache (the skipped prefix is not resident) — both paths
+        pinned by exact equality against the uninterrupted run."""
+        rng = np.random.default_rng(11)
+        n = 8_000
+        ds = pdp.ArrayDataset(privacy_ids=rng.integers(0, 2_000, n),
+                              partition_keys=rng.integers(0, 4, n),
+                              values=rng.uniform(0.0, 10.0, n))
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.PERCENTILE(50), pdp.Metrics.PERCENTILE(90),
+                     pdp.Metrics.COUNT],
+            max_partitions_contributed=4,
+            max_contributions_per_partition=50,
+            min_value=0.0, max_value=10.0)
+        public = list(range(4))
+        baseline, _ = run_streamed(ds, params, seed=13, public=public)
+        store = CheckpointStore(str(tmp_path / "pct.ckpt"))
+        with injected_faults(FaultPlan(fail_chunks=(3,))):
+            with pytest.raises(ChunkFailure):
+                run_streamed(ds, params, seed=13, public=public,
+                             checkpoint=store)
+        resumed, timings = run_streamed(ds, params, seed=13,
+                                        public=public, checkpoint=store)
+        assert timings["stream_resumed_from"] >= 1
+        # The resumed run must have re-streamed pass B (no partial
+        # cache), not silently dropped the skipped prefix.
+        assert timings["stream_pass_b"] == "reship"
+        assert_bit_identical(baseline, resumed)
+
+    def test_kill_on_first_chunk_resumes_from_scratch(self, tmp_path):
+        """A kill before ANY fold completes leaves no checkpoint; the
+        'resume' is a clean, still bit-identical, restart."""
+        ds, parts = make_ds(seed=3)
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT],
+            max_partitions_contributed=parts,
+            max_contributions_per_partition=50)
+        baseline, _ = run_streamed(ds, params, seed=7)
+        store = CheckpointStore(str(tmp_path / "first.ckpt"))
+        with injected_faults(FaultPlan(fail_chunks=(0,))):
+            with pytest.raises(ChunkFailure):
+                run_streamed(ds, params, seed=7, checkpoint=store)
+        assert not store.exists()
+        resumed, timings = run_streamed(ds, params, seed=7,
+                                        checkpoint=store)
+        assert timings["stream_resumed_from"] == 0
+        assert_bit_identical(baseline, resumed)
+
+    def test_checkpoint_requires_fixed_seed(self, tmp_path):
+        ds, parts = make_ds(seed=4, n=5_000)
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT],
+            max_partitions_contributed=parts,
+            max_contributions_per_partition=50)
+        ds.invalidate_cache()
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=5.0,
+                                        total_delta=1e-6)
+        engine = pdp.DPEngine(acc, JaxBackend(
+            rng_seed=None, checkpoint=str(tmp_path / "x.ckpt")))
+        res = engine.aggregate(ds, params, pdp.DataExtractors())
+        acc.compute_budgets()
+        with pytest.raises(ValueError, match="budget is consumed at "
+                                             "noise draw"):
+            dict(res)
+
+    def test_mismatched_checkpoint_refuses_resume(self, tmp_path):
+        """A checkpoint from a DIFFERENT seed must refuse to resume —
+        silently restarting would re-draw noise and double-spend."""
+        ds, parts = make_ds(seed=6, n=5_000)
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT],
+            max_partitions_contributed=parts,
+            max_contributions_per_partition=50)
+        store = CheckpointStore(str(tmp_path / "mismatch.ckpt"))
+        with injected_faults(FaultPlan(fail_chunks=(3,))):
+            with pytest.raises(ChunkFailure):
+                run_streamed(ds, params, seed=1, checkpoint=store)
+        assert store.exists()
+        ds.invalidate_cache()
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=5.0,
+                                        total_delta=1e-6)
+        engine = pdp.DPEngine(acc, JaxBackend(rng_seed=2,
+                                              checkpoint=store))
+        res = engine.aggregate(ds, params, pdp.DataExtractors())
+        acc.compute_budgets()
+        with pytest.raises(CheckpointMismatch):
+            dict(res)
+
+    def test_same_shape_different_data_refuses_resume(self, tmp_path):
+        """The fingerprint's data component is a CONTENT digest: a
+        different dataset with the identical row count / config / seed
+        must refuse to resume (splicing two datasets into one release
+        would corrupt it silently)."""
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            max_partitions_contributed=12,
+            max_contributions_per_partition=50,
+            min_value=0.0, max_value=10.0)
+        ds_a, _ = make_ds(seed=31)
+        store = CheckpointStore(str(tmp_path / "data.ckpt"))
+        with injected_faults(FaultPlan(fail_chunks=(3,))):
+            with pytest.raises(ChunkFailure):
+                run_streamed(ds_a, params, seed=4, checkpoint=store)
+        assert store.exists()
+        ds_b, _ = make_ds(seed=32)  # same shape, different rows
+        ds_b.invalidate_cache()
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=5.0,
+                                        total_delta=1e-6)
+        engine = pdp.DPEngine(acc, JaxBackend(rng_seed=4,
+                                              checkpoint=store))
+        res = engine.aggregate(ds_b, params, pdp.DataExtractors())
+        acc.compute_budgets()
+        with pytest.raises(CheckpointMismatch):
+            dict(res)
+
+    def test_resume_on_mesh_is_bit_identical(self, tmp_path,
+                                             monkeypatch):
+        """Kill + resume composed with the 8-device CPU mesh: the
+        owner-sharded fold restores and replays identically."""
+        monkeypatch.setenv("PIPELINEDP_TPU_STREAM_CHUNK", "500")
+        from pipelinedp_tpu.parallel import make_mesh
+        ds, parts = make_ds(seed=8, n=14_000)
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            max_partitions_contributed=parts,
+            max_contributions_per_partition=50,
+            min_value=0.0, max_value=10.0)
+        mesh = make_mesh()
+        baseline, _ = run_streamed(ds, params, seed=21, mesh=mesh)
+        store = CheckpointStore(str(tmp_path / "mesh.ckpt"))
+        with injected_faults(FaultPlan(fail_chunks=(2,))):
+            with pytest.raises(ChunkFailure):
+                run_streamed(ds, params, seed=21, mesh=mesh,
+                             checkpoint=store)
+        resumed, timings = run_streamed(ds, params, seed=21, mesh=mesh,
+                                        checkpoint=store)
+        assert timings["stream_resumed_from"] >= 1
+        assert_bit_identical(baseline, resumed)
+
+
+class TestBenchDegradation:
+    """The BENCH_r05 failure mode, end to end: a wedged device probe
+    must yield rc=0 and parseable ``"degraded": true`` JSON, not rc=3."""
+
+    def test_wedged_probe_bench_exits_zero_with_degraded_json(self):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["PIPELINEDP_TPU_FAULTS"] = "wedged_init=99"
+        env["PIPELINEDP_TPU_PROBE_BACKOFF"] = "0.01"  # real clock: tiny
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PIPELINEDP_TPU_DEGRADED", None)  # fresh process state
+        env.pop("PYTHONPATH", None)
+        proc = subprocess.run(
+            [sys.executable, "bench.py", "--smoke", "--flagship-only",
+             "--stream-rows", "0"],
+            cwd=repo, env=env, capture_output=True, text=True,
+            timeout=1200)
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        headline = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert headline["degraded"] is True
+        assert headline["value"] > 0
+        assert "DEVICE UNREACHABLE" in proc.stderr
+
+    def test_probe_helper_degrades_without_subprocess(self, monkeypatch):
+        """The bench probe helper itself (fast, tier-1): wedged probe →
+        degraded report, backoff schedule from the env knobs."""
+        monkeypatch.setenv("PIPELINEDP_TPU_PROBE_ATTEMPTS", "2")
+        monkeypatch.setenv("PIPELINEDP_TPU_PROBE_BACKOFF", "0.0")
+        # Roll back the degradation the helper writes into os.environ.
+        monkeypatch.setenv("JAX_PLATFORMS",
+                           os.environ.get("JAX_PLATFORMS", "cpu"))
+        monkeypatch.setenv("PIPELINEDP_TPU_DEGRADED", "")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        monkeypatch.syspath_prepend(repo)
+        import bench
+        with injected_faults(FaultPlan(wedged_init=99)):
+            report = bench._ensure_device_or_degrade()
+        assert report.degraded
+        assert report.attempts == 2
