@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast bench smoke multichip lint dev clean faultcheck nosleep
+.PHONY: test test-fast bench smoke multichip lint dev clean faultcheck nosleep perfcheck
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -27,16 +27,34 @@ multichip:
 faultcheck: nosleep
 	$(PYTHON) -m pytest tests/test_resilience.py tests/test_faults.py -q
 
+# Overlapped-ingest acceptance suite: serial/overlapped bit-parity,
+# fault-kill drain (no orphan threads), O(n) assignment, id-narrowing
+# tiers, sweep checkpoint/resume — plus the kill/resume fault tests.
+perfcheck: nosleep
+	$(PYTHON) -m pytest tests/test_ingest.py tests/test_faults.py -q
+
 # Lint-style check: no library/bench code path may call time.sleep
 # directly — waits must route through the injectable
 # pipelinedp_tpu.resilience.clock so fault tests stay fast and
-# deterministic. (tests/test_resilience.py enforces the same in-tree.)
+# deterministic — and no bare threading.Thread outside
+# pipelinedp_tpu/ingest/ and pipelinedp_tpu/resilience/: every worker
+# thread must go through the ingest executor's cancellable lifecycle
+# so fault-injected kills can always drain to zero orphan threads.
+# (tests/test_resilience.py enforces both in-tree.)
 nosleep:
 	@bad=$$(grep -rn "time\.sleep *(" --include='*.py' pipelinedp_tpu bench.py \
 	  | grep -v "resilience/clock\.py" || true); \
 	if [ -n "$$bad" ]; then \
 	  echo "$$bad"; \
 	  echo "ERROR: direct time.sleep — use pipelinedp_tpu.resilience.clock"; \
+	  exit 1; \
+	fi; \
+	bad=$$(grep -rn "threading\.Thread *(" --include='*.py' pipelinedp_tpu bench.py \
+	  | grep -v "pipelinedp_tpu/ingest/" \
+	  | grep -v "pipelinedp_tpu/resilience/" || true); \
+	if [ -n "$$bad" ]; then \
+	  echo "$$bad"; \
+	  echo "ERROR: bare threading.Thread — use the pipelinedp_tpu.ingest executor"; \
 	  exit 1; \
 	fi; \
 	echo "nosleep: OK"

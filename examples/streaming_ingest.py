@@ -16,9 +16,13 @@ only streams past 67M rows per device (the bench's ``--stream-rows``
 record runs 150M). Streaming composes with a device mesh
 (``JaxBackend(mesh=make_mesh())``): each chunk shards by privacy id
 over the mesh and the per-chunk budget scales with the device count.
-Batch transfer overlaps the previous batch's kernel, and percentile
-pass B re-reads shipped batches from a device cache
-(``PIPELINEDP_TPU_STREAM_CACHE``) instead of re-shipping them.
+The overlapped ingest executor (``pipelinedp_tpu/ingest``, on by
+default) stages batch b+1 on a background thread while the device
+computes batch b and folds finished batches on an ordered worker —
+bit-identical to the serial path (``PIPELINEDP_TPU_INGEST_EXECUTOR=0``
+to compare) — and percentile pass B re-reads shipped batches from a
+device cache (``PIPELINEDP_TPU_STREAM_CACHE``) instead of re-shipping
+them.
 
 Usage: python examples/streaming_ingest.py
 """
@@ -62,6 +66,14 @@ def main():
     batches = result.timings.get("stream_batches", 1)
     print(f"aggregated in {dt:.1f}s across {batches} streamed batches "
           f"({len(rows)} partitions kept)")
+    t = result.timings
+    if "stream_t_total" in t:
+        print(f"pass-A phases: stage {t['stream_t_stage']:.2f}s + fold "
+              f"{t['stream_t_fold']:.2f}s + device "
+              f"{t['stream_t_device']:.2f}s vs wall "
+              f"{t['stream_t_total']:.2f}s "
+              f"({t['stream_executor']}, overlap "
+              f"{t['stream_overlap_frac']:.0%})")
     print("partition  count      sum     mean   p50")
     for pk, m in rows[:8]:
         print(f"{pk:9d} {m.count:7.0f} {m.sum:9.0f} {m.mean:7.2f} "

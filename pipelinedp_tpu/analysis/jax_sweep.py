@@ -833,7 +833,8 @@ class LazySweepResult:
 
     def __init__(self, col, options, data_extractors, public_partitions,
                  budgets, selection_budget, mesh=None,
-                 return_per_partition=False, backend=None):
+                 return_per_partition=False, backend=None,
+                 checkpoint=None):
         self._col = col
         self._options = options
         self._extractors = data_extractors
@@ -843,6 +844,9 @@ class LazySweepResult:
         self._mesh = mesh
         self._return_per_partition = return_per_partition
         self._backend = backend  # host-graph fallback past _PP_BYTE_CAP
+        self._checkpoint = checkpoint  # budget-safe chunk-prefix resume
+        #: chunk index the last _execute resumed from (observability).
+        self._resumed_from_chunk: Optional[int] = None
         self._cache = None
         self._pp_rows: Optional[list] = None
 
@@ -1030,9 +1034,74 @@ class LazySweepResult:
             dlog_rs, dt_table = jax.device_put((log_rs, t_table))
             cfg = jax.device_put(host_cfg)
 
+        # Budget-safe chunk-prefix resume (the streamed-aggregation
+        # checkpoint pattern applied to the sweep): each chunk's
+        # per-configuration outputs are a pure function of (data,
+        # config), so persisting the completed-chunk prefix after every
+        # chunk lets a killed sweep resume from its last chunk instead
+        # of restarting the whole grid. The fingerprint covers the
+        # chunking, the config vectors and the data content — a
+        # checkpoint from a different sweep refuses to resume.
+        # Per-partition sweeps skip checkpointing (their [P, C] blocks
+        # dwarf the aggregate state; they fall back to a full rerun).
+        import os as _os
+
+        from pipelinedp_tpu.resilience import checkpoint as ckpt_mod
+        from pipelinedp_tpu.resilience import faults
+        ckpt_store = (ckpt_mod.as_store(self._checkpoint)
+                      if not per_partition else None)
+        if ckpt_store is not None:
+            # The sweep checkpoints into a SIBLING file of the backend's
+            # checkpoint path: the streamed aggregation owns the path
+            # itself, and the two features must never collide — a
+            # leftover stream checkpoint would raise CheckpointMismatch
+            # here, and deleting it would destroy the stream's
+            # budget-safe resume state.
+            ckpt_store = ckpt_mod.CheckpointStore(
+                ckpt_store.path + ".sweep")
+        # Chunks between checkpoint writes (the streaming loop's knob):
+        # every save fetches + rewrites the full accumulated prefix, so
+        # large sweeps on slow disks can throttle it.
+        ckpt_every = max(1, int(_os.environ.get(
+            "PIPELINEDP_TPU_CKPT_EVERY", "1")))
+        acc_flat = None  # host arrays, concatenated over done chunks
+        done_chunks = 0
+        ckpt_fp = None
+        if ckpt_store is not None:
+            ckpt_fp = ckpt_mod.sweep_fingerprint(
+                repr((metric_names, str(strategy), str(noise_kind),
+                      public, options.epsilon, options.delta,
+                      options.partitions_sampling_prob,
+                      bool(options.pre_aggregated_data))),
+                C, chunk, P_pad, n_dev,
+                data=ckpt_mod.data_digest(encoded), arrays=host_cfg)
+            saved = ckpt_store.load_for(ckpt_fp)
+            if saved is not None:
+                done_chunks = saved.next_batch
+                acc_flat = dict(saved.arrays)
+        self._resumed_from_chunk = done_chunks
+
+        def flatten_host(out, sel):
+            """One chunk's outputs fetched to host, flat-keyed (the
+            checkpoint array namespace)."""
+            flat = {}
+            for nm in metric_names:
+                for f, v in out[nm].items():
+                    flat[f"o:{nm}:{f}"] = np.asarray(v)
+            if sel is not None:
+                for f, v in sel.items():
+                    flat[f"s:{f}"] = np.asarray(v)
+            return flat
+
         chunk_outs = []
         pp_chunks = []
-        for start in range(0, C, chunk):
+        for ci, start in enumerate(range(0, C, chunk)):
+            if ckpt_store is not None and ci < done_chunks:
+                continue  # restored from the checkpoint prefix
+            # Injectable kill point (the streaming loop's chunk-kill
+            # twin): tests sever the sweep at chunk ci and assert the
+            # resumed grid is bit-identical.
+            faults.check_chunk(ci)
             if self._mesh is not None and n_dev > 1:
                 out, sel, pp = _sweep_chunk_sharded(
                     metric_names, strategy, noise_kind, P_pad, public,
@@ -1049,24 +1118,56 @@ class LazySweepResult:
                     per_partition=per_partition)
                 if per_partition:
                     pp_chunks.append(_split_pp(out, metric_names))
-            chunk_outs.append((out, sel))
+            if ckpt_store is not None:
+                # Checkpointing fetches per chunk (the price of
+                # resumability); the monoid append keeps the prefix
+                # bit-identical to an uninterrupted accumulation. The
+                # accumulated state is small ([C]-sized fields), so the
+                # re-concatenate per chunk is noise next to the fetch.
+                flat = flatten_host(out, sel)
+                acc_flat = (flat if acc_flat is None else
+                            {k: np.concatenate([acc_flat[k], flat[k]])
+                             for k in flat})
+                if (ci + 1) % ckpt_every == 0:  # same boundary rule
+                    # as the streaming fold's checkpoint cadence.
+                    ckpt_store.save(ckpt_mod.StreamCheckpoint(
+                        ckpt_fp, ci + 1, acc_flat))
+            else:
+                chunk_outs.append((out, sel))
 
-        out_cat = jax.tree.map(lambda *xs: jnp.concatenate(xs, 0),
-                               *[o for o, _ in chunk_outs])
-        sel_cat = None
-        if chunk_outs[0][1] is not None:
-            sel_cat = jax.tree.map(lambda *xs: jnp.concatenate(xs, 0),
-                                   *[s for _, s in chunk_outs])
-        # ONE flat d2h transfer for every output field of every chunk.
-        leaves, treedef = jax.tree.flatten((out_cat, sel_cat))
-        shapes = [l.shape for l in leaves]
-        flat = np.asarray(jnp.concatenate([l.ravel() for l in leaves]))
-        split, off = [], 0
-        for s in shapes:
-            size = int(np.prod(s))
-            split.append(flat[off:off + size].reshape(s)[:C])
-            off += size
-        out_cat, sel_cat = jax.tree.unflatten(treedef, split)
+        if ckpt_store is not None:
+            # Reassemble the flat checkpoint namespace; the trailing
+            # config padding (last chunk) slices off exactly as in the
+            # device-concat path below.
+            out_cat = {nm: {} for nm in metric_names}
+            sel_cat = {}
+            for k, v in acc_flat.items():
+                if k.startswith("o:"):
+                    _, nm, f = k.split(":", 2)
+                    out_cat[nm][f] = v[:C]
+                else:
+                    sel_cat[k[2:]] = v[:C]
+            sel_cat = sel_cat or None
+        else:
+            out_cat = jax.tree.map(lambda *xs: jnp.concatenate(xs, 0),
+                                   *[o for o, _ in chunk_outs])
+            sel_cat = None
+            if chunk_outs[0][1] is not None:
+                sel_cat = jax.tree.map(
+                    lambda *xs: jnp.concatenate(xs, 0),
+                    *[s for _, s in chunk_outs])
+            # ONE flat d2h transfer for every output field of every
+            # chunk.
+            leaves, treedef = jax.tree.flatten((out_cat, sel_cat))
+            shapes = [l.shape for l in leaves]
+            flat = np.asarray(jnp.concatenate([l.ravel()
+                                               for l in leaves]))
+            split, off = [], 0
+            for s in shapes:
+                size = int(np.prod(s))
+                split.append(flat[off:off + size].reshape(s)[:C])
+                off += size
+            out_cat, sel_cat = jax.tree.unflatten(treedef, split)
         fields = {nm: out_cat[nm] for nm in metric_names}
         sel_fields = sel_cat
 
@@ -1089,8 +1190,13 @@ class LazySweepResult:
                 all_params, metric_names, blocks, mask_np, noise_rows,
                 encoded.pk_vocab, public)
 
-        return self._pack(all_params, fields, sel_fields, noise_rows,
-                          metric_names)
+        result = self._pack(all_params, fields, sel_fields, noise_rows,
+                            metric_names)
+        if ckpt_store is not None:
+            # The sweep released its outputs: a finished run must not be
+            # resumable (mirrors the streaming-checkpoint contract).
+            ckpt_store.clear()
+        return result
 
     def _host_fallback(self):
         """Per-partition sweeps past the fetch budget run the host
@@ -1208,9 +1314,16 @@ class LazySweepResult:
 def build_fused_sweep(col, options, data_extractors, public_partitions,
                       budget_accountant, mesh=None,
                       return_per_partition=False,
-                      backend=None) -> LazySweepResult:
+                      backend=None, checkpoint=None) -> LazySweepResult:
     """Requests the same budgets the host analysis engine would
-    (``utility_analysis_engine.py:61-99``) and returns the lazy sweep."""
+    (``utility_analysis_engine.py:61-99``) and returns the lazy sweep.
+    ``checkpoint`` (a path or ``resilience.checkpoint.CheckpointStore``)
+    enables budget-safe chunk-prefix resume of the configuration grid —
+    a killed sweep restarts from its last completed chunk instead of
+    from scratch. The sweep writes a ``<path>.sweep`` SIBLING file so a
+    backend shared with streamed aggregations never collides with (or
+    destroys) a stream's own resume state; save cadence follows
+    ``PIPELINEDP_TPU_CKPT_EVERY``."""
     params = options.aggregate_params
     mechanism_type = data_structures.analysis_mechanism_type(options)
     selection_budget = None
@@ -1230,4 +1343,4 @@ def build_fused_sweep(col, options, data_extractors, public_partitions,
                            public_partitions, budgets, selection_budget,
                            mesh=mesh,
                            return_per_partition=return_per_partition,
-                           backend=backend)
+                           backend=backend, checkpoint=checkpoint)

@@ -42,7 +42,8 @@ def perform_utility_analysis(col, backend,
                 col, options, data_extractors, public_partitions,
                 accountant, mesh=getattr(backend, "mesh", None),
                 return_per_partition=return_per_partition,
-                backend=backend)
+                backend=backend,
+                checkpoint=getattr(backend, "checkpoint", None))
             accountant.compute_budgets()
             if return_per_partition:
                 return result, result.per_partition_rows()

@@ -46,20 +46,33 @@ class JaxBackend(LocalBackend):
         must be flagged by callers (bench emits ``"degraded": true``).
       health: the ``resilience.health.HealthReport`` of the probe, or
         None when no ``health_policy`` was requested.
+      ingest_executor: overlapped streaming-ingest executor
+        (``pipelinedp_tpu/ingest``): True/False force it on/off, None
+        (default) follows ``PIPELINEDP_TPU_INGEST_EXECUTOR`` (on unless
+        0). Both modes are bit-identical; off = the serial reference
+        path.
+
+    Constructing the backend also wires JAX's persistent compilation
+    cache when ``PIPELINEDP_TPU_COMPILE_CACHE`` names a directory, so
+    cold processes skip XLA recompilation of the fused kernels.
     """
 
     supports_fused_aggregation = True
 
     def __init__(self, mesh=None, rng_seed: Optional[int] = None,
                  checkpoint=None, health_policy=None, clock=None,
-                 probe_timeout_s: Optional[float] = None):
+                 probe_timeout_s: Optional[float] = None,
+                 ingest_executor: Optional[bool] = None):
         import os
 
+        from pipelinedp_tpu.ingest import maybe_enable_compile_cache
         from pipelinedp_tpu.resilience.health import DEGRADED_ENV
 
+        maybe_enable_compile_cache()
         self.mesh = mesh
         self.rng_seed = rng_seed
         self.checkpoint = checkpoint
+        self.ingest_executor = ingest_executor
         # A prior degradation in this process pinned the platform to
         # CPU for EVERY later backend — the flag must say so even when
         # this construction ran no probe of its own.

@@ -1,0 +1,70 @@
+"""Persistent XLA compilation cache — opt-in, wired at engine init.
+
+Every cold process pays full XLA compilation for the fused kernels
+(seconds per program shape on CPU, tens of seconds on a real TPU
+toolchain). JAX ships a persistent on-disk compilation cache; setting
+``PIPELINEDP_TPU_COMPILE_CACHE=/path/to/dir`` points it at a directory
+so repeated cold runs (bench re-runs, checkpoint-resumed jobs, sweep
+restarts) reuse compiled executables across processes.
+
+Opt-in by design: the cache directory is a shared mutable resource
+(multi-tenant hosts, version skew across jax upgrades invalidating
+entries), so the library never picks a location on its own. The
+min-compile-time / min-entry-size thresholds are zeroed so even the
+small test-scale programs cache — the knob exists for resumability, not
+only for flagship shapes.
+
+Idempotent and failure-safe: configuring twice is a no-op, and a jax
+build without the cache options (or a read-only directory) degrades to
+a warning-free no-op rather than breaking aggregation.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+ENV_VAR = "PIPELINEDP_TPU_COMPILE_CACHE"
+
+_configured: Optional[str] = None
+
+
+def maybe_enable_compile_cache() -> Optional[str]:
+    """Points jax's persistent compilation cache at the directory named
+    by ``PIPELINEDP_TPU_COMPILE_CACHE`` (no-op when unset). Returns the
+    configured directory, or None. Safe to call on every engine/backend
+    construction."""
+    global _configured
+    path = os.environ.get(ENV_VAR)
+    if not path:
+        return None
+    if _configured == path:
+        return _configured
+    import jax
+
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # Cache everything: the default thresholds skip fast-compiling
+        # programs, but resumed/test-scale runs want those too.
+        for flag, value in (
+                ("jax_persistent_cache_min_compile_time_secs", 0.0),
+                ("jax_persistent_cache_min_entry_size_bytes", -1)):
+            try:
+                jax.config.update(flag, value)
+            except Exception:
+                pass  # older jax: threshold knob absent — cache still on
+        try:
+            # jax latches the persistent-cache state at the process's
+            # FIRST compilation; a backend constructed after any jit has
+            # run would silently get no caching without this re-init.
+            from jax._src import compilation_cache as _cc
+            _cc.reset_cache()
+        except Exception:
+            pass
+        _configured = path
+    except Exception:
+        # Never let an unwritable cache dir or an old jax break the
+        # aggregation itself.
+        return None
+    return _configured

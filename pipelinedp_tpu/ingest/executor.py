@@ -1,0 +1,283 @@
+"""Cancellable worker threads for the overlapped streaming ingest.
+
+Threading model (one producer, one consumer per queue, enforced by the
+streaming driver):
+
+    stager thread ──staged queue──> dispatch (caller) ──fold queue──> fold thread
+
+The dispatch thread is the caller's own thread: it pulls staged batches,
+runs the fault-injection check, launches the (async) device kernel and
+submits the launched batch to the fold worker. Kernel *results* are
+fetched by the fold worker — ``np.asarray`` on the packed block blocks
+until that batch's kernel finishes — so the dispatch thread never waits
+on the device and the stager never waits on the fold.
+
+Every blocking primitive here polls with a short timeout instead of
+waiting forever, checking a cancel event (and, via ``poll`` callbacks,
+the health of the peer worker) on each beat. That is what makes the
+whole pipeline *drainable*: when fault injection raises ``ChunkFailure``
+on the dispatch thread, ``close()``/``cancel()`` unblock every queue and
+semaphore, the threads exit after at most one in-flight item, and
+``join`` proves there are no orphans. No ``time.sleep`` anywhere — the
+timeouts ride on ``queue``/``threading`` primitives, keeping the
+``resilience.clock`` no-direct-sleep invariant intact.
+
+Worker exceptions are captured and re-raised on the dispatch thread at
+the next interaction (``submit``/iteration/``finish``), never swallowed.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Callable, Iterable, Iterator, Optional
+
+#: Every thread this package starts carries this name prefix, so tests
+#: can assert a severed run left no orphans.
+THREAD_PREFIX = "pdp-ingest"
+
+#: Seconds between cancel/health polls while blocked on a queue or the
+#: staging ring. Short enough that drain latency is invisible next to a
+#: batch, long enough to cost nothing.
+_POLL_S = 0.02
+
+ENV_VAR = "PIPELINEDP_TPU_INGEST_EXECUTOR"
+
+
+def executor_enabled() -> bool:
+    """The overlapped executor is ON unless the env knob disables it
+    (``PIPELINEDP_TPU_INGEST_EXECUTOR=0`` forces the serial path — the
+    bit-parity reference and the fallback for debugging)."""
+    return os.environ.get(ENV_VAR, "1").lower() not in ("0", "false", "off")
+
+
+class IngestCancelled(Exception):
+    """Raised inside a worker blocked on a queue/ring when the pipeline
+    is being torn down; never escapes to the caller."""
+
+
+class StagingRing:
+    """Reuse gate for a rotating set of staging buffers.
+
+    The stager writes batch b into buffer set ``b % n_slots`` and ships
+    the narrowed planes WITHOUT defensive copies — ``jax.device_put``
+    may zero-copy a numpy array, so the buffer must not be mutated again
+    until nothing can still read batch b's bytes. ``acquire()`` blocks
+    the stager before it reuses a set; ``retire()`` is called by the
+    consumer once batch b's device OUTPUTS have been fetched (a fetch
+    proves the kernel ran, hence its inputs were fully consumed). With
+    ``n_slots=2`` this is classic double buffering: batch b+1 stages
+    while batch b computes, batch b+2 waits for b's fetch.
+    """
+
+    def __init__(self, n_slots: int = 2):
+        self.n_slots = n_slots
+        self._sem = threading.Semaphore(n_slots)
+
+    def acquire(self, cancelled: Optional[threading.Event] = None) -> None:
+        while not self._sem.acquire(timeout=_POLL_S):
+            if cancelled is not None and cancelled.is_set():
+                raise IngestCancelled()
+
+    def retire(self) -> None:
+        self._sem.release()
+
+
+class _CaptureThread(threading.Thread):
+    """Worker thread that captures its body's exception for re-raising
+    on the dispatch thread (``IngestCancelled`` is a clean exit)."""
+
+    def __init__(self, body, name: str):
+        super().__init__(name=f"{THREAD_PREFIX}-{name}", daemon=True)
+        self._body = body
+        self.exc: Optional[BaseException] = None
+
+    def run(self):
+        try:
+            self._body()
+        except IngestCancelled:
+            pass
+        except BaseException as e:  # re-raised by the owner, not lost
+            self.exc = e
+
+
+class BackgroundStager:
+    """Runs a staging generator on a worker thread, one batch ahead.
+
+    ``gen_factory(cancelled)`` builds the generator; it receives the
+    cancel event so staging primitives that block (``StagingRing``) can
+    abort a teardown promptly. ``depth`` bounds the handoff queue — the
+    default 1 plus the item the caller holds is the double buffer.
+
+    Iterate via :meth:`items` (``poll`` runs on every wait beat — pass
+    the fold worker's ``raise_if_failed`` so a dead consumer can't
+    deadlock the pipeline). Always ``close()`` (or use as a context
+    manager): it cancels, unblocks and joins the thread, and re-raises
+    any staging exception not already delivered.
+    """
+
+    def __init__(self, gen_factory: Callable[[threading.Event], Iterable],
+                 depth: int = 1, name: str = "stager"):
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._cancelled = threading.Event()
+        self._done = object()  # sentinel: generator exhausted
+        self._raised = False
+        gen = gen_factory(self._cancelled)
+
+        def body():
+            try:
+                for item in gen:
+                    self._put(item)
+            finally:
+                getattr(gen, "close", lambda: None)()
+                self._put(self._done, sentinel=True)
+
+        self._thread = _CaptureThread(body, name)
+        self._thread.start()
+
+    def _put(self, item, sentinel: bool = False) -> None:
+        while True:
+            try:
+                self._q.put(item, timeout=_POLL_S)
+                return
+            except queue.Full:
+                if not self._cancelled.is_set():
+                    continue  # consumer alive: keep waiting for room
+                if not sentinel:
+                    raise IngestCancelled()
+                # Teardown with a full queue: the staged items will
+                # never be consumed — drop one to make room so the
+                # sentinel (and thread exit) cannot block.
+                try:
+                    self._q.get_nowait()
+                except queue.Empty:
+                    pass
+
+    def items(self, poll: Optional[Callable[[], None]] = None) -> Iterator:
+        """Yields staged batches in order; re-raises stager exceptions.
+        ``poll()`` runs every wait beat (use it to surface a consumer
+        failure instead of waiting on a wedged pipeline)."""
+        while True:
+            try:
+                item = self._q.get(timeout=_POLL_S)
+            except queue.Empty:
+                if poll is not None:
+                    poll()
+                if self._thread.exc is not None:
+                    self._raised = True
+                    raise self._thread.exc
+                continue
+            if item is self._done:
+                if self._thread.exc is not None:
+                    self._raised = True
+                    raise self._thread.exc
+                return
+            yield item
+
+    def __iter__(self) -> Iterator:
+        return self.items()
+
+    def close(self) -> None:
+        """Cancel + join; re-raise a not-yet-delivered staging error."""
+        self._cancelled.set()
+        while self._thread.is_alive():
+            try:  # drain so a blocked put wakes immediately
+                self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=_POLL_S)
+        if self._thread.exc is not None and not self._raised:
+            self._raised = True
+            raise self._thread.exc
+
+    def __enter__(self) -> "BackgroundStager":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is None:
+            self.close()
+        else:  # already unwinding: don't mask the original error
+            try:
+                self.close()
+            except BaseException:
+                pass
+
+
+class OrderedFoldWorker:
+    """Drains a bounded FIFO of launched batches on one worker thread,
+    applying ``fold_fn(item)`` strictly in submission order — the exact
+    left-fold sequence of the serial path, so float64 accumulators and
+    the checkpoints written inside ``fold_fn`` are bit-identical.
+
+    ``submit`` blocks on backpressure (bounding device buffers in
+    flight) and re-raises a fold failure instead of wedging when the
+    worker died. ``finish`` waits for every submitted fold, then joins.
+    ``cancel`` severs: the worker stops after the in-progress fold,
+    queued batches are dropped (their checkpoint prefix is already a
+    valid resume point), and the thread is joined — no orphans.
+    """
+
+    def __init__(self, fold_fn: Callable, depth: int = 2,
+                 name: str = "fold"):
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._cancelled = threading.Event()
+        self._done = object()
+
+        def body():
+            while True:
+                try:
+                    item = self._q.get(timeout=_POLL_S)
+                except queue.Empty:
+                    if self._cancelled.is_set():
+                        return
+                    continue
+                if item is self._done or self._cancelled.is_set():
+                    return
+                fold_fn(item)
+
+        self._thread = _CaptureThread(body, name)
+        self._thread.start()
+
+    def raise_if_failed(self) -> None:
+        if self._thread.exc is not None:
+            exc = self._thread.exc
+            self._thread.exc = None
+            raise exc
+
+    def submit(self, item) -> None:
+        while True:
+            self.raise_if_failed()
+            if not self._thread.is_alive():
+                raise RuntimeError("fold worker exited early")
+            try:
+                self._q.put(item, timeout=_POLL_S)
+                return
+            except queue.Full:
+                continue
+
+    def finish(self) -> None:
+        """Fold everything submitted, stop, join, surface any error."""
+        while True:
+            self.raise_if_failed()
+            try:
+                self._q.put(self._done, timeout=_POLL_S)
+                break
+            except queue.Full:
+                continue
+        while self._thread.is_alive():
+            self._thread.join(timeout=_POLL_S)
+            self.raise_if_failed()
+        self.raise_if_failed()
+
+    def cancel(self) -> None:
+        """Sever: drop queued batches, stop after the in-progress fold,
+        join. Fold errors are NOT re-raised here (cancel runs while an
+        original exception is already unwinding)."""
+        self._cancelled.set()
+        while self._thread.is_alive():
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=_POLL_S)

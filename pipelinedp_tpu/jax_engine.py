@@ -1794,7 +1794,8 @@ class LazyFusedResult:
     def __init__(self, rows, params: AggregateParams, config: FusedConfig,
                  data_extractors, public_partitions, specs,
                  selection_spec, rng_seed: Optional[int] = None,
-                 mesh=None, checkpoint=None):
+                 mesh=None, checkpoint=None, ingest_executor=None):
+        self._ingest_executor = ingest_executor
         self._rows = rows
         self._params = params
         self._config = config
@@ -1859,7 +1860,8 @@ class LazyFusedResult:
                 streaming.stream_partials_and_select(
                     config, encoded, scales, keep_table, thr, s_scale,
                     min_count, rows_per_uid, self._rng_seed,
-                    mesh=self._mesh, checkpoint=self._checkpoint))
+                    mesh=self._mesh, checkpoint=self._checkpoint,
+                    executor=self._ingest_executor))
             self.timings["device_s"] = _time.perf_counter() - t1
             self.timings["stream_batches"] = stream_stats["n_batches"]
             if "resumed_from_batch" in stream_stats:
@@ -1871,6 +1873,12 @@ class LazyFusedResult:
             # blocked waiting for kernel results (the overlap evidence).
             self.timings["stream_stage_s"] = stream_stats["stage_s"]
             self.timings["stream_fold_wait_s"] = stream_stats["fold_wait_s"]
+            # Per-phase pass-A breakdown from the ingest executor: busy
+            # time per phase vs the loop wall clock; overlap_frac > 0
+            # means phase time was hidden inside the wall.
+            for k in ("t_stage", "t_fold", "t_device", "t_total",
+                      "overlap_frac", "executor"):
+                self.timings[f"stream_{k}"] = stream_stats[k]
             if "pass_b_source" in stream_stats:
                 self.timings["stream_pass_b"] = stream_stats["pass_b_source"]
                 self.timings["stream_pass_b_rounds"] = (
@@ -2119,7 +2127,8 @@ def build_fused_select_partitions(col, params, data_extractors,
 def build_fused_aggregation(col, params: AggregateParams, data_extractors,
                             public_partitions, budget_accountant,
                             report_gen, rng_seed=None,
-                            mesh=None, checkpoint=None) -> LazyFusedResult:
+                            mesh=None, checkpoint=None,
+                            ingest_executor=None) -> LazyFusedResult:
     """Engine entry point for the fused plane: requests budgets (same
     pattern as the generic path, so the privacy semantics are identical),
     registers report stages, returns the lazy result."""
@@ -2167,4 +2176,5 @@ def build_fused_aggregation(col, params: AggregateParams, data_extractors,
     return LazyFusedResult(col, params, config, data_extractors,
                            public_partitions, specs, selection_spec,
                            rng_seed=rng_seed, mesh=mesh,
-                           checkpoint=checkpoint)
+                           checkpoint=checkpoint,
+                           ingest_executor=ingest_executor)

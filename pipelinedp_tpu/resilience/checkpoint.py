@@ -91,6 +91,32 @@ def run_fingerprint(config, n_rows: int, n_batches: int, seed: int,
     return hashlib.sha256(blob.encode()).hexdigest()
 
 
+def sweep_fingerprint(spec_repr: str, n_configs: int, chunk: int,
+                      num_partitions: int, n_dev: int, data: str = "",
+                      arrays=()) -> str:
+    """Identity of one analysis sweep (``analysis/jax_sweep.py``):
+    everything that determines the chunk boundaries and the per-chunk
+    kernel math — the static spec, the chunking, the per-config
+    parameter vectors (digested) and the ``data_digest`` content
+    identity. The sweep's per-configuration outputs are pure functions
+    of (data, config), so a resumed prefix + recomputed suffix equals
+    the uninterrupted run exactly."""
+    h = hashlib.blake2b(digest_size=16)
+    for arr in arrays:
+        _digest_array(h, np.asarray(arr))
+    blob = json.dumps({
+        "kind": "analysis_sweep",
+        "spec": spec_repr,
+        "n_configs": int(n_configs),
+        "chunk": int(chunk),
+        "num_partitions": int(num_partitions),
+        "n_dev": int(n_dev),
+        "vectors": h.hexdigest(),
+        "data": data,
+    }, sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
 @dataclasses.dataclass
 class StreamCheckpoint:
     fingerprint: str

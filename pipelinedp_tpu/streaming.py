@@ -383,6 +383,8 @@ def _batch_assignment(config, encoded, n_batches: int, seed: int,
     single-batch mesh kernel). Without privacy ids every row is its own
     unit, so plain contiguous slices suffice (no reorder). Returns
     ``(order | None, counts[n_batches, n_dev])``."""
+    from pipelinedp_tpu.ingest import assign as ingest_assign
+
     n = encoded.n_rows
     cells = n_batches * n_dev
     if config.bounds_already_enforced:
@@ -404,15 +406,17 @@ def _batch_assignment(config, encoded, n_batches: int, seed: int,
         cell_of_row = batch_of_row * n_dev + shard
     else:
         cell_of_row = batch_of_row
-    order = np.argsort(cell_of_row, kind="stable")
-    counts = np.bincount(cell_of_row, minlength=cells)
+    # O(n) counting-sort scatter (bit-identical to the former stable
+    # argsort, ~4x faster at bench scale — see ingest/assign.py).
+    order, counts = ingest_assign.group_rows_by_cell(cell_of_row, cells)
     return order, counts.reshape(n_batches, n_dev)
 
 
 def stream_partials_and_select(config, encoded, scales, keep_table,
                                sel_threshold, sel_scale, sel_min_count,
                                sel_rows_per_uid, rng_seed: Optional[int],
-                               mesh=None, checkpoint=None
+                               mesh=None, checkpoint=None,
+                               executor: Optional[bool] = None
                                ) -> Tuple[np.ndarray, Dict, Dict]:
     """Runs the streaming aggregation. Returns ``(keep[P_pad] bool,
     part64, stats)`` where ``part64`` holds the combined float64/int64
@@ -420,6 +424,15 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
     percentile configs ``stats["percentile_values"]`` carries the
     [P_pad, Q] walked quantile values (pass B re-streams the batches —
     see the module docstring).
+
+    ``executor`` selects the overlapped ingest pipeline
+    (``pipelinedp_tpu/ingest``): a background stager prepares batch b+1
+    while the device computes batch b, and an ordered fold worker
+    fetches + folds finished batches behind the dispatch thread. None
+    (the default) follows ``PIPELINEDP_TPU_INGEST_EXECUTOR`` (on unless
+    set to 0). The overlapped and serial paths are BIT-IDENTICAL —
+    the fold worker preserves the exact left-fold float64 operation
+    sequence and checkpoint order — proven by ``tests/test_ingest.py``.
 
     ``checkpoint`` (a ``resilience.checkpoint.CheckpointStore`` or path)
     enables budget-safe resume: the host accumulators are pure monoid
@@ -441,9 +454,22 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
     every process fetches its own complete copy and runs the identical
     host fold/selection — proven across a two-process gloo mesh by
     ``tests/test_multihost.py``."""
+    from pipelinedp_tpu import ingest
     from pipelinedp_tpu.ops import noise as noise_ops
     from pipelinedp_tpu.resilience import checkpoint as ckpt_mod
     from pipelinedp_tpu.resilience import faults
+
+    use_executor = (ingest.executor_enabled() if executor is None
+                    else bool(executor))
+    if mesh is not None and mesh.is_multi_process:
+        # Multi-PROCESS meshes run the serial path: every process must
+        # enqueue the same device work in the same order, and the
+        # executor's stager/fold threads interleave transfers with the
+        # collective kernels differently per process — measured as a
+        # gloo rendezvous wedge on the two-process CPU mesh. The
+        # single-controller mesh (one process, many devices) keeps the
+        # overlap.
+        use_executor = False
 
     n_dev = mesh.devices.size if mesh is not None else 1
     P = len(encoded.pk_vocab)
@@ -547,19 +573,38 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
         row_sharding = None
 
     t_stage = 0.0  # host staging + enqueue time across both passes
+    t_device = 0.0  # blocked on the device for batch outputs (fetch)
+    t_fold = 0.0  # host fold math after the fetch
 
-    def batches(start_at=0):
+    # Plane-width tiers are decided ONCE from the global id maxima (the
+    # jit signature must not vary per batch) and hoisted out of the
+    # generator: percentile pass B used to rescan the full id columns
+    # on every re-stream round.
+    pid_spec = ("u16" if config.bounds_already_enforced else
+                je._plane_spec(int(encoded.pid.max(initial=0))))
+    pk_spec = je._plane_spec(int(encoded.pk.max(initial=0)))
+
+    # Staging-buffer strategy. Percentile configs may RETAIN shipped
+    # arrays (the device cache feeds pass B), so they keep fresh-copy
+    # semantics: a fresh values buffer per batch, i32-mode planes
+    # copied. Everything else stages into a rotating PAIR of buffer
+    # sets and ships the narrowed planes without defensive copies:
+    # ``device_put`` may zero-copy a numpy array, so a set is reused
+    # only after the batch staged from it had its OUTPUTS fetched
+    # (``StagingRing`` — a fetch proves the kernel consumed its
+    # inputs), i.e. two batches later at the earliest.
+    copy_mode = bool(config.percentiles)
+    ring = None if copy_mode else ingest.StagingRing(2)
+
+    def batches(start_at=0, cancelled=None):
         """Ships the deterministic batch sequence to the device; pass A
-        and pass B (percentiles) iterate it identically. The ID staging
-        buffers are allocated once and reused across batches with their
-        tails re-zeroed (rows past n_valid are masked in the kernel, so
-        no invariant rests on padding content) — safe because what
-        ships is a fresh narrowed copy of them. Everything that is
-        ACTUALLY shipped must be an array no later iteration mutates
-        (``device_put`` may zero-copy a numpy array while the previous
-        batch's kernel is still reading it — the fold runs one batch
-        late and pass B never folds): values stage into a fresh buffer
-        every batch, and i32-mode id planes are copied.
+        and pass B (percentiles) iterate it identically, on the caller's
+        thread (serial path) or on the executor's stager thread
+        (``cancelled`` is the stager's teardown event). Staging buffers
+        rotate per the ``copy_mode``/``ring`` policy above; tails past
+        each shard cell's row count are re-zeroed on reuse (the kernel
+        masks rows past n_valid, so no invariant rests on padding
+        content — the zeroing just keeps shipped bytes deterministic).
 
         On a mesh the staging layout is [n_dev * pad_rows]: shard d's
         rows occupy cell d, and the one ``device_put`` places the
@@ -568,16 +613,17 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
         (b, planes, values_d, nv, n_pid_planes) where ``nv`` is the
         device-ready valid-row count (scalar, or [n_dev] sharded)."""
         nonlocal t_stage
-        pid_spec = (je._plane_spec(int(encoded.pid.max(initial=0)))
-                    if not config.bounds_already_enforced else "u16")
-        pk_spec = je._plane_spec(int(encoded.pk.max(initial=0)))
         buf_len = n_dev * pad_rows
         zeros_dev = None  # shared zero values for COUNT-style runs
-        pid_b = np.zeros(buf_len, np.int32)
-        pk_b = np.zeros(buf_len, np.int32)
+        n_sets = 1 if ring is None else ring.n_slots
+        pid_bufs = [np.zeros(buf_len, np.int32) for _ in range(n_sets)]
+        pk_bufs = [np.zeros(buf_len, np.int32) for _ in range(n_sets)]
         vshape = ((buf_len, config.vector_size)
                   if config.vector_size else (buf_len,))
+        val_bufs = ([np.zeros(vshape, np.float32) for _ in range(n_sets)]
+                    if config.needs_values and not copy_mode else None)
         offset = 0
+        staged = 0
         for b in range(n_batches):
             ccounts = counts[b]
             if b < start_at:
@@ -587,16 +633,21 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                 continue
             if int(ccounts.sum()) == 0:
                 continue
+            if ring is not None:
+                # Blocks until the set staged two batches ago has had
+                # its outputs fetched; aborts promptly on teardown.
+                ring.acquire(cancelled)
             t0 = _time.perf_counter()
-            # Values stage into a FRESH buffer every batch (fresh zeros
-            # also make tail re-zeroing moot): ``jax.device_put`` may
-            # zero-copy a numpy array on some backends, and with the
-            # fold delayed one batch (and pass B never folding) the
-            # previous batch's kernel can still be reading its input
-            # when this batch stages — nothing a pending kernel might
-            # alias is ever mutated.
-            values_b = (np.zeros(vshape, np.float32)
-                        if config.needs_values else None)
+            s = staged % n_sets
+            staged += 1
+            pid_b, pk_b = pid_bufs[s], pk_bufs[s]
+            if copy_mode:
+                # Fresh values buffer every batch: the pass-B device
+                # cache may retain what ships, indefinitely.
+                values_b = (np.zeros(vshape, np.float32)
+                            if config.needs_values else None)
+            else:
+                values_b = val_bufs[s] if val_bufs is not None else None
             # Narrow byte planes, padded on host to the uniform batch
             # shape (uniform shape = ONE compile for every batch).
             for d in range(n_dev):
@@ -610,17 +661,22 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                     pid_b[s0 + cnt:s0 + pad_rows] = 0
                 pk_b[s0:s0 + cnt] = encoded.pk[rows]
                 pk_b[s0 + cnt:s0 + pad_rows] = 0
-                if config.needs_values:
+                if values_b is not None:
                     values_b[s0:s0 + cnt] = encoded.values[rows]
-            # _narrow_ids returns fresh plane arrays except in "i32"
-            # mode, where it returns the staging buffer itself — copy
-            # those so the ship list never aliases a reused buffer.
+                    if not copy_mode:
+                        values_b[s0 + cnt:s0 + pad_rows] = 0
             pid_planes = je._narrow_ids(pid_b, pid_spec)
             n_pid_planes = len(pid_planes)
-            host = [p.copy() if (p is pid_b or p is pk_b) else p
-                    for p in (*pid_planes,
-                              *je._narrow_ids(pk_b, pk_spec))]
-            if config.needs_values:
+            host = [*pid_planes, *je._narrow_ids(pk_b, pk_spec)]
+            if copy_mode:
+                # _narrow_ids returns fresh plane arrays except in
+                # "i32" mode, where it returns the staging buffer
+                # itself — copy those so a retained (cached) ship list
+                # never aliases a reused buffer. In ring mode the slot
+                # gating makes the reuse safe without the copy.
+                host = [p.copy() if (p is pid_b or p is pk_b) else p
+                        for p in host]
+            if values_b is not None:
                 host.append(values_b)
             if row_sharding is None:
                 dev = jax.device_put(tuple(host))  # one batched transfer
@@ -629,7 +685,7 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                 dev = jax.device_put(tuple(host), row_sharding)
                 nv = jax.device_put(ccounts.astype(np.int32),
                                     row_sharding)
-            if config.needs_values:
+            if values_b is not None:
                 planes, values_d = dev[:-1], dev[-1]
             else:
                 planes = dev
@@ -642,14 +698,10 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
             t_stage += _time.perf_counter() - t0
             yield b, planes, values_d, nv, n_pid_planes
 
-    def fold_packed(packed, vec):
-        """Fetch one batch's [C+1, P] block and fold it on host —
-        BLOCKS on that batch's kernel, so the caller delays it by one
-        batch: while batch b-1's fetch waits, batch b's host->device
-        transfer and kernel are already in flight (the device runtime
-        overlaps the copy stream with compute)."""
+    def fold_host(host, vec):
+        """Folds one batch's FETCHED [C+1, P] block into the host
+        accumulators (exact left-fold float64 sequence)."""
         nonlocal vec_acc
-        host = np.asarray(packed)  # [C+1, P_pad] int32, one transfer
         # Loud failure if the kernel's packed column set ever diverges
         # from the host-side name mirror (a silent mismatch would hand
         # the release mislabeled accumulators).
@@ -680,7 +732,6 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                              start_batch == 0 else None)
     cache_bytes = 0
     cache_cap = stream_cache_bytes()
-    t_fold = 0.0
     n_saves = 0
     # Folds between checkpoint writes; clamped to >= 1 (0 would divide
     # by zero below — disable checkpointing by not passing a store).
@@ -691,7 +742,6 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
     # j+1's in-flight histogram — the left-fold order is unchanged.
     mid_acc = (jnp.asarray(mid_restore) if mid_restore is not None
                else None)
-    pending = None  # previous batch's (b, packed, vec, mid), folded late
 
     def save_ckpt(next_batch):
         nonlocal n_saves
@@ -705,18 +755,35 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                                                   arrays))
         n_saves += 1
 
-    def fold_pending():
-        nonlocal t_fold, mid_acc
-        pb, packed, vec, mid = pending
+    def fold_item(item):
+        """Fetch + fold one launched batch, in batch order. Runs on
+        the caller's thread (serial path, one batch behind the launch)
+        or on the executor's single ordered fold worker — either way
+        the float64 operation sequence and the checkpoint-after-fold
+        order are identical. The fetch BLOCKS until the batch's kernel
+        finishes, which is what retires its staging-ring slot."""
+        nonlocal t_fold, t_device, mid_acc
+        pb, packed, vec, mid = item
         t0 = _time.perf_counter()
-        fold_packed(packed, vec)
-        t_fold += _time.perf_counter() - t0
+        host = np.asarray(packed)  # [C+1, P_pad] int32, one transfer
+        if ring is not None:
+            ring.retire()
+        t_device += _time.perf_counter() - t0
+        t0 = _time.perf_counter()
+        fold_host(host, vec)
         if mid is not None:
             mid_acc = mid if mid_acc is None else mid_acc + mid
+        t_fold += _time.perf_counter() - t0
         if ckpt_store is not None and (pb + 1) % ckpt_every == 0:
             save_ckpt(pb + 1)
 
-    for b, planes, values_d, nv, n_pid_planes in batches(start_batch):
+    def launch(item):
+        """Fault check + kernel dispatch for one staged batch (async:
+        returns device futures) — always on the dispatch thread, so
+        injected ``ChunkFailure``s sever the run at a deterministic
+        chunk boundary in both executor modes."""
+        nonlocal cache, cache_bytes
+        b, planes, values_d, nv, n_pid_planes = item
         # Injectable kill point: tests sever the run at chunk b and
         # assert the checkpointed resume is bit-identical.
         faults.check_chunk(b)
@@ -738,11 +805,50 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                 cache.append((b, planes, values_d, nv, n_pid_planes))
             else:
                 cache = None
+        return b, packed, vec, mid
+
+    t_loop0 = _time.perf_counter()
+    if use_executor:
+        # Overlapped pass A: the stager prepares batch b+1 while the
+        # device computes batch b and the fold worker drains finished
+        # batches — three phases in flight at once. Any failure
+        # (including injected ChunkFailures) cancels both workers and
+        # joins them before propagating: no orphan threads, and the
+        # checkpoint on disk is a clean fold prefix.
+        folder = ingest.OrderedFoldWorker(fold_item, depth=2)
+        try:
+            with ingest.BackgroundStager(
+                    lambda cancelled: batches(start_batch, cancelled),
+                    depth=1) as stager:
+                for item in stager.items(poll=folder.raise_if_failed):
+                    folder.submit(launch(item))
+            folder.finish()
+        except BaseException:
+            folder.cancel()
+            raise
+    else:
+        # Serial pass A (the bit-parity reference): fold one batch
+        # late, so batch b's transfer + kernel are in flight while
+        # batch b-1's fetch waits.
+        pending = None
+        for item in batches(start_batch):
+            out = launch(item)
+            if pending is not None:
+                fold_item(pending)
+            pending = out
         if pending is not None:
-            fold_pending()
-        pending = (b, packed, vec, mid)
-    if pending is not None:
-        fold_pending()
+            fold_item(pending)
+    t_loop = _time.perf_counter() - t_loop0
+    # Overlap evidence for the bench: time the three host/device phases
+    # spent busy vs the wall clock of the whole pass-A loop. Serial
+    # execution gives t_total ≈ busy (frac ~0); overlap hides phase
+    # time inside the wall (t_total < busy, frac > 0).
+    busy_a = t_stage + t_device + t_fold
+    overlap = {"t_stage": t_stage, "t_device": t_device,
+               "t_fold": t_fold, "t_total": t_loop,
+               "overlap_frac": (max(0.0, 1.0 - t_loop / busy_a)
+                                if busy_a > 0 else 0.0),
+               "executor": "overlapped" if use_executor else "serial"}
 
     part64: Dict[str, np.ndarray] = dict(acc)
     part64.update(val_acc)
@@ -767,7 +873,8 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
             jnp.float32(sel_rows_per_uid), k_sel))
     stats = {"n_batches": n_batches, "chunk_rows": chunk,
              "fx_bits": fx_bits, "max_batch_rows": max_rows,
-             "mesh_devices": n_dev, "fold_wait_s": t_fold}
+             "mesh_devices": n_dev,
+             "fold_wait_s": t_device + t_fold, **overlap}
     if ckpt_store is not None:
         stats["resumed_from_batch"] = start_batch
         stats["checkpoint_saves"] = n_saves
@@ -806,12 +913,10 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                                   else "reship")
         Q = len(config.percentiles)
         vals_groups = []
-        for q0 in range(0, Q, q_chunk):
-            qsl = slice(q0, min(q0 + q_chunk, Q))
-            ss_dev = jnp.asarray(sub_start[:, qsl])
+
+        def run_pass_b(source, ss_dev):
             sub_acc = None
-            pass_b = iter(cache) if cache is not None else batches()
-            for b, planes, values_d, nv, n_pid_planes in pass_b:
+            for b, planes, values_d, nv, n_pid_planes in source:
                 kb = jax.random.fold_in(k_bound, b)
                 if mesh is None:
                     sub = _pct_sub_kernel(
@@ -824,6 +929,24 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                         fx_bits, n_pid_planes=n_pid_planes,
                         sub_start=ss_dev)
                 sub_acc = sub if sub_acc is None else sub_acc + sub
+            return sub_acc
+
+        for q0 in range(0, Q, q_chunk):
+            qsl = slice(q0, min(q0 + q_chunk, Q))
+            ss_dev = jnp.asarray(sub_start[:, qsl])
+            if cache is not None:
+                sub_acc = run_pass_b(iter(cache), ss_dev)
+            elif use_executor:
+                # Overlapped re-ship: stage batch b+1 on the stager
+                # thread while the device counts batch b's subtree
+                # leaves (no folds in pass B — accumulation stays on
+                # device, so only the stager is needed).
+                with ingest.BackgroundStager(
+                        lambda cancelled: batches(cancelled=cancelled),
+                        depth=1) as stager_b:
+                    sub_acc = run_pass_b(stager_b.items(), ss_dev)
+            else:
+                sub_acc = run_pass_b(batches(), ss_dev)
             vals_g = _walk_bottom_kernel(
                 config, P_pad, sub_acc, ss_dev, lo[:, qsl], hi[:, qsl],
                 target[:, qsl], leaf_lo[:, qsl], done[:, qsl], k_tree,
