@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast bench smoke multichip lint dev clean faultcheck nosleep perfcheck
+.PHONY: test test-fast bench smoke multichip lint dev clean faultcheck nosleep perfcheck nofoldin
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -27,11 +27,31 @@ multichip:
 faultcheck: nosleep
 	$(PYTHON) -m pytest tests/test_resilience.py tests/test_faults.py -q
 
-# Overlapped-ingest acceptance suite: serial/overlapped bit-parity,
+# Performance-path acceptance suite: overlapped-ingest bit-parity,
 # fault-kill drain (no orphan threads), O(n) assignment, id-narrowing
-# tiers, sweep checkpoint/resume — plus the kill/resume fault tests.
-perfcheck: nosleep
-	$(PYTHON) -m pytest tests/test_ingest.py tests/test_faults.py -q
+# tiers, sweep checkpoint/resume, the kill/resume fault tests — plus
+# the quantile-walk suite (counter-noise generator, three-way walk
+# bit-parity, partition-block chunking, guard-cliff boundaries).
+perfcheck: nosleep nofoldin
+	$(PYTHON) -m pytest tests/test_ingest.py tests/test_faults.py \
+	  tests/test_walk.py -q
+
+# Lint-style check: no per-element vmap(fold_in) key constructions —
+# they rebuild a full threefry key schedule per element, the cost the
+# counter-based node-noise generator (ops/counter_rng.py, the one
+# blessed keyed-generator module) removed from the quantile walk.
+# (tests/test_walk.py enforces the same rule in-tree.)
+nofoldin:
+	@bad=$$(grep -rnE "vmap.*fold_in|fold_in.*vmap" --include='*.py' \
+	  pipelinedp_tpu bench.py \
+	  | grep -v "pipelinedp_tpu/ops/counter_rng\.py" || true); \
+	if [ -n "$$bad" ]; then \
+	  echo "$$bad"; \
+	  echo "ERROR: per-element vmap(fold_in) key construction — use"; \
+	  echo "the counter-based generator (pipelinedp_tpu/ops/counter_rng)"; \
+	  exit 1; \
+	fi; \
+	echo "nofoldin: OK"
 
 # Lint-style check: no library/bench code path may call time.sleep
 # directly — waits must route through the injectable

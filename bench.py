@@ -479,6 +479,112 @@ def roofline_probe(ds):
     return rec
 
 
+def walk_breakdown_probe(n_partitions, n_rows, n_quantiles=3):
+    """Per-phase breakdown of the quantile walk at the config-4 shape,
+    mirroring the ingest record's ``t_stage/t_fold/t_device/t_total``
+    split: ``t_noise`` (the per-level node-noise generation alone — the
+    counter-based threefry draws, 4 levels with the root deduped),
+    ``t_hist`` (the [P, 256] top-histogram row scatter — the walk's one
+    unconditional full-row scatter; the data-dependent compacted
+    subtree build lands in the residual), ``t_walk`` (the residual,
+    t_total minus the other two, floored at 0) and
+    ``t_total`` (the full ``_percentile_values`` wall clock). Driver-
+    measurable: re-deriving the node-noise speedup claim needs exactly
+    one clean run of this record before and after a change."""
+    import jax
+    import jax.numpy as jnp
+
+    from pipelinedp_tpu import jax_engine as je
+    from pipelinedp_tpu.aggregate_params import NoiseKind
+    from pipelinedp_tpu.ops import quantile_tree as qt
+
+    P = je._pad_pow2(n_partitions)
+    n = je._pad_rows(n_rows)
+    b = qt.DEFAULT_BRANCHING_FACTOR
+    height = qt.DEFAULT_TREE_HEIGHT
+    n_leaves = b**height
+    Q = n_quantiles
+    percentiles = tuple(float(p) for p in
+                        np.linspace(50, 99, Q).round(0))
+    config = je.FusedConfig(
+        metrics=("PERCENTILE",), percentiles=percentiles,
+        noise_kind=NoiseKind.LAPLACE, linf=2, l0=4,
+        per_partition_bounds=False, min_value=0.0, max_value=10.0,
+        min_sum_per_partition=None, max_sum_per_partition=None,
+        vector_size=None, vector_norm_kind=None, vector_max_norm=None,
+        selection=None, bounds_already_enforced=False)
+    key = jax.random.PRNGKey(0)
+    qpk = jax.random.randint(jax.random.fold_in(key, 1), (n,), 0,
+                             n_partitions, jnp.int32)
+    leaf = jax.random.randint(jax.random.fold_in(key, 2), (n,), 0,
+                              n_leaves, jnp.int32)
+    kept = jnp.ones(n, bool)
+    scale = jnp.float32(2.0)
+    bucket_w = b**(height - 2)  # the top histogram's bucket width
+
+    @jax.jit
+    def noise_only(key):
+        # The walk's exact per-level draw structure: root deduped to
+        # [P, 1, b] and broadcast, three [P, Q, b] levels below.
+        tot = jnp.float32(0)
+        base = jnp.zeros((P, Q), jnp.int32)
+        level_offset = 0
+        for level in range(height):
+            node_ids = (level_offset + base)[..., None] + jnp.arange(
+                b, dtype=jnp.int32)
+            ids = node_ids[:, :1, :] if level_offset == 0 else node_ids
+            tot += je._node_noise(config.noise_kind, key, ids).sum()
+            level_offset += b**(level + 1)
+        return tot
+
+    @jax.jit
+    def hist_only(qpk, leaf, kept):
+        # The [P, b^2] top-histogram scatter — the walk's one
+        # unconditional full-row scatter (the bottom-level sub-histogram
+        # build is data-dependent: prefix-sum compaction makes its cost
+        # a function of subtree concentration, so it lands in the
+        # t_walk residual rather than being modeled separately).
+        n_mid = b * b
+        hist = jax.ops.segment_sum(
+            kept.astype(jnp.int32),
+            qpk * n_mid + jnp.minimum(leaf // bucket_w, n_mid - 1),
+            num_segments=P * n_mid)
+        return hist[0]
+
+    @jax.jit
+    def walk_full(qpk, leaf, kept, scale, key):
+        return je._percentile_values(config, P, (qpk, leaf, kept),
+                                     scale, key)[0, 0]
+
+    def timed(fn, *args):
+        np.asarray(fn(*args))  # compile warm-up
+        best = 1e9
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(fn(*args))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_noise = timed(noise_only, key)
+    t_hist = timed(hist_only, qpk, leaf, kept)
+    t_total = timed(walk_full, qpk, leaf, kept, scale, key)
+    rec = {
+        "metric": "quantile_walk_breakdown",
+        "partitions": P,
+        "rows": n,
+        "quantiles": Q,
+        "t_noise": round(t_noise, 4),
+        "t_hist": round(t_hist, 4),
+        "t_walk": round(max(0.0, t_total - t_noise - t_hist), 4),
+        "t_total": round(t_total, 4),
+    }
+    log(f"## quantile walk breakdown [{P} parts, {n} rows, {Q} q]: "
+        f"noise {t_noise:.3f}s + hist {t_hist:.3f}s + walk "
+        f"{rec['t_walk']:.3f}s (total {t_total:.3f}s)")
+    log(json.dumps(rec))
+    return rec
+
+
 def _ensure_device_or_degrade():
     """Probe the accelerator with bounded retry + exponential backoff
     (jax backend initialization can block indefinitely on a wedged TPU
@@ -608,6 +714,12 @@ def main():
                 max_contributions_per_partition=2,
                 min_value=0.0, max_value=10.0),
             ds_q, min(local_rows, 50_000), repeats=3)  # 10M rows: 3 is enough
+
+        # Per-phase walk breakdown at (at least) a 2^16-partition
+        # synthetic — the driver-measurable evidence for walk-phase
+        # claims (t_noise / t_hist / t_walk / t_total).
+        walk_breakdown_probe(max(1 << 16, q_parts),
+                             min(q_rows, 4_000_000))
 
         # Config 5: the analysis epsilon-sweep.
         bench_analysis_sweep(a_rows, max(1000, a_rows // 25),

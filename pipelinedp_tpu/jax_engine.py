@@ -33,10 +33,14 @@ PERCENTILE never materializes dense per-partition trees (height-4 ×
 branching-16 = 69,904 nodes per partition would be O(P·nodes) HBM): the
 quantile walk runs level-by-level over ALL partitions at once, counting
 each level's child buckets with one segment_sum over the rows, and node
-noise is a pure function of (partition, node index) via ``fold_in`` — the
+noise is a pure function of (partition, node index) — one batched
+counter-based threefry draw per level (``ops/counter_rng.py``), the
 stateless equivalent of the host tree's noisy-count memoization
 (reference ``pipeline_dp/combiners.py:402-476``; host twin
-``ops/quantile_tree.py``).
+``ops/quantile_tree.py``). When the bottom walk's [P, Q, span] subtree
+block exceeds ``_SUBHIST_BYTE_CAP``, the partition axis chunks into
+blocks walked one at a time — bit-identical to the unchunked walk,
+because the noise is keyed by GLOBAL (partition, node).
 """
 
 from __future__ import annotations
@@ -804,12 +808,17 @@ _FX_STEPS = 1 << 23
 _FX_OFFSET = 1 << 23
 _FX_PAYLOAD_BITS = 24  # offset-shifted u fits 24 bits (u <= 2^24 - 1)
 
+# int32 lane-sum capacity. Module-level seam so boundary tests can
+# inject a small cap and pin the exact guard cliff (the way the lane
+# plan's 524,417-row boundary is pinned) without 2^27-row datasets.
+_LANE_SUM_CAP = 1 << 31
+
 
 def _fx_max_rows() -> int:
     """Largest per-batch GLOBAL row count the narrowest (4-bit) lane
     plan accumulates exactly — the streaming chunk sizer caps per-batch
     targets here so value pipelines never plan an impossible batch."""
-    return ((1 << 31) - 1) // 15
+    return (_LANE_SUM_CAP - 1) // 15
 
 
 def _fx_plan(n_rows_total: int) -> Tuple[int, int]:
@@ -817,9 +826,9 @@ def _fx_plan(n_rows_total: int) -> Tuple[int, int]:
     across all devices — the cross-device psum adds per-shard lane sums,
     so capacity is a GLOBAL row bound."""
     bits = 12
-    while bits > 4 and n_rows_total * ((1 << bits) - 1) >= (1 << 31):
+    while bits > 4 and n_rows_total * ((1 << bits) - 1) >= _LANE_SUM_CAP:
         bits -= 1
-    if n_rows_total * ((1 << bits) - 1) >= (1 << 31):
+    if n_rows_total * ((1 << bits) - 1) >= _LANE_SUM_CAP:
         raise NotImplementedError(
             f"fixed-point value lanes support up to 2^27 rows per "
             f"BATCH (got {n_rows_total}). The engine streams larger "
@@ -1125,28 +1134,46 @@ def _node_noise(noise_kind: NoiseKind, key, node_ids, pk_index=None):
     """One noise draw per (partition, tree node), as a pure function of
     the indices: every quantile walk that visits a node sees the same
     noisy count — the stateless form of the host tree's memoization
-    (``ops/quantile_tree.py:176-183``). ``node_ids`` is int32 [P, Q, b];
-    ``pk_index`` overrides the per-partition key indices (the GLOBAL
-    partition ids when the pk axis is sharded, so mesh noise matches
-    single-chip noise bit-for-bit)."""
+    (``ops/quantile_tree.py::compute_quantiles``). Realized as ONE
+    batched counter-based threefry pass per call
+    (``ops/counter_rng.py``): the (partition, node) pair IS the
+    counter, so the draw is identical wherever and however often the
+    pair appears — visited-node-only draws, the root-level broadcast
+    and partition-block-chunked walks are all bit-exact restructurings
+    by construction. ``node_ids`` is int32 [P, Q, b]; ``pk_index``
+    overrides the per-partition counter lane (the GLOBAL partition ids
+    when the pk axis is sharded or block-chunked, so mesh, streamed and
+    chunked noise all match the single-chip draw bit-for-bit)."""
+    from pipelinedp_tpu.ops import counter_rng
+
     P = node_ids.shape[0]
     if pk_index is None:
         pk_index = jnp.arange(P, dtype=jnp.uint32)
-    pkeys = jax.vmap(lambda i: jax.random.fold_in(key, i))(pk_index)
-    flat = node_ids.reshape(P, -1).astype(jnp.uint32)
-
-    def per_pk(k, ids):
-        ks = jax.vmap(lambda i: jax.random.fold_in(k, i))(ids)
-        if noise_kind == NoiseKind.LAPLACE:
-            return jax.vmap(lambda kk: jax.random.laplace(kk, ()))(ks)
-        return jax.vmap(lambda kk: jax.random.normal(kk, ()))(ks)
-
-    return jax.vmap(per_pk)(pkeys, flat).reshape(node_ids.shape)
+    x0 = jnp.broadcast_to(
+        pk_index.astype(jnp.uint32).reshape(
+            (P,) + (1,) * (node_ids.ndim - 1)), node_ids.shape)
+    x1 = node_ids.astype(jnp.uint32)
+    if noise_kind == NoiseKind.LAPLACE:
+        return counter_rng.laplace(key, x0, x1)
+    return counter_rng.normal(key, x0, x1)
 
 
 # HBM cap for the per-quantile subtree histogram (int32 [P, Q, span]);
-# above it the walk falls back to per-level row scatters.
+# above it the walk chunks the partition axis into blocks and walks
+# block-by-block (bit-identical to the unchunked walk — node noise is a
+# pure function of (partition, node id)).
 _SUBHIST_BYTE_CAP = 600 << 20
+
+# The single-batch walk unrolls its partition blocks INSIDE one XLA
+# program, so the block count is bounded: each block costs ~3 O(n)
+# elementwise passes + Q compacted scatters, so 16 blocks stay well
+# under the per-level row-scatter fallback's cost envelope while
+# covering subtree blocks to 16x the byte cap (~10 GB at the default
+# cap — past any single chip's HBM); beyond that the per-level
+# fallback both bounds the program size and does fewer row passes.
+# (The streamed walk needs no such bound: its blocks are separate
+# kernel launches, and re-streaming is its only completion path.)
+_MAX_WALK_BLOCKS = 16
 
 
 def _percentile_values(config: FusedConfig, P, qrows, scale, key):
@@ -1208,134 +1235,188 @@ def _percentile_values(config: FusedConfig, P, qrows, scale, key):
     leaf_lo = jnp.zeros((P, Q), jnp.int32)
     done = jnp.zeros((P, Q), bool)
     level_offset = 0
-    sub_hist = None  # [P, Q, span] leaf-granularity subtree histogram
-    sub_start = None  # [P, Q] first leaf of the sub_hist subtree
-    for level in range(height):
+    # Top levels: served by the mid histogram (node width >= bucket_w —
+    # levels 0 and 1 for any height >= 2).
+    n_top = min(2, height) if hist is not None else 0
+    for level in range(n_top):
         w = b**(height - 1 - level)
         base = leaf_lo // w  # [P, Q] first-child index at this level
-        below_hist = hist is None or w < bucket_w
-        if below_hist and sub_hist is None:
-            # Entering the levels the top histogram can't serve. ONE
-            # leaf-granularity scatter per quantile over the chosen
-            # subtree (span = w*b leaves) serves ALL remaining levels via
-            # in-register group sums — halving the walk's dominant cost,
-            # the full-row scatters (VERDICT r2 #9). Skipped when the
-            # [P, Q, span] block would blow HBM; the per-level fallback
-            # then runs.
-            span = w * b
-            n_blocks = (b**height) // span
-            if P * Q * span * 4 <= _SUBHIST_BYTE_CAP:
-                # The descent so far only added multiples of widths
-                # >= span, so every walk's subtree start is span-ALIGNED:
-                # membership is "the row's span-block == the walk's
-                # block id", the in-subtree offset is just the low leaf
-                # bits, and the scatter key is the SAME for every
-                # quantile — only the membership mask differs. The Q
-                # per-row block ids (each < n_blocks <= 256 for the
-                # default tree) pack 4-per-int32, so the per-row cost is
-                # ceil(Q/4) gathers + byte compares instead of Q
-                # gathers.
-                sub_start = leaf_lo
-                shift = span.bit_length() - 1  # span is a power of two
-                mid = leaf >> shift
-                lo_bits = leaf & (span - 1)
-                blk = sub_start >> shift  # [P, Q] block ids
-
-                def row_masks(qpk_r, mid_r, kept_r):
-                    """Per-quantile membership masks of the given rows,
-                    via the packed block tables."""
-                    masks = []
-                    for g in range(0, Q, 4):
-                        packed = jnp.zeros(P, jnp.int32)
-                        for j, q in enumerate(range(g, min(g + 4, Q))):
-                            packed |= blk[:, q] << (8 * j)
-                        pr = packed[qpk_r]  # ONE gather per 4 quantiles
-                        for j, q in enumerate(range(g, min(g + 4, Q))):
-                            masks.append(kept_r & (
-                                mid_r == ((pr >> (8 * j)) & 0xFF)))
-                    return masks
-
-                def subs_over(qpk_r, mid_r, lo_r, kept_r):
-                    seg = qpk_r * span + lo_r  # q-independent key
-                    return jnp.stack([
-                        jax.ops.segment_sum(ok.astype(jnp.int32), seg,
-                                            num_segments=P * span
-                                            ).reshape(P, span)
-                        for ok in row_masks(qpk_r, mid_r, kept_r)
-                    ], axis=1)  # [P, Q, span] int32
-
-                if n_blocks <= 256 and shift <= 22:
-                    # The chosen subtrees jointly cover ~Q/n_blocks of
-                    # the leaf space, so typically ~1% of rows land in
-                    # ANY sub-histogram — yet a full scatter scans every
-                    # row. Compact the relevant rows to a static n/8
-                    # prefix by PREFIX-SUM scatter: each relevant row's
-                    # destination is its rank among relevant rows
-                    # (cumsum), so two O(n) passes replace the former
-                    # stable argsort's ~log^2 n bitonic stages (the
-                    # walk's furthest-from-roofline op, r4 README). The
-                    # destinations are unique and monotone — the
-                    # scatter coalesces; irrelevant rows target index
-                    # ``cap`` and drop out of bounds, as do relevant
-                    # rows past the cap (data concentrated enough to
-                    # overflow falls back to full-row scatters via
-                    # lax.cond). The three row fields pack into one
-                    # int32 (mid <= 8 bits by the n_blocks gate,
-                    # lo_bits < span = 2^shift <= 2^22 by the shift
-                    # gate, kept 1 bit), so compaction is exactly two
-                    # int32 scatters.
-                    n_rows = leaf.shape[0]
-                    cap = max(8192, n_rows // 8)
-                    rel_any = jnp.zeros(n_rows, bool)
-                    for ok in row_masks(qpk, mid, kept):
-                        rel_any |= ok
-                    n_rel = jnp.sum(rel_any.astype(jnp.int32))
-
-                    def compacted(_):
-                        # Built INSIDE the branch: cond operands are
-                        # computed unconditionally, so hoisting these
-                        # would make the overflow fallback pay for
-                        # both paths.
-                        dest = jnp.where(
-                            rel_any,
-                            jnp.cumsum(rel_any.astype(jnp.int32)) - 1,
-                            cap)
-                        packed_row = (
-                            mid | (lo_bits << 8) |
-                            (kept.astype(jnp.int32) << (8 + shift)))
-                        qpk_c = jnp.zeros(cap, jnp.int32).at[dest].set(
-                            qpk, mode="drop")
-                        row_c = jnp.zeros(cap, jnp.int32).at[dest].set(
-                            packed_row, mode="drop")
-                        return subs_over(qpk_c, row_c & 0xFF,
-                                         (row_c >> 8) & (span - 1),
-                                         (row_c >> (8 + shift)
-                                          ).astype(bool))
-
-                    def full(_):
-                        return subs_over(qpk, mid, lo_bits, kept)
-
-                    sub_hist = jax.lax.cond(n_rel <= cap, compacted,
-                                            full, None)
-                elif n_blocks <= 256:
-                    # Exotic tree shapes whose packed row would overflow
-                    # int32: no compaction, full-row scatters.
-                    sub_hist = subs_over(qpk, mid, lo_bits, kept)
-                else:  # non-default tree shapes: block ids > 8 bits
-                    sub_hist = _subtree_counts(qpk, leaf, kept,
-                                               sub_start, P, span)
-        if not below_hist:
-            raw = counts_at(w, base)  # [P, Q, b]
-        elif sub_hist is not None:
-            raw = _sub_level_counts(sub_hist, sub_start, leaf_lo, w, b)
-        else:
-            raw = counts_at(w, base)
         lo, hi, target, leaf_lo, done = _walk_level(
-            config.noise_kind, key, scale, raw, base, level_offset, lo,
-            hi, target, leaf_lo, done, b, w)
+            config.noise_kind, key, scale, counts_at(w, base), base,
+            level_offset, lo, hi, target, leaf_lo, done, b, w)
         level_offset += b**(level + 1)
+
+    if n_top < height:
+        # Bottom levels: ONE leaf-granularity scatter per quantile over
+        # the chosen subtree (span = w*b leaves at the first bottom
+        # level) serves ALL remaining levels via in-register group sums
+        # — halving the walk's dominant cost, the full-row scatters
+        # (VERDICT r2 #9).
+        w1 = b**(height - 1 - n_top)
+        span = w1 * b
+        if P * Q * span * 4 <= _SUBHIST_BYTE_CAP:
+            sub_start = leaf_lo  # [P, Q] first leaf of each subtree
+            sub_hist = _build_sub_hist(qpk, leaf, kept, sub_start, P, Q,
+                                       span, b, height)
+            for level in range(n_top, height):
+                w = b**(height - 1 - level)
+                raw = _sub_level_counts(sub_hist, sub_start, leaf_lo, w, b)
+                lo, hi, target, leaf_lo, done = _walk_level(
+                    config.noise_kind, key, scale, raw, leaf_lo // w,
+                    level_offset, lo, hi, target, leaf_lo, done, b, w)
+                level_offset += b**(level + 1)
+        else:
+            blk = 0
+            if Q * span * 4 <= _SUBHIST_BYTE_CAP:
+                blk = min(P, 1 << ((_SUBHIST_BYTE_CAP //
+                                    (Q * span * 4)).bit_length() - 1))
+            if blk and -(-P // blk) <= _MAX_WALK_BLOCKS:
+                # The full [P, Q, span] block would blow the HBM cap:
+                # chunk the partition axis into blocks and walk
+                # block-by-block (the streamed pass B's q-chunk loop
+                # shape, turned along the partition axis), each block's
+                # histogram built with the SAME compacted machinery as
+                # the one-block walk (rows outside the block are simply
+                # masked out of the relevance flags). Node noise is a
+                # pure function of the GLOBAL (partition, node id) —
+                # passed via ``pk_index`` — and the per-partition
+                # histogram content is unchanged, so the chunked walk
+                # is bit-identical to the unchunked one.
+                outs = []
+                for p0 in range(0, P, blk):
+                    Pb = min(blk, P - p0)
+                    psl = slice(p0, p0 + Pb)
+                    ss = leaf_lo[psl]
+                    rel_pk = qpk - p0
+                    kept_b = kept & (rel_pk >= 0) & (rel_pk < Pb)
+                    pk_b = jnp.clip(rel_pk, 0, Pb - 1)
+                    sub = _build_sub_hist(pk_b, leaf, kept_b, ss, Pb,
+                                          Q, span, b, height)
+                    lo_b, hi_b, tg_b = lo[psl], hi[psl], target[psl]
+                    ll_b, dn_b = leaf_lo[psl], done[psl]
+                    pk_idx = (p0 + jnp.arange(Pb)).astype(jnp.uint32)
+                    lvo = level_offset
+                    for level in range(n_top, height):
+                        w = b**(height - 1 - level)
+                        raw = _sub_level_counts(sub, ss, ll_b, w, b)
+                        lo_b, hi_b, tg_b, ll_b, dn_b = _walk_level(
+                            config.noise_kind, key, scale, raw,
+                            ll_b // w, lvo, lo_b, hi_b, tg_b, ll_b,
+                            dn_b, b, w, pk_index=pk_idx)
+                        lvo += b**(level + 1)
+                    outs.append(lo_b + (hi_b - lo_b) * tg_b)
+                return _monotone_in_q(jnp.concatenate(outs, axis=0),
+                                      quantiles)
+            # Past _MAX_WALK_BLOCKS (or a cap below one partition's
+            # [1, Q, span] block — necessarily test-shrunken):
+            # per-level per-quantile row scatters, the rows being
+            # device-resident here.
+            for level in range(n_top, height):
+                w = b**(height - 1 - level)
+                base = leaf_lo // w
+                lo, hi, target, leaf_lo, done = _walk_level(
+                    config.noise_kind, key, scale, counts_at(w, base),
+                    base, level_offset, lo, hi, target, leaf_lo, done,
+                    b, w)
+                level_offset += b**(level + 1)
     vals = lo + (hi - lo) * target  # [P, Q]
     return _monotone_in_q(vals, quantiles)
+
+
+def _build_sub_hist(qpk, leaf, kept, sub_start, P, Q, span, b, height):
+    """The [P, Q, span] leaf-granularity subtree histograms of the
+    bottom walk, with the prefix-sum row compaction (r5): the chosen
+    subtrees jointly cover ~Q/n_blocks of the leaf space, so typically
+    ~1% of rows land in ANY sub-histogram — compact the relevant rows
+    to a static n/8 prefix first so the per-quantile scatters scan 8x
+    fewer rows."""
+    n_blocks = (b**height) // span
+    # The descent so far only added multiples of widths >= span, so
+    # every walk's subtree start is span-ALIGNED: membership is "the
+    # row's span-block == the walk's block id", the in-subtree offset
+    # is just the low leaf bits, and the scatter key is the SAME for
+    # every quantile — only the membership mask differs. The Q per-row
+    # block ids (each < n_blocks <= 256 for the default tree) pack
+    # 4-per-int32, so the per-row cost is ceil(Q/4) gathers + byte
+    # compares instead of Q gathers.
+    shift = span.bit_length() - 1  # span is a power of two
+    mid = leaf >> shift
+    lo_bits = leaf & (span - 1)
+    blk = sub_start >> shift  # [P, Q] block ids
+
+    def row_masks(qpk_r, mid_r, kept_r):
+        """Per-quantile membership masks of the given rows, via the
+        packed block tables."""
+        masks = []
+        for g in range(0, Q, 4):
+            packed = jnp.zeros(P, jnp.int32)
+            for j, q in enumerate(range(g, min(g + 4, Q))):
+                packed |= blk[:, q] << (8 * j)
+            pr = packed[qpk_r]  # ONE gather per 4 quantiles
+            for j, q in enumerate(range(g, min(g + 4, Q))):
+                masks.append(kept_r & (
+                    mid_r == ((pr >> (8 * j)) & 0xFF)))
+        return masks
+
+    def subs_over(qpk_r, mid_r, lo_r, kept_r):
+        seg = qpk_r * span + lo_r  # q-independent key
+        return jnp.stack([
+            jax.ops.segment_sum(ok.astype(jnp.int32), seg,
+                                num_segments=P * span
+                                ).reshape(P, span)
+            for ok in row_masks(qpk_r, mid_r, kept_r)
+        ], axis=1)  # [P, Q, span] int32
+
+    if n_blocks <= 256 and shift <= 22:
+        # Compact the relevant rows to a static n/8 prefix by
+        # PREFIX-SUM scatter: each relevant row's destination is its
+        # rank among relevant rows (cumsum), so two O(n) passes replace
+        # the former stable argsort's ~log^2 n bitonic stages (the
+        # walk's furthest-from-roofline op, r4 README). The
+        # destinations are unique and monotone — the scatter coalesces;
+        # irrelevant rows target index ``cap`` and drop out of bounds,
+        # as do relevant rows past the cap (data concentrated enough to
+        # overflow falls back to full-row scatters via lax.cond). The
+        # three row fields pack into one int32 (mid <= 8 bits by the
+        # n_blocks gate, lo_bits < span = 2^shift <= 2^22 by the shift
+        # gate, kept 1 bit), so compaction is exactly two int32
+        # scatters.
+        n_rows = leaf.shape[0]
+        cap = max(8192, n_rows // 8)
+        rel_any = jnp.zeros(n_rows, bool)
+        for ok in row_masks(qpk, mid, kept):
+            rel_any |= ok
+        n_rel = jnp.sum(rel_any.astype(jnp.int32))
+
+        def compacted(_):
+            # Built INSIDE the branch: cond operands are computed
+            # unconditionally, so hoisting these would make the
+            # overflow fallback pay for both paths.
+            dest = jnp.where(
+                rel_any,
+                jnp.cumsum(rel_any.astype(jnp.int32)) - 1,
+                cap)
+            packed_row = (
+                mid | (lo_bits << 8) |
+                (kept.astype(jnp.int32) << (8 + shift)))
+            qpk_c = jnp.zeros(cap, jnp.int32).at[dest].set(
+                qpk, mode="drop")
+            row_c = jnp.zeros(cap, jnp.int32).at[dest].set(
+                packed_row, mode="drop")
+            return subs_over(qpk_c, row_c & 0xFF,
+                             (row_c >> 8) & (span - 1),
+                             (row_c >> (8 + shift)).astype(bool))
+
+        def full(_):
+            return subs_over(qpk, mid, lo_bits, kept)
+
+        return jax.lax.cond(n_rel <= cap, compacted, full, None)
+    if n_blocks <= 256:
+        # Exotic tree shapes whose packed row would overflow int32: no
+        # compaction, full-row scatters.
+        return subs_over(qpk, mid, lo_bits, kept)
+    # Non-default tree shapes: block ids > 8 bits.
+    return _subtree_counts(qpk, leaf, kept, sub_start, P, span)
 
 
 def _mid_level_counts(mid, base, w, bucket_w, b):
@@ -1391,15 +1472,25 @@ def _walk_level(noise_kind, key, scale, raw, base, level_offset, lo, hi,
     return _walk_step(noisy, lo, hi, target, leaf_lo, done, b, w)
 
 
-def _subtree_counts(qpk, leaf, kept, sub_start, P, span):
+def _subtree_counts(qpk, leaf, kept, sub_start, P, span, p_offset=None):
     """Leaf counts of each quantile's chosen subtree from row data:
     [P, Q, span] int32 (one masked scatter per quantile). Shared by the
-    single-batch generic fallback and the streamed pass-B kernel."""
+    single-batch generic fallback and the streamed pass-B kernel. With
+    ``p_offset`` set, ``P`` is a partition BLOCK size and only rows of
+    partitions [p_offset, p_offset + P) count — the partition-block-
+    chunked walk's histogram, whose per-partition content is identical
+    to the full scatter's."""
+    if p_offset is not None:
+        rel_pk = qpk - p_offset
+        in_blk = kept & (rel_pk >= 0) & (rel_pk < P)
+        pk_b = jnp.clip(rel_pk, 0, P - 1)
+    else:
+        in_blk, pk_b = kept, qpk
     subs = []
     for q in range(sub_start.shape[1]):
-        rel = leaf - sub_start[:, q][qpk]
-        ok = kept & (rel >= 0) & (rel < span)
-        seg = qpk * span + jnp.clip(rel, 0, span - 1)
+        rel = leaf - sub_start[:, q][pk_b]
+        ok = in_blk & (rel >= 0) & (rel < span)
+        seg = pk_b * span + jnp.clip(rel, 0, span - 1)
         subs.append(jax.ops.segment_sum(ok.astype(jnp.int32), seg,
                                         num_segments=P * span
                                         ).reshape(P, span))
@@ -1885,6 +1976,19 @@ class LazyFusedResult:
                     stream_stats["pass_b_rounds"])
             t_rel = _time.perf_counter()
             part64 = {k: v[:P] for k, v in part64.items()}
+            if self._public is not None:
+                rel_sel = vocab_idx = np.arange(P)
+            else:
+                # Release ONLY the kept partitions, in ascending pk
+                # order — the same host-noise draw sequence as the
+                # single-batch compact fetch path, so a streamed run
+                # and a single-batch run with the same seed release
+                # bit-identical scalar values whenever their kept sets
+                # and accumulators agree.
+                kept_idx = np.flatnonzero(keep_np[:P])
+                part64 = {k: v[kept_idx] for k, v in part64.items()}
+                rel_sel = np.arange(len(kept_idx))
+                vocab_idx = kept_idx
             rng = (np.random.default_rng(self._rng_seed)
                    if self._rng_seed is not None else None)
             metric_arrays = _host_release(config, self._specs, part64,
@@ -1892,12 +1996,9 @@ class LazyFusedResult:
                                           rng)
             for qi, name in enumerate(
                     _percentile_field_names(config.percentiles)):
-                metric_arrays[name] = (
-                    stream_stats["percentile_values"][:P, qi])
-            if self._public is not None:
-                rel_sel = vocab_idx = np.arange(P)
-            else:
-                rel_sel = vocab_idx = np.flatnonzero(keep_np[:P])
+                vals_q = stream_stats["percentile_values"][:P, qi]
+                metric_arrays[name] = (vals_q if self._public is not None
+                                       else vals_q[vocab_idx])
             out = _assemble_output(config, encoded.pk_vocab,
                                    metric_arrays, rel_sel, vocab_idx)
             self.timings["host_decode_s"] = _time.perf_counter() - t_rel

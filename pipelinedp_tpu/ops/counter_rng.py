@@ -1,0 +1,107 @@
+"""Counter-based parallel noise generation for the quantile walk.
+
+The quantile-tree walk needs one noise draw per visited (partition,
+tree node), as a *pure function* of those indices — the stateless twin
+of the host tree's noisy-count memoization
+(``ops/quantile_tree.py::compute_quantiles``): every walk level that
+revisits a node must see the same draw, on any device layout.
+
+The original construction realized that purity with a nested
+``vmap(fold_in)`` — one full threefry key schedule per (partition,
+node) element, P·Q·b schedules per walk level, the walk's dominant
+per-level cost off the histogram scatters. Counter-based parallel RNG
+(Salmon et al., "Parallel Random Numbers: As Easy as 1, 2, 3", SC'11 —
+the threefry/philox family JAX itself builds on) collapses that to ONE
+batched block-cipher pass: the (partition, node) pair IS the counter,
+fed as the two 32-bit input lanes of a single Threefry-2x32 evaluation
+over the whole [P, Q, b] index array, followed by one vectorized
+inverse-CDF transform. Purity is inherited from the cipher being a
+deterministic function of (key, counter), so deduplication (the
+root-level broadcast in ``jax_engine._walk_level``) and partition-block
+chunking are bit-exact restructurings by construction.
+
+This module is the ONE blessed per-element keyed generator: the lint in
+``make nofoldin`` (mirrored in ``tests/test_walk.py``) bans new
+``vmap(...fold_in...)`` per-element key constructions everywhere else.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# Threefry-2x32 rotation schedule (Salmon et al., table 2) — identical
+# to the one inside jax.random's own generator.
+_ROTATIONS = ((13, 15, 26, 6), (17, 29, 16, 24))
+_PARITY = np.uint32(0x1BD11BDA)
+
+
+def threefry2x32(k0, k1, x0, x1):
+    """One Threefry-2x32 block per element (20 rounds): returns two
+    uint32 streams, each a pure function of ``(k0, k1, x0, x1)`` at its
+    element. ``x0``/``x1`` are the caller-chosen counter lanes — unlike
+    ``jax.random.bits`` (whose counter is the output *position*), the
+    draw here is keyed by counter *content*, which is what makes noise
+    a pure function of (partition, node id) regardless of where in the
+    batch the pair appears. Verified against JAX's internal
+    ``threefry_2x32`` in ``tests/test_walk.py``."""
+    def rotl(x, r):
+        return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
+
+    x0 = x0.astype(jnp.uint32)
+    x1 = x1.astype(jnp.uint32)
+    k0 = k0.astype(jnp.uint32)
+    k1 = k1.astype(jnp.uint32)
+    ks = (k0, k1, k0 ^ k1 ^ _PARITY)
+    x0 = x0 + ks[0]
+    x1 = x1 + ks[1]
+    for d in range(5):
+        for r in _ROTATIONS[d % 2]:
+            x0 = x0 + x1
+            x1 = rotl(x1, r)
+            x1 = x1 ^ x0
+        x0 = x0 + ks[(d + 1) % 3]
+        x1 = x1 + ks[(d + 2) % 3] + np.uint32(d + 1)
+    return x0, x1
+
+
+def _key_lanes(key):
+    """The two uint32 key words of a JAX PRNG key (typed or raw)."""
+    if jnp.issubdtype(jnp.asarray(key).dtype, jax.dtypes.prng_key):
+        key = jax.random.key_data(key)
+    key = jnp.asarray(key)
+    return key[0], key[1]
+
+
+def _uniform_open01(bits):
+    """float32 uniform on the OPEN interval (0, 1) from 32 random bits:
+    the top 24 bits (f32 resolution) on a half-step-offset grid, so
+    neither endpoint is reachable and downstream log/erfinv transforms
+    never see 0 or 1."""
+    return ((bits >> np.uint32(8)).astype(jnp.float32) *
+            np.float32(2.0**-24) + np.float32(2.0**-25))
+
+
+def laplace(key, x0, x1):
+    """Unit-scale Laplace noise keyed by counter content: one batched
+    threefry pass over ``(x0, x1)`` + the inverse CDF. Same f32 tail
+    truncation (~16.6 scale units, from the 24-bit uniform grid) as
+    ``jax.random.laplace``. Shapes of ``x0``/``x1`` must match."""
+    k0, k1 = _key_lanes(key)
+    bits, _ = threefry2x32(k0, k1, x0, x1)
+    c = _uniform_open01(bits) - np.float32(0.5)
+    # The offset grid never lands on exactly 0.5, so sign(c) != 0.
+    return -jnp.sign(c) * jnp.log1p(-2.0 * jnp.abs(c))
+
+
+def normal(key, x0, x1):
+    """Unit-variance Gaussian noise keyed by counter content, via the
+    same inverse-CDF construction ``jax.random.normal`` uses
+    (sqrt(2) * erfinv of an open-interval uniform, ~±5.6 sigma f32
+    truncation)."""
+    k0, k1 = _key_lanes(key)
+    bits, _ = threefry2x32(k0, k1, x0, x1)
+    u = _uniform_open01(bits) * np.float32(2.0) - np.float32(1.0)
+    return np.float32(np.sqrt(2.0)) * jax.scipy.special.erfinv(u)

@@ -171,8 +171,16 @@ class QuantileTree:
             noise_fn = lambda: rng.normal(0.0, sigma)
 
         b = self.branching_factor
-        # Memoized noisy counts so each node is noised at most once even
-        # when several quantile walks visit it.
+        # THE MEMOIZATION CONTRACT: each (level, node) is noised at most
+        # once, and every quantile walk that revisits it sees the SAME
+        # noisy count. This is what bounds the per-level sensitivity at
+        # linf node counts per partition (the calibration above) no
+        # matter how many quantiles are requested. The fused TPU walk
+        # honors the identical contract statelessly: node noise there is
+        # a pure counter-based function of (partition, node id)
+        # (``ops/counter_rng.py``, via ``jax_engine._node_noise``), so
+        # revisits — including across quantile groups and partition
+        # blocks of a chunked walk — reproduce the draw with no cache.
         noisy_cache: Dict[tuple, float] = {}
 
         def noisy_count(level: int, idx: int) -> float:

