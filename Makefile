@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast bench smoke multichip lint dev clean faultcheck nosleep perfcheck nofoldin
+.PHONY: test test-fast bench smoke multichip lint dev clean faultcheck nosleep perfcheck nofoldin obscheck noperf
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -35,6 +35,29 @@ faultcheck: nosleep
 perfcheck: nosleep nofoldin
 	$(PYTHON) -m pytest tests/test_ingest.py tests/test_faults.py \
 	  tests/test_walk.py -q
+
+# Observability acceptance suite: tracer thread-safety under a live
+# overlapped-ingest run, no-op-mode zero emission, bench-field parity
+# (names/semantics unchanged, DP outputs bit-identical trace on/off),
+# Chrome-trace round-trip, run-report schema, resilience/fault event
+# coverage — plus the no-raw-perf-counter lint below.
+obscheck: noperf
+	$(PYTHON) -m pytest tests/test_obs.py -q
+
+# Lint-style check: no bare time.perf_counter() phase timing outside
+# pipelinedp_tpu/obs/ — every measured phase must flow through obs
+# spans so it lands in the run ledger and the bench timing fields stay
+# derived views over spans (bench.py's helpers route through
+# obs.run_tracer; tests/test_obs.py enforces the same rule in-tree).
+noperf:
+	@bad=$$(grep -rn "perf_counter *(" --include='*.py' pipelinedp_tpu bench.py \
+	  | grep -v "pipelinedp_tpu/obs/" || true); \
+	if [ -n "$$bad" ]; then \
+	  echo "$$bad"; \
+	  echo "ERROR: raw perf_counter timing — use pipelinedp_tpu.obs spans"; \
+	  exit 1; \
+	fi; \
+	echo "noperf: OK"
 
 # Lint-style check: no per-element vmap(fold_in) key constructions —
 # they rebuild a full threefry key schedule per element, the cost the

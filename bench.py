@@ -27,13 +27,48 @@ for humans.
 import argparse
 import json
 import sys
-import time
 
 import numpy as np
 
 
 def log(s):
     print(s, file=sys.stderr, flush=True)
+
+
+_TRACER = None
+
+
+def tracer():
+    """The bench's span tracer (lazy: constructed after the platform/
+    degradation env is settled). Always measures — every timing number
+    in a bench record is a span duration — and records full spans into
+    the obs ledger when PIPELINEDP_TPU_TRACE is set."""
+    global _TRACER
+    if _TRACER is None:
+        from pipelinedp_tpu import obs
+        _TRACER = obs.run_tracer()
+    return _TRACER
+
+
+_ENV_FP = None
+
+
+def env_fingerprint():
+    """Environment fingerprint attached to EVERY bench record (traced
+    or not): jax/jaxlib versions, device kind/count, git SHA, active
+    PIPELINEDP_TPU_* flags, degraded flag — so a BENCH_r*.json is
+    attributable without session notes. Cached: one probe per run."""
+    global _ENV_FP
+    if _ENV_FP is None:
+        from pipelinedp_tpu import obs
+        _ENV_FP = obs.environment_fingerprint()
+    return _ENV_FP
+
+
+def emit(rec):
+    """Log one record (with the env fingerprint merged) as JSON."""
+    rec["env"] = env_fingerprint()
+    log(json.dumps(rec))
 
 
 def zipf_dataset(n_rows, n_users, n_partitions, seed=0, value_hi=10.0):
@@ -62,10 +97,10 @@ def run_once(backend, dataset, params, eps=1.0, delta=1e-6):
     engine = pdp.DPEngine(acc, backend)
     result = engine.aggregate(dataset, params, pdp.DataExtractors())
     acc.compute_budgets()
-    t0 = time.perf_counter()
-    out = list(result)
-    dt = time.perf_counter() - t0
-    return len(out), dt, getattr(result, "timings", None)
+    with tracer().span("bench.aggregate", cat="bench",
+                       backend=type(backend).__name__) as sp:
+        out = list(result)
+    return len(out), sp.duration, getattr(result, "timings", None)
 
 
 def bench_config(name, params, fused_ds, local_rows, repeats=5,
@@ -157,7 +192,7 @@ def bench_config(name, params, fused_ds, local_rows, repeats=5,
                  f"local baseline reused ({local_rps:.0f} rows/s)")
     log(f"## {name}: {local_txt}; fused {n_rows} rows -> "
         f"{n_fused} parts in {fused_dt:.2f}s ({fused_rps:.0f} rows/s)")
-    log(json.dumps(rec))
+    emit(rec)
     rec["_local_baseline"] = (local_scaling, local_dt)  # for re-samples
     return rec
 
@@ -199,11 +234,12 @@ def bench_analysis_sweep(n_rows, n_users, n_partitions, n_configs):
     extractors = pdp.DataExtractors()
 
     def run(backend, data, options):
-        t0 = time.perf_counter()
-        res = analysis.perform_utility_analysis(data, backend, options,
-                                                extractors)
-        n = len(list(res))
-        return n, time.perf_counter() - t0
+        with tracer().span("bench.sweep_run", cat="bench",
+                           backend=type(backend).__name__) as sp:
+            res = analysis.perform_utility_analysis(data, backend,
+                                                    options, extractors)
+            n = len(list(res))
+        return n, sp.duration
 
     # The pure-Python baseline is far too slow for the full sweep: measure
     # its unit rate (configs x rows per second) on a small slice and scale.
@@ -252,7 +288,7 @@ def bench_analysis_sweep(n_rows, n_users, n_partitions, n_configs):
     log(f"## analysis sweep: {n_eff} configs x {n_rows} rows in "
         f"{fused_dt:.2f}s; host baseline {host_unit_rate:.0f} config*rows/s "
         f"(measured on {base_cfg} cfg x {base_rows} rows)")
-    log(json.dumps(rec))
+    emit(rec)
     return rec
 
 
@@ -302,10 +338,10 @@ def bench_streaming(n_rows):
         os.environ[streaming_mod._CHUNK_ENV] = str(max(n_rows // 4, 1000))
         did_set = True
     try:
-        t0 = time.perf_counter()
-        n_parts, dt, timings = run_once(JaxBackend(rng_seed=0), ds,
-                                        params)
-        total = time.perf_counter() - t0
+        with tracer().span("bench.streaming_run", cat="bench") as sp:
+            n_parts, dt, timings = run_once(JaxBackend(rng_seed=0), ds,
+                                            params)
+        total = sp.duration
     finally:
         if did_set:
             if prev is None:
@@ -349,7 +385,7 @@ def bench_streaming(n_rows):
         f"compile + host link); pass-A overlap {rec['overlap_frac']:.0%} "
         f"(stage {rec['t_stage']} + fold {rec['t_fold']} + device "
         f"{rec['t_device']} vs wall {rec['t_total']}, {rec['executor']})")
-    log(json.dumps(rec))
+    emit(rec)
     return rec
 
 
@@ -389,14 +425,15 @@ def roofline_probe(ds):
         return jax.ops.segment_sum(jnp.ones_like(pk), pk,
                                    num_segments=65536)[0]
 
-    def timed(fn, *args):
+    def timed(fn, *args, label="op"):
         best = 1e9
         for _ in range(3):
-            t0 = time.perf_counter()
-            # np.asarray forces execution + flush (block_until_ready does
-            # not flush on the tunneled platform).
-            np.asarray(fn(*args))
-            best = min(best, time.perf_counter() - t0)
+            # np.asarray forces execution + flush (block_until_ready
+            # does not flush on the tunneled platform).
+            with tracer().span(f"roofline.{label}",
+                               cat="roofline") as sp:
+                np.asarray(fn(*args))
+            best = min(best, sp.duration)
         return best
 
     # Quantile-walk pieces at bench shape: the per-quantile relevance
@@ -441,10 +478,11 @@ def roofline_probe(ds):
     segsum_only(pk)
     walk_flags_and_sort(qpk, leaf, blk)
     top_hist(qpk, leaf)
-    sort_s = timed(sort_only, pid, pk, key)
-    seg_s = timed(segsum_only, pk)
-    walk_s = timed(walk_flags_and_sort, qpk, leaf, blk)
-    hist_s = timed(top_hist, qpk, leaf)
+    sort_s = timed(sort_only, pid, pk, key, label="sort")
+    seg_s = timed(segsum_only, pk, label="segment_sum")
+    walk_s = timed(walk_flags_and_sort, qpk, leaf, blk,
+                   label="walk_flags")
+    hist_s = timed(top_hist, qpk, leaf, label="top_hist")
     stages = math.log2(n) * (math.log2(n) + 1) / 2
     sort_bytes = stages * n * 16 * 2
     hbm_peak = 810e9
@@ -475,7 +513,7 @@ def roofline_probe(ds):
         f"({rec['walk_flag_sort_hbm_frac']:.0%} of peak), walk top-hist "
         f"scatter {hist_s:.3f}s "
         f"({rec['walk_hist_scatter_hbm_frac']:.0%} of peak)")
-    log(json.dumps(rec))
+    emit(rec)
     return rec
 
 
@@ -556,18 +594,21 @@ def walk_breakdown_probe(n_partitions, n_rows, n_quantiles=3):
         return je._percentile_values(config, P, (qpk, leaf, kept),
                                      scale, key)[0, 0]
 
-    def timed(fn, *args):
+    def timed(fn, *args, label="phase"):
         np.asarray(fn(*args))  # compile warm-up
         best = 1e9
         for _ in range(3):
-            t0 = time.perf_counter()
-            np.asarray(fn(*args))
-            best = min(best, time.perf_counter() - t0)
+            # The acceptance spans for the three walk phases: each
+            # repetition is one "walk.<phase>" span in the ledger.
+            with tracer().span(f"walk.{label}", cat="walk") as sp:
+                np.asarray(fn(*args))
+            best = min(best, sp.duration)
         return best
 
-    t_noise = timed(noise_only, key)
-    t_hist = timed(hist_only, qpk, leaf, kept)
-    t_total = timed(walk_full, qpk, leaf, kept, scale, key)
+    t_noise = timed(noise_only, key, label="noise")
+    t_hist = timed(hist_only, qpk, leaf, kept, label="hist")
+    t_total = timed(walk_full, qpk, leaf, kept, scale, key,
+                    label="walk")
     rec = {
         "metric": "quantile_walk_breakdown",
         "partitions": P,
@@ -581,7 +622,7 @@ def walk_breakdown_probe(n_partitions, n_rows, n_quantiles=3):
     log(f"## quantile walk breakdown [{P} parts, {n} rows, {Q} q]: "
         f"noise {t_noise:.3f}s + hist {t_hist:.3f}s + walk "
         f"{rec['t_walk']:.3f}s (total {t_total:.3f}s)")
-    log(json.dumps(rec))
+    emit(rec)
     return rec
 
 
@@ -758,11 +799,31 @@ def main():
 
     # The driver's contract: exactly one JSON line on stdout. A degraded
     # (CPU-fallback) run says so — its numbers measure the fallback, not
-    # the accelerator.
+    # the accelerator. The env fingerprint rides on every record; with
+    # PIPELINEDP_TPU_TRACE set the headline additionally carries the
+    # schema-versioned run report (spans + counters + events) and a
+    # Chrome-trace file lands next to it for Perfetto.
+    from pipelinedp_tpu import obs
     headline = {k: flagship[k] for k in
                 ("metric", "value", "unit", "vs_baseline",
                  "host_s", "device_s") if k in flagship}
     headline["degraded"] = bool(health_report.degraded)
+    headline["env"] = env_fingerprint()
+    if obs.trace_enabled():
+        # ONE ledger snapshot feeds both exporters, so the trace file
+        # and the report agree span-for-span; the cached fingerprint
+        # skips a second device/git probe.
+        snap = obs.ledger().snapshot()
+        trace_path = obs.write_chrome_trace(snapshot=snap)
+        report = obs.build_run_report(env=env_fingerprint(),
+                                      snapshot=snap)
+        with open(trace_path + ".report.json", "w",
+                  encoding="utf-8") as f:
+            json.dump(report, f, indent=1)
+        headline["run_report"] = report
+        log(f"## chrome trace: {trace_path} (open at "
+            f"https://ui.perfetto.dev); run report: "
+            f"{trace_path}.report.json")
     print(json.dumps(headline))
 
 

@@ -1046,6 +1046,7 @@ class LazySweepResult:
         # dwarf the aggregate state; they fall back to a full rerun).
         import os as _os
 
+        from pipelinedp_tpu import obs
         from pipelinedp_tpu.resilience import checkpoint as ckpt_mod
         from pipelinedp_tpu.resilience import faults
         ckpt_store = (ckpt_mod.as_store(self._checkpoint)
@@ -1102,22 +1103,30 @@ class LazySweepResult:
             # twin): tests sever the sweep at chunk ci and assert the
             # resumed grid is bit-identical.
             faults.check_chunk(ci)
-            if self._mesh is not None and n_dev > 1:
-                out, sel, pp = _sweep_chunk_sharded(
-                    metric_names, strategy, noise_kind, P_pad, public,
-                    chunk, self._mesh, np.int32(start), marker, pk_safe,
-                    count_u, sum_u, npart_u, users_in, *cfg, dlog_rs,
-                    dt_table, per_partition=per_partition)
-                if per_partition:
-                    pp_chunks.append(pp)
-            else:
-                out, sel = _sweep_chunk_kernel(
-                    metric_names, strategy, noise_kind, P_pad, public,
-                    chunk, np.int32(start), marker, pk_safe, count_u,
-                    sum_u, npart_u, users_in, *cfg, dlog_rs, dt_table,
-                    per_partition=per_partition)
-                if per_partition:
-                    pp_chunks.append(_split_pp(out, metric_names))
+            # Ledger span per sweep chunk (a no-op unless
+            # PIPELINEDP_TPU_TRACE is set); dispatch is async, so an
+            # untraced chunk costs nothing and a traced one shows where
+            # the checkpoint fetches serialize the grid.
+            with obs.span("sweep.chunk", cat="sweep", chunk=ci,
+                          start=int(start)):
+                if self._mesh is not None and n_dev > 1:
+                    out, sel, pp = _sweep_chunk_sharded(
+                        metric_names, strategy, noise_kind, P_pad,
+                        public, chunk, self._mesh, np.int32(start),
+                        marker, pk_safe, count_u, sum_u, npart_u,
+                        users_in, *cfg, dlog_rs, dt_table,
+                        per_partition=per_partition)
+                    if per_partition:
+                        pp_chunks.append(pp)
+                else:
+                    out, sel = _sweep_chunk_kernel(
+                        metric_names, strategy, noise_kind, P_pad,
+                        public, chunk, np.int32(start), marker, pk_safe,
+                        count_u, sum_u, npart_u, users_in, *cfg,
+                        dlog_rs, dt_table,
+                        per_partition=per_partition)
+                    if per_partition:
+                        pp_chunks.append(_split_pp(out, metric_names))
             if ckpt_store is not None:
                 # Checkpointing fetches per chunk (the price of
                 # resumability); the monoid append keeps the prefix

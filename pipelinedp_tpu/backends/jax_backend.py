@@ -88,3 +88,12 @@ class JaxBackend(LocalBackend):
                 # A wedged-device mesh is unusable; the CPU fallback
                 # runs single-device. NEVER silent: ``degraded`` says so.
                 self.mesh = None
+        from pipelinedp_tpu import obs
+        # seed_fixed, never the seed itself: run reports are meant to
+        # be shared, and noise draws are pure functions of the seed —
+        # publishing it would let a report holder subtract the noise.
+        obs.event("backend.created", degraded=self.degraded,
+                  mesh_devices=(int(self.mesh.devices.size)
+                                if self.mesh is not None else 0),
+                  seed_fixed=rng_seed is not None,
+                  checkpoint=bool(checkpoint))

@@ -63,6 +63,18 @@ def maybe_enable_compile_cache() -> Optional[str]:
         except Exception:
             pass
         _configured = path
+        # Entry count at enable time is the observable hit evidence:
+        # a warm dir means later compilations load instead of build
+        # (XLA exposes no per-program hit counter to count directly).
+        try:
+            with os.scandir(path) as it:
+                entries = sum(1 for _ in it)
+        except OSError:
+            entries = -1
+        from pipelinedp_tpu import obs
+        obs.inc("compile_cache.enabled")
+        obs.inc("compile_cache.warm_entries", max(entries, 0))
+        obs.event("compile_cache.enabled", dir=path, entries=entries)
     except Exception:
         # Never let an unwritable cache dir or an old jax break the
         # aggregation itself.

@@ -94,12 +94,18 @@ class _CaptureThread(threading.Thread):
         self.exc: Optional[BaseException] = None
 
     def run(self):
+        from pipelinedp_tpu import obs
+        obs.inc("ingest.worker_threads_started")
         try:
             self._body()
         except IngestCancelled:
             pass
         except BaseException as e:  # re-raised by the owner, not lost
             self.exc = e
+            # The error surfaces on the dispatch thread later; the
+            # event records WHERE it actually happened.
+            obs.event("ingest.worker_error", thread=self.name,
+                      error=repr(e))
 
 
 class BackgroundStager:

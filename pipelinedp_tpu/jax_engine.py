@@ -1254,7 +1254,16 @@ def _percentile_values(config: FusedConfig, P, qrows, scale, key):
         # (VERDICT r2 #9).
         w1 = b**(height - 1 - n_top)
         span = w1 * b
+        # Which bottom-walk path fires is a STATIC (shape-driven)
+        # decision made here, at jit-trace time — so the ledger records
+        # it once per compiled shape (scope="compile"), exactly when
+        # the choice happens; cached executions re-use the program and
+        # the recorded choice with it.
+        from pipelinedp_tpu import obs
         if P * Q * span * 4 <= _SUBHIST_BYTE_CAP:
+            obs.inc("walk.path_subhist")
+            obs.event("walk.path", path="subhist", scope="compile",
+                      P=int(P), Q=int(Q), span=int(span))
             sub_start = leaf_lo  # [P, Q] first leaf of each subtree
             sub_hist = _build_sub_hist(qpk, leaf, kept, sub_start, P, Q,
                                        span, b, height)
@@ -1271,6 +1280,9 @@ def _percentile_values(config: FusedConfig, P, qrows, scale, key):
                 blk = min(P, 1 << ((_SUBHIST_BYTE_CAP //
                                     (Q * span * 4)).bit_length() - 1))
             if blk and -(-P // blk) <= _MAX_WALK_BLOCKS:
+                obs.inc("walk.path_partition_block_chunked")
+                obs.event("walk.path", path="partition_block_chunked",
+                          scope="compile", blk=int(blk), P=int(P))
                 # The full [P, Q, span] block would blow the HBM cap:
                 # chunk the partition axis into blocks and walk
                 # block-by-block (the streamed pass B's q-chunk loop
@@ -1311,6 +1323,9 @@ def _percentile_values(config: FusedConfig, P, qrows, scale, key):
             # [1, Q, span] block — necessarily test-shrunken):
             # per-level per-quantile row scatters, the rows being
             # device-resident here.
+            obs.inc("walk.path_per_level_scatter")
+            obs.event("walk.path", path="per_level_scatter",
+                      scope="compile", P=int(P), Q=int(Q))
             for level in range(n_top, height):
                 w = b**(height - 1 - level)
                 base = leaf_lo // w
@@ -1911,17 +1926,20 @@ class LazyFusedResult:
         yield from self._cache
 
     def _execute(self):
-        import time as _time
+        from pipelinedp_tpu import obs
 
         config = self._config
         params = self._params
-        t0 = _time.perf_counter()
-        encoded = encode(self._rows, self._extractors, config.vector_size,
-                         self._public,
-                         require_pid=not config.bounds_already_enforced)
-        t_encode = _time.perf_counter() - t0
-        self.timings = {"host_encode_s": t_encode, "device_s": 0.0,
-                        "host_decode_s": 0.0}
+        # Span-derived timing: the host_encode_s / device_s /
+        # host_decode_s fields keep their names and semantics; they are
+        # now views over the run tracer's "engine.*" span totals.
+        tr = obs.run_tracer()
+        with tr.span("engine.encode", cat="engine"):
+            encoded = encode(self._rows, self._extractors,
+                             config.vector_size, self._public,
+                             require_pid=not config.bounds_already_enforced)
+        self.timings = {"host_encode_s": tr.total("engine.encode"),
+                        "device_s": 0.0, "host_decode_s": 0.0}
         P = len(encoded.pk_vocab)
         if P == 0:
             return []
@@ -1941,19 +1959,21 @@ class LazyFusedResult:
             keep_table, thr, s_scale, min_count = selection_inputs(
                 config, 1.0, 1e-9, None)
 
-        t1 = _time.perf_counter()
         from pipelinedp_tpu import streaming
         if streaming.should_stream(config, encoded.n_rows, self._mesh):
             # Multi-batch ingest: the dataset exceeds one device batch.
             # Partials accumulate on host (int64 / folded float64),
             # selection runs once on device, release below as usual.
-            keep_np, part64, stream_stats = (
-                streaming.stream_partials_and_select(
-                    config, encoded, scales, keep_table, thr, s_scale,
-                    min_count, rows_per_uid, self._rng_seed,
-                    mesh=self._mesh, checkpoint=self._checkpoint,
-                    executor=self._ingest_executor))
-            self.timings["device_s"] = _time.perf_counter() - t1
+            with tr.span("engine.device", cat="engine",
+                         path="streamed"):
+                keep_np, part64, stream_stats = (
+                    streaming.stream_partials_and_select(
+                        config, encoded, scales, keep_table, thr,
+                        s_scale, min_count, rows_per_uid,
+                        self._rng_seed, mesh=self._mesh,
+                        checkpoint=self._checkpoint,
+                        executor=self._ingest_executor))
+            self.timings["device_s"] = tr.total("engine.device")
             self.timings["stream_batches"] = stream_stats["n_batches"]
             if "resumed_from_batch" in stream_stats:
                 self.timings["stream_resumed_from"] = (
@@ -1974,129 +1994,138 @@ class LazyFusedResult:
                 self.timings["stream_pass_b"] = stream_stats["pass_b_source"]
                 self.timings["stream_pass_b_rounds"] = (
                     stream_stats["pass_b_rounds"])
-            t_rel = _time.perf_counter()
-            part64 = {k: v[:P] for k, v in part64.items()}
-            if self._public is not None:
-                rel_sel = vocab_idx = np.arange(P)
-            else:
-                # Release ONLY the kept partitions, in ascending pk
-                # order — the same host-noise draw sequence as the
-                # single-batch compact fetch path, so a streamed run
-                # and a single-batch run with the same seed release
-                # bit-identical scalar values whenever their kept sets
-                # and accumulators agree.
-                kept_idx = np.flatnonzero(keep_np[:P])
-                part64 = {k: v[kept_idx] for k, v in part64.items()}
-                rel_sel = np.arange(len(kept_idx))
-                vocab_idx = kept_idx
-            rng = (np.random.default_rng(self._rng_seed)
-                   if self._rng_seed is not None else None)
-            metric_arrays = _host_release(config, self._specs, part64,
-                                          part64["privacy_id_count_raw"],
-                                          rng)
-            for qi, name in enumerate(
-                    _percentile_field_names(config.percentiles)):
-                vals_q = stream_stats["percentile_values"][:P, qi]
-                metric_arrays[name] = (vals_q if self._public is not None
-                                       else vals_q[vocab_idx])
-            out = _assemble_output(config, encoded.pk_vocab,
-                                   metric_arrays, rel_sel, vocab_idx)
-            self.timings["host_decode_s"] = _time.perf_counter() - t_rel
+            with tr.span("engine.release", cat="engine"):
+                part64 = {k: v[:P] for k, v in part64.items()}
+                if self._public is not None:
+                    rel_sel = vocab_idx = np.arange(P)
+                else:
+                    # Release ONLY the kept partitions, in ascending pk
+                    # order — the same host-noise draw sequence as the
+                    # single-batch compact fetch path, so a streamed
+                    # run and a single-batch run with the same seed
+                    # release bit-identical scalar values whenever
+                    # their kept sets and accumulators agree.
+                    kept_idx = np.flatnonzero(keep_np[:P])
+                    part64 = {k: v[kept_idx]
+                              for k, v in part64.items()}
+                    rel_sel = np.arange(len(kept_idx))
+                    vocab_idx = kept_idx
+                rng = (np.random.default_rng(self._rng_seed)
+                       if self._rng_seed is not None else None)
+                metric_arrays = _host_release(
+                    config, self._specs, part64,
+                    part64["privacy_id_count_raw"], rng)
+                for qi, name in enumerate(
+                        _percentile_field_names(config.percentiles)):
+                    vals_q = stream_stats["percentile_values"][:P, qi]
+                    metric_arrays[name] = (
+                        vals_q if self._public is not None
+                        else vals_q[vocab_idx])
+                out = _assemble_output(config, encoded.pk_vocab,
+                                       metric_arrays, rel_sel,
+                                       vocab_idx)
+            self.timings["host_decode_s"] = tr.total("engine.release")
             return out
 
-        keep_pk, raw, fx_bits = _run_fused_kernel(
-            config, encoded, scales, keep_table, thr, s_scale, min_count,
-            rows_per_uid, self._rng_seed, self._mesh)
+        with tr.span("engine.device", cat="engine", path="single_batch"):
+            keep_pk, raw, fx_bits = _run_fused_kernel(
+                config, encoded, scales, keep_table, thr, s_scale,
+                min_count, rows_per_uid, self._rng_seed, self._mesh)
 
-        # Fetching the outputs forces device execution; the fetch is
-        # attributed to device_s, the float64 release + row assembly to
-        # decode_s. All rank-1 outputs ride ONE stacked transfer — the
-        # tunneled host<->device link pays per round trip, not per byte
-        # here. The stack is int32 with float columns BITCAST into it:
-        # integer lanes move bit-exactly, whereas small ints bitcast to
-        # float32 become subnormals that TPUs flush to zero (and a
-        # float32 CAST would corrupt counts above 2^24).
-        flat = sorted(k for k, v in raw.items() if v.ndim == 1)
-        cols = []
-        for name in flat:
-            arr = raw[name]
-            cols.append(arr if arr.dtype == jnp.int32 else
-                        jax.lax.bitcast_convert_type(
-                            arr.astype(jnp.float32), jnp.int32))
-        # With private selection most partitions are usually dropped:
-        # compact ON DEVICE and fetch kept count + kept indices + kept
-        # columns as ONE packed block — a single transfer over the
-        # high-latency link instead of a full [K, P] fetch plus extra
-        # round trips. Partitions kept beyond the static cap (rare: a
-        # huge keyspace where selection keeps >8192 keys) fall back to
-        # the full fetch.
-        compact = self._public is None
-        if compact:
-            cap = min(P, _COMPACT_FETCH_CAP)
-            packed = np.asarray(_compact_fetch_kernel(
-                keep_pk, tuple(cols), P, cap))
-            n_keep = int(packed[0, 0])
-            if n_keep > cap:  # fallback: fetch everything
-                stacked = np.asarray(
-                    jnp.stack([keep_pk.astype(jnp.int32)] + cols))[:, :P]
-                kept_idx = np.flatnonzero(stacked[0] > 0)
-                n_rel = P
-                compact = False
-            else:
-                stacked = packed[1:, :n_keep]
-                kept_idx = stacked[0]
-                n_rel = n_keep  # release only kept rows
-                kept_order = jnp.asarray(kept_idx)  # for rank-2 gathers
-        else:
-            stacked = np.asarray(jnp.stack([keep_pk.astype(jnp.int32)] +
-                                           cols))[:, :P]
-            kept_idx = np.flatnonzero(stacked[0] > 0)
-            n_rel = P  # release all rows, select kept at the end
-        fetched = {}
-        for i, name in enumerate(flat):
-            col = stacked[1 + i]
-            fetched[name] = (col if raw[name].dtype == jnp.int32 else
-                             col.view(np.float32))
-        for name, arr in raw.items():  # rank-2 (vector) outputs
-            if arr.ndim != 1:
-                if compact:
-                    fetched[name] = np.asarray(arr[kept_order])
+            # Fetching the outputs forces device execution; the fetch
+            # is attributed to device_s, the float64 release + row
+            # assembly to decode_s. All rank-1 outputs ride ONE stacked
+            # transfer — the tunneled host<->device link pays per round
+            # trip, not per byte here. The stack is int32 with float
+            # columns BITCAST into it: integer lanes move bit-exactly,
+            # whereas small ints bitcast to float32 become subnormals
+            # that TPUs flush to zero (and a float32 CAST would corrupt
+            # counts above 2^24).
+            flat = sorted(k for k, v in raw.items() if v.ndim == 1)
+            cols = []
+            for name in flat:
+                arr = raw[name]
+                cols.append(arr if arr.dtype == jnp.int32 else
+                            jax.lax.bitcast_convert_type(
+                                arr.astype(jnp.float32), jnp.int32))
+            # With private selection most partitions are usually
+            # dropped: compact ON DEVICE and fetch kept count + kept
+            # indices + kept columns as ONE packed block — a single
+            # transfer over the high-latency link instead of a full
+            # [K, P] fetch plus extra round trips. Partitions kept
+            # beyond the static cap (rare: a huge keyspace where
+            # selection keeps >8192 keys) fall back to the full fetch.
+            compact = self._public is None
+            if compact:
+                cap = min(P, _COMPACT_FETCH_CAP)
+                packed = np.asarray(_compact_fetch_kernel(
+                    keep_pk, tuple(cols), P, cap))
+                n_keep = int(packed[0, 0])
+                if n_keep > cap:  # fallback: fetch everything
+                    stacked = np.asarray(
+                        jnp.stack([keep_pk.astype(jnp.int32)] +
+                                  cols))[:, :P]
+                    kept_idx = np.flatnonzero(stacked[0] > 0)
+                    n_rel = P
+                    compact = False
                 else:
-                    fetched[name] = np.asarray(arr)[:P]
-        self.timings["device_s"] = _time.perf_counter() - t1
+                    stacked = packed[1:, :n_keep]
+                    kept_idx = stacked[0]
+                    n_rel = n_keep  # release only kept rows
+                    kept_order = jnp.asarray(kept_idx)  # rank-2 gathers
+            else:
+                stacked = np.asarray(
+                    jnp.stack([keep_pk.astype(jnp.int32)] +
+                              cols))[:, :P]
+                kept_idx = np.flatnonzero(stacked[0] > 0)
+                n_rel = P  # release all rows, select kept at the end
+            fetched = {}
+            for i, name in enumerate(flat):
+                col = stacked[1 + i]
+                fetched[name] = (col if raw[name].dtype == jnp.int32
+                                 else col.view(np.float32))
+            for name, arr in raw.items():  # rank-2 (vector) outputs
+                if arr.ndim != 1:
+                    if compact:
+                        fetched[name] = np.asarray(arr[kept_order])
+                    else:
+                        fetched[name] = np.asarray(arr)[:P]
+        self.timings["device_s"] = tr.total("engine.device")
 
         # The scalar DP release, in float64 via the shared mechanisms.
         # Integer columns stay integral: the hardened noise path
         # dispatches on dtype (discrete Laplace for counts — no float
         # noise bits), exactly like the generic combiners' int
         # accumulators.
-        t_rel = _time.perf_counter()
-        part64 = {
-            k: (v.astype(np.int64) if v.dtype.kind in "iu" else
-                v.astype(np.float64)) for k, v in fetched.items()
-        }
-        # Reassemble fixed-point value lanes into float64 columns.
-        _fold_fixedpoint(config, part64, fx_bits)
-        rng = (np.random.default_rng(self._rng_seed)
-               if self._rng_seed is not None else None)
-        metric_arrays = _host_release(config, self._specs, part64,
-                                      part64["privacy_id_count_raw"], rng)
-        for name in _percentile_field_names(config.percentiles):
-            metric_arrays[name] = fetched[name]
+        with tr.span("engine.release", cat="engine"):
+            part64 = {
+                k: (v.astype(np.int64) if v.dtype.kind in "iu" else
+                    v.astype(np.float64)) for k, v in fetched.items()
+            }
+            # Reassemble fixed-point value lanes into float64 columns.
+            _fold_fixedpoint(config, part64, fx_bits)
+            rng = (np.random.default_rng(self._rng_seed)
+                   if self._rng_seed is not None else None)
+            metric_arrays = _host_release(config, self._specs, part64,
+                                          part64["privacy_id_count_raw"],
+                                          rng)
+            for name in _percentile_field_names(config.percentiles):
+                metric_arrays[name] = fetched[name]
 
-        # Only materialize kept partitions (with private selection the kept
-        # fraction can be tiny — never walk the full pk axis in Python).
-        # In compact mode the released arrays already hold only kept rows.
-        if self._public is not None:
-            rel_sel = vocab_idx = np.arange(P)
-        elif compact:
-            rel_sel = np.arange(n_rel)
-            vocab_idx = kept_idx
-        else:
-            rel_sel = vocab_idx = kept_idx
-        out = _assemble_output(config, encoded.pk_vocab, metric_arrays,
-                               rel_sel, vocab_idx)
-        self.timings["host_decode_s"] = _time.perf_counter() - t_rel
+            # Only materialize kept partitions (with private selection
+            # the kept fraction can be tiny — never walk the full pk
+            # axis in Python). In compact mode the released arrays
+            # already hold only kept rows.
+            if self._public is not None:
+                rel_sel = vocab_idx = np.arange(P)
+            elif compact:
+                rel_sel = np.arange(n_rel)
+                vocab_idx = kept_idx
+            else:
+                rel_sel = vocab_idx = kept_idx
+            out = _assemble_output(config, encoded.pk_vocab,
+                                   metric_arrays, rel_sel, vocab_idx)
+        self.timings["host_decode_s"] = tr.total("engine.release")
         return out
 
 
@@ -2123,21 +2152,24 @@ def _run_fused_kernel(config: FusedConfig, encoded: EncodedData, scales,
         fx_bits, _ = _fx_plan(max(encoded.n_rows, 1))
     else:
         fx_bits = 12
+    from pipelinedp_tpu import obs
     if mesh is not None:
         from pipelinedp_tpu.parallel import sharded_fused_aggregate
-        keep_pk, raw = sharded_fused_aggregate(
-            mesh, config, P_pad, encoded.pid, encoded.pk,
-            encoded.values if config.needs_values else None,
-            np.ones(encoded.n_rows, bool), scales, keep_table, thr,
-            s_scale, min_count, rows_per_uid, key, fx_bits)
+        with obs.device_annotation("pdp.sharded_fused_aggregate"):
+            keep_pk, raw = sharded_fused_aggregate(
+                mesh, config, P_pad, encoded.pid, encoded.pk,
+                encoded.values if config.needs_values else None,
+                np.ones(encoded.n_rows, bool), scales, keep_table, thr,
+                s_scale, min_count, rows_per_uid, key, fx_bits)
         return keep_pk, raw, fx_bits
     pid, pk, values, valid = pad_and_put(encoded, config.vector_size,
                                          with_values=config.needs_values)
-    keep_pk, raw = fused_aggregate_kernel(
-        config, P_pad, pid, pk, values, valid, jnp.asarray(scales),
-        jnp.asarray(keep_table), jnp.float32(thr), jnp.float32(s_scale),
-        jnp.float32(min_count), jnp.float32(rows_per_uid), key,
-        fx_bits=fx_bits)
+    with obs.device_annotation("pdp.fused_aggregate"):
+        keep_pk, raw = fused_aggregate_kernel(
+            config, P_pad, pid, pk, values, valid, jnp.asarray(scales),
+            jnp.asarray(keep_table), jnp.float32(thr),
+            jnp.float32(s_scale), jnp.float32(min_count),
+            jnp.float32(rows_per_uid), key, fx_bits=fx_bits)
     return keep_pk, raw, fx_bits
 
 

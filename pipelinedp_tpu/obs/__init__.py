@@ -1,0 +1,134 @@
+"""Run-ledger observability layer: spans, counters, and the run report.
+
+Every performance claim in this repo must be **driver-witnessed**: a
+single ``python bench.py`` run has to record *what* executed (which code
+path, which environment, where the wall time went), not just how fast.
+This package is the one place that knowledge accumulates:
+
+* :func:`run_tracer` — per-run span tracer. ALWAYS measures (the bench
+  timing fields ``t_stage``/``t_fold``/``t_device``/``host_encode_s``/…
+  are derived views over its span totals — same names, same semantics
+  as the ad-hoc ``perf_counter`` plumbing it replaced); records full
+  spans into the ledger only when ``PIPELINEDP_TPU_TRACE`` is set.
+* :func:`tracer` — the global tracer for ledger-only call sites (sweep
+  chunks, checkpoint phases, walk rounds): a recording tracer when
+  tracing is on, the shared zero-overhead no-op otherwise.
+* :func:`inc` / :func:`event` — the counters/events registry. Always
+  on: retries, health degradations, checkpoint saves/resumes/refusals,
+  fault injections, cache hits, and which fallback path fired are rare
+  and load-bearing — invisible branches are how artifacts stop being
+  self-describing.
+* :func:`build_run_report` / :func:`write_chrome_trace` — exporters:
+  the schema-versioned run report (merged into bench records) and the
+  Perfetto-loadable Chrome-trace file.
+* :func:`device_annotation` — optional ``jax.profiler`` trace
+  annotation around device kernel dispatches, active only under
+  ``PIPELINEDP_TPU_TRACE``.
+
+Threading/cycles: this package imports only the stdlib at module level
+(``resilience`` and the engine import it lazily or downstream), and the
+ledger/tracers are lock-guarded so the ingest executor's stager and
+fold threads emit concurrently with the dispatch thread.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from pipelinedp_tpu.obs import report as _report
+from pipelinedp_tpu.obs.tracer import (ENV_VAR, MAX_EVENTS, MAX_SPANS,
+                                       NOOP_SPAN, NOOP_TRACER, NoopTracer,
+                                       RunLedger, Span, Tracer,
+                                       trace_destination, trace_enabled)
+from pipelinedp_tpu.obs.report import SCHEMA_VERSION, environment_fingerprint
+
+__all__ = [
+    "ENV_VAR", "SCHEMA_VERSION", "MAX_SPANS", "MAX_EVENTS",
+    "Span", "Tracer", "NoopTracer", "RunLedger",
+    "NOOP_SPAN", "NOOP_TRACER",
+    "trace_enabled", "trace_destination",
+    "ledger", "tracer", "run_tracer", "span", "inc", "event", "reset",
+    "environment_fingerprint", "build_run_report", "write_chrome_trace",
+    "device_annotation",
+]
+
+#: The process-global run ledger.
+_LEDGER = RunLedger()
+
+#: The one recording tracer behind :func:`tracer` (its totals are
+#: global and unread; sites that need per-run totals use run_tracer).
+_RECORDING = Tracer(ledger=_LEDGER)
+
+
+def ledger() -> RunLedger:
+    return _LEDGER
+
+
+def tracer() -> Any:
+    """Global tracer for ledger-only span sites: recording when
+    ``PIPELINEDP_TPU_TRACE`` is set, the shared no-op otherwise."""
+    return _RECORDING if trace_enabled() else NOOP_TRACER
+
+
+def run_tracer(clock=None) -> Tracer:
+    """Fresh always-measuring tracer for one run/section: per-name span
+    totals accumulate regardless of the trace flag (bench timing fields
+    read them), full spans reach the ledger only when tracing is on."""
+    return Tracer(clock=clock,
+                  ledger=_LEDGER if trace_enabled() else None)
+
+
+def span(name: str, cat: str = "run", **args):
+    """Convenience: a span on the global tracer (no-op when disabled)."""
+    return tracer().span(name, cat, **args)
+
+
+def inc(name: str, n: int = 1) -> None:
+    """Increment a ledger counter (always on)."""
+    _LEDGER.inc(name, n)
+
+
+def event(name: str, **attrs) -> None:
+    """Record a structured ledger event (always on)."""
+    _LEDGER.event(name, **attrs)
+
+
+def reset() -> None:
+    """Start a fresh ledger (tests; bench run boundaries)."""
+    _LEDGER.reset()
+
+
+def build_run_report(mesh=None, extra: Optional[Dict[str, Any]] = None,
+                     env: Optional[Dict[str, Any]] = None,
+                     snapshot: Optional[Dict[str, Any]] = None
+                     ) -> Dict[str, Any]:
+    """The schema-versioned self-describing run report (see
+    ``obs.report``) over the current ledger state. Pass the SAME
+    ``snapshot`` to this and :func:`write_chrome_trace` when the pair
+    must agree span-for-span (worker threads may still be emitting)."""
+    return _report.build_run_report(
+        snapshot if snapshot is not None else _LEDGER.snapshot(),
+        mesh=mesh, extra=extra, env=env)
+
+
+def write_chrome_trace(path: Optional[str] = None,
+                       snapshot: Optional[Dict[str, Any]] = None) -> str:
+    """Write the ledger's spans/events as a Chrome-trace JSON file
+    (Perfetto-loadable); returns the path written."""
+    return _report.write_chrome_trace(
+        path or trace_destination(),
+        snapshot if snapshot is not None else _LEDGER.snapshot())
+
+
+def device_annotation(name: str):
+    """``jax.profiler.TraceAnnotation`` around a kernel dispatch so
+    device profiles line up with host spans — active only under
+    ``PIPELINEDP_TPU_TRACE`` (and only when jax exposes the API);
+    otherwise the shared no-op context."""
+    if not trace_enabled():
+        return NOOP_SPAN
+    try:
+        from jax.profiler import TraceAnnotation
+        return TraceAnnotation(name)
+    except Exception:
+        return NOOP_SPAN

@@ -1,0 +1,172 @@
+"""Exporters: the self-describing run report and the Chrome-trace file.
+
+The run report is the driver-witnessed answer to "what actually
+executed": a schema-versioned JSON object carrying an environment
+fingerprint (jax/jaxlib versions, device kind and count, mesh shape,
+git SHA, every active ``PIPELINEDP_TPU_*`` flag, the ``degraded``
+flag), the counters and events the run emitted (retries, checkpoint
+saves/resumes, cache hits, which fallback path fired), and a per-name
+span summary. ``bench.py`` merges it into its output record so a
+``BENCH_r*.json`` artifact explains itself without session notes.
+
+The Chrome-trace export writes the full span list as trace-event JSON
+(``ph: "X"`` complete events, microsecond ``ts``/``dur``, one ``tid``
+lane per thread; ledger events ride along as ``ph: "i"`` instants) —
+load it at https://ui.perfetto.dev to see the stager / dispatch / fold
+lanes overlap batch by batch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+from typing import Any, Dict, List, Optional
+
+#: Version of the run-report layout. Bump on any breaking change to the
+#: top-level keys; readers refuse (or warn on) unknown majors.
+SCHEMA_VERSION = 1
+
+_git_sha_cache: Optional[str] = None
+
+
+def _git_sha() -> Optional[str]:
+    """Best-effort git SHA of the source tree this process imported
+    (cached; None outside a work tree or without git)."""
+    global _git_sha_cache
+    if _git_sha_cache is None:
+        try:
+            here = os.path.dirname(os.path.abspath(__file__))
+            out = subprocess.run(
+                ["git", "rev-parse", "HEAD"], cwd=here, timeout=10,
+                capture_output=True, text=True)
+            _git_sha_cache = (out.stdout.strip()
+                              if out.returncode == 0 else "")
+        except Exception:
+            _git_sha_cache = ""
+    return _git_sha_cache or None
+
+
+def environment_fingerprint(mesh=None) -> Dict[str, Any]:
+    """What this process is running on — attached to every bench record
+    (traced or not) so the artifact is attributable: jax/jaxlib
+    versions, device kind/count/platform, optional mesh shape, git SHA,
+    the active ``PIPELINEDP_TPU_*`` env flags and the ``degraded``
+    flag. Never raises: a wedged backend reports ``device_error``
+    instead of killing the bench that is trying to describe it."""
+    fp: Dict[str, Any] = {}
+    try:
+        import jax
+        fp["jax_version"] = jax.__version__
+        try:
+            import jaxlib
+            fp["jaxlib_version"] = jaxlib.__version__
+        except Exception:
+            fp["jaxlib_version"] = None
+        devs = jax.devices()
+        fp["platform"] = devs[0].platform
+        fp["device_kind"] = devs[0].device_kind
+        fp["device_count"] = len(devs)
+        fp["process_count"] = getattr(jax, "process_count", lambda: 1)()
+    except Exception as e:  # a fingerprint must never take the run down
+        fp["device_error"] = f"{type(e).__name__}: {e}"
+    if mesh is not None:
+        try:
+            fp["mesh_shape"] = {str(name): int(size) for name, size in
+                                zip(mesh.axis_names, mesh.devices.shape)}
+        except Exception:
+            fp["mesh_shape"] = None
+    fp["git_sha"] = _git_sha()
+    fp["flags"] = {k: os.environ[k] for k in sorted(os.environ)
+                   if k.startswith("PIPELINEDP_TPU_")}
+    # Mirrors resilience.health.DEGRADED_ENV (string literal: the
+    # fingerprint must be importable without touching resilience).
+    fp["degraded"] = bool(os.environ.get("PIPELINEDP_TPU_DEGRADED"))
+    return fp
+
+
+def span_summary(spans) -> Dict[str, Dict[str, Any]]:
+    """Per-name rollup of a span list: count / total / max seconds.
+    The full per-span detail lives in the Chrome trace; the report
+    stays record-sized no matter how many batches streamed."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for s in spans:
+        agg = out.setdefault(s.name, {"cat": s.cat, "count": 0,
+                                      "total_s": 0.0, "max_s": 0.0})
+        agg["count"] += 1
+        agg["total_s"] += s.dur
+        agg["max_s"] = max(agg["max_s"], s.dur)
+    for agg in out.values():
+        agg["total_s"] = round(agg["total_s"], 6)
+        agg["max_s"] = round(agg["max_s"], 6)
+    return out
+
+
+def build_run_report(snapshot: Dict[str, Any], mesh=None,
+                     extra: Optional[Dict[str, Any]] = None,
+                     env: Optional[Dict[str, Any]] = None
+                     ) -> Dict[str, Any]:
+    """Assemble the self-describing run report from a
+    ``RunLedger.snapshot()``. Pass a precomputed ``env`` fingerprint to
+    skip the device/git re-probe (bench computes it once per run)."""
+    report = {
+        "schema_version": SCHEMA_VERSION,
+        "env": env if env is not None else
+               environment_fingerprint(mesh=mesh),
+        "counters": dict(snapshot.get("counters", {})),
+        "events": list(snapshot.get("events", [])),
+        "spans": span_summary(snapshot.get("spans", [])),
+        "dropped": {"spans": snapshot.get("dropped_spans", 0),
+                    "events": snapshot.get("dropped_events", 0)},
+    }
+    if extra:
+        report.update(extra)
+    return report
+
+
+def chrome_trace_events(snapshot: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Convert a ledger snapshot to Chrome trace-event dicts. Spans
+    become ``ph: "X"`` complete events; ledger events become ``ph: "i"``
+    instants. Timestamps rebase to the earliest record (µs)."""
+    spans = snapshot.get("spans", [])
+    events = snapshot.get("events", [])
+    pid = os.getpid()
+    t0 = min([s.ts for s in spans] +
+             [e["ts"] for e in events if "ts" in e], default=0.0)
+    out: List[Dict[str, Any]] = []
+    threads = {}
+    for s in spans:
+        threads.setdefault(s.tid, s.thread)
+        out.append({"ph": "X", "name": s.name, "cat": s.cat,
+                    "pid": pid, "tid": s.tid,
+                    "ts": (s.ts - t0) * 1e6, "dur": s.dur * 1e6,
+                    "args": {k: _jsonable(v) for k, v in s.args.items()}})
+    for e in events:
+        args = {k: _jsonable(v) for k, v in e.items()
+                if k not in ("name", "ts")}
+        out.append({"ph": "i", "name": e["name"], "cat": "event",
+                    "pid": pid, "tid": 0, "s": "p",
+                    "ts": (e.get("ts", t0) - t0) * 1e6, "args": args})
+    # Thread-name metadata rows make the Perfetto lanes self-labeling.
+    for tid, name in sorted(threads.items()):
+        out.append({"ph": "M", "name": "thread_name", "pid": pid,
+                    "tid": tid, "args": {"name": name}})
+    return out
+
+
+def _jsonable(v):
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return repr(v)
+
+
+def write_chrome_trace(path: str, snapshot: Dict[str, Any]) -> str:
+    """Write the Chrome-trace JSON for ``snapshot``; returns ``path``."""
+    payload = {"traceEvents": chrome_trace_events(snapshot),
+               "displayTimeUnit": "ms",
+               "otherData": {"schema_version": SCHEMA_VERSION}}
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f)
+    return path

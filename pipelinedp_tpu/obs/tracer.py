@@ -1,0 +1,272 @@
+"""Structured span tracing + the process-global run ledger.
+
+The observability layer has two always-distinct cost tiers:
+
+* **Counters and events** record ALWAYS — they mark rare, load-bearing
+  occurrences (a retry attempt, a checkpoint resume, a fallback path
+  firing) whose absence from the record is exactly what made past bench
+  artifacts "session-measured, not driver-witnessed". An increment is a
+  dict update under a lock; an event is one small dict append.
+* **Spans** are the hot-path tier. A :class:`Tracer` always accumulates
+  per-name busy totals (that is the substrate the bench timing fields
+  ``t_stage``/``t_fold``/``t_device``/... derive from — the exact same
+  two-timestamp cost the ad-hoc ``perf_counter`` plumbing paid before),
+  but full :class:`Span` records flow into the :class:`RunLedger` only
+  when ``PIPELINEDP_TPU_TRACE`` is set. With tracing off, call sites
+  that only want ledger spans get the shared :data:`NOOP_TRACER`, whose
+  ``span()`` hands back ONE preallocated no-op context manager: no
+  allocation, nothing recorded, no attributes added to any hot object.
+
+Thread safety: the streaming ingest runs a ``BackgroundStager`` thread
+and an ``OrderedFoldWorker`` thread concurrently with the dispatch
+thread, and all three emit spans into one tracer — every mutation here
+is lock-guarded, and each completed span carries its thread identity so
+the Chrome-trace export lays the three lanes out side by side.
+
+Clock: tracers accept any ``pipelinedp_tpu.resilience.clock.Clock``
+(``monotonic()`` is the only method used), so fault tests drive spans
+with a ``FakeClock`` and assert exact durations in zero wall time. The
+default clock reads ``time.perf_counter`` — ``obs/`` is the ONE package
+allowed to touch the raw timer (``make noperf`` bans it elsewhere).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time as _time
+from typing import Any, Dict, List, Optional
+
+ENV_VAR = "PIPELINEDP_TPU_TRACE"
+
+#: Retention caps: a pathological run (millions of batches) must not
+#: OOM the host through its own telemetry. Drops are counted and
+#: surfaced in the run report — silent truncation would read as
+#: "covered everything".
+MAX_SPANS = 200_000
+MAX_EVENTS = 20_000
+
+
+def trace_enabled() -> bool:
+    """True when ``PIPELINEDP_TPU_TRACE`` requests span recording (any
+    value except empty/0/false/off; a path value also names the
+    Chrome-trace output file)."""
+    return os.environ.get(ENV_VAR, "").lower() not in ("", "0", "false",
+                                                       "off")
+
+
+def trace_destination(default: str = "pdp_trace.json") -> str:
+    """Where the Chrome-trace export should land: a path-like
+    ``PIPELINEDP_TPU_TRACE`` value (contains a separator or ends in
+    ``.json``) names the file; bare switch values ("1") use
+    ``default``."""
+    v = os.environ.get(ENV_VAR, "")
+    if os.sep in v or "/" in v or v.endswith(".json"):
+        return v
+    return default
+
+
+class _PerfClock:
+    """Default tracer clock. Satisfies the ``Clock.monotonic`` protocol
+    without importing ``resilience`` (which may import ``obs`` lazily —
+    keeping this module stdlib-only breaks the cycle)."""
+
+    def monotonic(self) -> float:
+        return _time.perf_counter()
+
+
+class Span:
+    """One completed span: ``[ts, ts + dur)`` seconds on thread ``tid``
+    (clock-relative timestamps; the Chrome export rebases to the run's
+    earliest span)."""
+
+    __slots__ = ("name", "cat", "ts", "dur", "tid", "thread", "args")
+
+    def __init__(self, name: str, cat: str, ts: float, dur: float,
+                 tid: int, thread: str, args: Dict[str, Any]):
+        self.name = name
+        self.cat = cat
+        self.ts = ts
+        self.dur = dur
+        self.tid = tid
+        self.thread = thread
+        self.args = args
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "cat": self.cat, "ts": self.ts,
+                "dur": self.dur, "tid": self.tid, "thread": self.thread,
+                "args": dict(self.args)}
+
+
+class RunLedger:
+    """Process-global sink for spans, counters, and events
+    (thread-safe). One ledger per process; ``pipelinedp_tpu.obs``
+    owns the singleton and ``reset()`` starts a fresh run."""
+
+    def __init__(self, clock=None):
+        self._lock = threading.Lock()
+        self._clock = clock if clock is not None else _PerfClock()
+        self.spans: List[Span] = []
+        self.counters: Dict[str, int] = {}
+        self.events: List[Dict[str, Any]] = []
+        self.dropped_spans = 0
+        self.dropped_events = 0
+
+    def add_span(self, span: Span) -> None:
+        with self._lock:
+            if len(self.spans) < MAX_SPANS:
+                self.spans.append(span)
+            else:
+                self.dropped_spans += 1
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + int(n)
+
+    def event(self, name: str, **attrs) -> None:
+        with self._lock:
+            if len(self.events) < MAX_EVENTS:
+                self.events.append({"name": name,
+                                    "ts": self._clock.monotonic(),
+                                    **attrs})
+            else:
+                self.dropped_events += 1
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Consistent copy of the ledger state (safe to serialize while
+        worker threads keep emitting)."""
+        with self._lock:
+            return {"spans": list(self.spans),
+                    "counters": dict(self.counters),
+                    "events": [dict(e) for e in self.events],
+                    "dropped_spans": self.dropped_spans,
+                    "dropped_events": self.dropped_events}
+
+    def reset(self) -> None:
+        with self._lock:
+            self.spans = []
+            self.counters = {}
+            self.events = []
+            self.dropped_spans = 0
+            self.dropped_events = 0
+
+
+class _SpanHandle:
+    """Context manager for one span. ``duration`` holds the measured
+    seconds after exit (bench helpers read it directly, replacing their
+    two-``perf_counter`` idiom)."""
+
+    __slots__ = ("_tracer", "name", "cat", "args", "_t0", "duration")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str,
+                 args: Dict[str, Any]):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self._t0 = 0.0
+        self.duration = 0.0
+
+    def __enter__(self) -> "_SpanHandle":
+        self._t0 = self._tracer._clock.monotonic()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        t1 = self._tracer._clock.monotonic()
+        self.duration = t1 - self._t0
+        self._tracer._finish(self, self._t0, self.duration)
+        return False
+
+
+class Tracer:
+    """Thread-safe span tracer.
+
+    Always accumulates per-name busy totals (``total(name)``) — the
+    derived view the bench timing fields are built from, bit-identical
+    in semantics to the former ad-hoc accumulators. When constructed
+    with a ``ledger`` (i.e. ``PIPELINEDP_TPU_TRACE`` is set), every
+    completed span is also appended there with its thread identity for
+    the Chrome-trace export.
+    """
+
+    def __init__(self, clock=None, ledger: Optional[RunLedger] = None):
+        self._clock = clock if clock is not None else _PerfClock()
+        self._ledger = ledger
+        self._lock = threading.Lock()
+        self._totals: Dict[str, float] = {}
+        self._counts: Dict[str, int] = {}
+
+    @property
+    def recording(self) -> bool:
+        return self._ledger is not None
+
+    def span(self, name: str, cat: str = "run", **args) -> _SpanHandle:
+        return _SpanHandle(self, name, cat, args)
+
+    def _finish(self, handle: _SpanHandle, t0: float, dur: float) -> None:
+        with self._lock:
+            self._totals[handle.name] = (
+                self._totals.get(handle.name, 0.0) + dur)
+            self._counts[handle.name] = self._counts.get(handle.name,
+                                                         0) + 1
+        if self._ledger is not None:
+            t = threading.current_thread()
+            self._ledger.add_span(Span(handle.name, handle.cat, t0, dur,
+                                       t.ident or 0, t.name,
+                                       handle.args))
+
+    def total(self, name: str) -> float:
+        """Accumulated busy seconds across completed spans of ``name``."""
+        with self._lock:
+            return self._totals.get(name, 0.0)
+
+    def count(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def totals(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._totals)
+
+
+class _NoopSpan:
+    """The shared do-nothing span context (one instance per process)."""
+
+    __slots__ = ()
+    duration = 0.0
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+
+#: The one no-op span handle — ``NoopTracer.span`` and
+#: ``obs.device_annotation`` return THIS object, never a fresh one.
+NOOP_SPAN = _NoopSpan()
+
+
+class NoopTracer:
+    """Zero-overhead disabled tracer: emits nothing, allocates nothing,
+    adds no attributes anywhere. ``total`` is honestly 0.0 — call sites
+    that need real totals with tracing off use ``obs.run_tracer()``."""
+
+    __slots__ = ()
+    recording = False
+
+    def span(self, name: str, cat: str = "run", **args) -> _NoopSpan:
+        return NOOP_SPAN
+
+    def total(self, name: str) -> float:
+        return 0.0
+
+    def count(self, name: str) -> int:
+        return 0
+
+    def totals(self) -> Dict[str, float]:
+        return {}
+
+
+#: The one no-op tracer instance.
+NOOP_TRACER = NoopTracer()

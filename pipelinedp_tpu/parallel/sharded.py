@@ -60,9 +60,13 @@ _CHECK_KW = ("check_vma" if "check_vma" in inspect.signature(
 
 def make_mesh(n_devices: Optional[int] = None, axis_name: str = "data"
               ) -> Mesh:
+    from pipelinedp_tpu import obs
     devices = jax.devices()
     if n_devices is not None:
         devices = devices[:n_devices]
+    obs.event("mesh.created", n_devices=len(devices),
+              axis_name=axis_name,
+              platform=devices[0].platform if devices else None)
     return Mesh(np.asarray(devices), (axis_name,))
 
 

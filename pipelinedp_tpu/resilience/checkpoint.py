@@ -155,6 +155,8 @@ class CheckpointStore:
             if os.path.exists(tmp):
                 os.unlink(tmp)
         self.last_event = f"saved next_batch={ckpt.next_batch}"
+        from pipelinedp_tpu import obs
+        obs.inc("checkpoint.saves")
 
     def load(self) -> Optional[StreamCheckpoint]:
         if not self.exists():
@@ -173,14 +175,25 @@ class CheckpointStore:
         A mismatch RAISES rather than silently restarting: a silent
         restart would re-draw noise and double-spend the budget without
         the operator ever learning the checkpoint was discarded."""
+        from pipelinedp_tpu import obs
+
         ckpt = self.load()
         if ckpt is None:
             return None
         if ckpt.fingerprint != fingerprint:
+            # The refusal used to be visible only as the raised
+            # exception; the ledger event makes it part of the record.
+            obs.inc("checkpoint.mismatch_refusals")
+            obs.event("checkpoint.mismatch_refusal", path=self.path,
+                      found=ckpt.fingerprint[:16],
+                      expected=fingerprint[:16])
             raise CheckpointMismatch(
                 f"checkpoint at {self.path} was written by a different "
                 "run (config/data/seed fingerprint mismatch); refusing "
                 "to resume — delete it explicitly to start fresh")
+        obs.inc("checkpoint.resumes")
+        obs.event("checkpoint.resumed", path=self.path,
+                  next_batch=int(ckpt.next_batch))
         return ckpt
 
     def clear(self) -> None:

@@ -117,16 +117,28 @@ def _consume(site: str) -> int:
     return n
 
 
+def _record(kind: str, **attrs) -> None:
+    """Every injected fault lands in the run ledger: a fault-test
+    artifact must say which failures were synthetic."""
+    from pipelinedp_tpu import obs
+    obs.inc("faults.injected")
+    obs.event("fault.injected", kind=kind, **attrs)
+
+
 def wedged(site: str) -> bool:
     """True when this attempt at ``site`` should behave as a wedged
     runtime (counted per site, deterministic)."""
     plan = active()
-    return plan is not None and _consume(site) < plan.wedged_init
+    hit = plan is not None and _consume(site) < plan.wedged_init
+    if hit:
+        _record("wedged_init", site=site)
+    return hit
 
 
 def check_chunk(index: int) -> None:
     plan = active()
     if plan is not None and index in plan.fail_chunks:
+        _record("chunk_failure", index=int(index))
         raise ChunkFailure(f"injected failure at streaming chunk {index}")
 
 
@@ -134,5 +146,6 @@ def check_coordinator() -> None:
     plan = active()
     if (plan is not None and
             _consume("distributed.init") < plan.coordinator_timeouts):
+        _record("coordinator_timeout")
         raise CoordinatorTimeout(
             "injected coordinator timeout (hung jax.distributed handshake)")

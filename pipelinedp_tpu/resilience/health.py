@@ -120,10 +120,13 @@ def ensure_device_or_degrade(policy: Optional[RetryPolicy] = None,
             raise _ProbeFailed(detail)
         return detail
 
+    from pipelinedp_tpu import obs
+
     try:
         detail = call_with_retry(
             attempt, policy, clock, retry_on=(_ProbeFailed,),
-            on_retry=lambda a, d, e: backoffs.append(d))
+            on_retry=lambda a, d, e: backoffs.append(d),
+            label="health.device_probe")
         if env.get(DEGRADED_ENV):
             # The accelerator recovered: lift the degradation override
             # we installed (the CPU pin only, never a user's own
@@ -133,12 +136,18 @@ def ensure_device_or_degrade(policy: Optional[RetryPolicy] = None,
             env.pop(DEGRADED_ENV, None)
             if env.get("JAX_PLATFORMS") == "cpu":
                 env.pop("JAX_PLATFORMS")
+            obs.event("health.recovered", attempts=attempts[0])
         return HealthReport(healthy=True, degraded=False,
                             attempts=attempts[0], backoff_s=backoffs,
                             detail=detail)
     except RetriesExhausted as e:
         env["JAX_PLATFORMS"] = "cpu"
         env[DEGRADED_ENV] = "1"
+        # A formerly-silent branch (the caller saw only the report):
+        # the degradation is now a first-class ledger event.
+        obs.inc("health.degradations")
+        obs.event("health.degraded", target="cpu_platform",
+                  attempts=attempts[0], detail=str(e.last_error))
         return HealthReport(healthy=False, degraded=True,
                             attempts=attempts[0], backoff_s=backoffs,
                             detail=str(e.last_error))
@@ -182,12 +191,15 @@ def resilient_make_mesh(n_devices: Optional[int] = None,
             raise TimeoutError(detail)
         return sharded.make_mesh(n_devices, axis_name)
 
+    from pipelinedp_tpu import obs
+
     backoffs: List[float] = []
     try:
         mesh = call_with_retry(
             attempt, policy, clock,
             retry_on=(RuntimeError, TimeoutError),
-            on_retry=lambda a, d, e: backoffs.append(d))
+            on_retry=lambda a, d, e: backoffs.append(d),
+            label="health.make_mesh")
         return mesh, HealthReport(healthy=True, degraded=False,
                                   attempts=attempts[0],
                                   backoff_s=backoffs, detail="ok")
@@ -196,6 +208,10 @@ def resilient_make_mesh(n_devices: Optional[int] = None,
         if n_devices is not None:
             cpu = cpu[:n_devices]
         mesh = Mesh(np.asarray(cpu), (axis_name,))
+        obs.inc("health.degradations")
+        obs.event("health.degraded", target="cpu_mesh",
+                  n_devices=int(mesh.devices.size),
+                  attempts=attempts[0], detail=str(e.last_error))
         return mesh, HealthReport(healthy=False, degraded=True,
                                   attempts=attempts[0],
                                   backoff_s=backoffs,
@@ -236,4 +252,6 @@ def resilient_distributed_initialize(coordinator_address: str,
             raise
 
     call_with_retry(attempt, policy, clock,
-                    retry_on=(RuntimeError, TimeoutError, faults.CoordinatorTimeout))
+                    retry_on=(RuntimeError, TimeoutError,
+                              faults.CoordinatorTimeout),
+                    label="health.distributed_initialize")

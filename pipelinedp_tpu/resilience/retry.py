@@ -57,13 +57,22 @@ def call_with_retry(fn: Callable,
                     policy: Optional[RetryPolicy] = None,
                     clock: Optional[Clock] = None,
                     retry_on: Tuple[Type[BaseException], ...] = (Exception,),
-                    on_retry: Optional[Callable] = None):
+                    on_retry: Optional[Callable] = None,
+                    label: Optional[str] = None):
     """Call ``fn()`` up to ``policy.max_attempts`` times, sleeping the
     policy's deterministic backoff schedule (via ``clock``) between
     attempts. ``on_retry(attempt, delay_s, error)`` is invoked before
-    each sleep. Raises ``RetriesExhausted`` wrapping the last error."""
+    each sleep. Raises ``RetriesExhausted`` wrapping the last error.
+
+    Every retry attempt (with its backoff delay) and every exhaustion
+    is also recorded in the run ledger (``pipelinedp_tpu.obs``) under
+    ``label`` — retries used to be invisible unless a caller wired its
+    own ``on_retry``."""
+    from pipelinedp_tpu import obs
+
     policy = policy or RetryPolicy()
     clock = clock or SystemClock()
+    label = label or getattr(fn, "__qualname__", repr(fn))
     delays = policy.delays()
     last: Optional[BaseException] = None
     for attempt in range(policy.max_attempts):
@@ -73,7 +82,13 @@ def call_with_retry(fn: Callable,
             last = e
             if attempt < policy.max_attempts - 1:
                 delay = delays[attempt]
+                obs.inc("retry.attempts")
+                obs.event("retry.attempt", label=label, attempt=attempt,
+                          delay_s=float(delay), error=repr(e))
                 if on_retry is not None:
                     on_retry(attempt, delay, e)
                 clock.sleep(delay)
+    obs.inc("retry.exhausted")
+    obs.event("retry.exhausted", label=label,
+              attempts=policy.max_attempts, error=repr(last))
     raise RetriesExhausted(policy.max_attempts, last)

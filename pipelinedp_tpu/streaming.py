@@ -59,7 +59,6 @@ from __future__ import annotations
 import dataclasses
 import functools
 import os
-import time as _time
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -68,6 +67,7 @@ import jax
 import jax.numpy as jnp
 
 from pipelinedp_tpu import jax_engine as je
+from pipelinedp_tpu import obs
 from pipelinedp_tpu.ops.segment import fmix32
 
 #: Rows per device batch (and the engine's streaming trigger: pipelines
@@ -493,6 +493,11 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
     from pipelinedp_tpu.resilience import checkpoint as ckpt_mod
     from pipelinedp_tpu.resilience import faults
 
+    # The run's span tracer: phase totals always accumulate (the bench
+    # timing fields below are derived views over them), full spans
+    # reach the ledger when PIPELINEDP_TPU_TRACE is set.
+    tr = obs.run_tracer()
+
     use_executor = (ingest.executor_enabled() if executor is None
                     else bool(executor))
     if mesh is not None and mesh.is_multi_process:
@@ -502,7 +507,13 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
         # collective kernels differently per process — measured as a
         # gloo rendezvous wedge on the two-process CPU mesh. The
         # single-controller mesh (one process, many devices) keeps the
-        # overlap.
+        # overlap. A formerly-silent branch: the event makes the forced
+        # serialization visible in the run ledger.
+        if use_executor:
+            obs.event("ingest.forced_serial",
+                      reason="multi-process mesh: threaded enqueue "
+                             "wedges the collective rendezvous")
+            obs.inc("ingest.forced_serial")
         use_executor = False
 
     n_dev = mesh.devices.size if mesh is not None else 1
@@ -541,6 +552,10 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
         p_blk = P_pad
         if per_q_bytes > je._SUBHIST_BYTE_CAP:
             if span * 4 > je._SUBHIST_BYTE_CAP:
+                obs.inc("walk.path_streamed_refusal")
+                obs.event("walk.fallback", path="streamed_refusal",
+                          span_bytes=span * 4,
+                          cap=int(je._SUBHIST_BYTE_CAP))
                 raise NotImplementedError(
                     f"streamed percentiles need one [1, 1, {span}] "
                     f"subtree block ({span * 4} bytes) within "
@@ -548,6 +563,13 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                     "partition's block")
             p_blk = 1 << ((je._SUBHIST_BYTE_CAP // (span * 4))
                           .bit_length() - 1)
+        if p_blk < P_pad or q_chunk < len(config.percentiles):
+            # The guard-cliff path fired: extra pass-B rounds instead
+            # of a refusal — record WHICH shape triggered it.
+            obs.inc("walk.path_partition_block_chunked")
+            obs.event("walk.fallback", path="partition_block_chunked",
+                      p_blk=int(p_blk), q_chunk=int(q_chunk),
+                      P_pad=int(P_pad))
 
     order, counts = _batch_assignment(config, encoded, n_batches, seed,
                                       n_dev)
@@ -594,10 +616,11 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                 "checkpointing requires a fixed rng_seed: resume must "
                 "replay the identical noise keys (the privacy budget is "
                 "consumed at noise draw, not at job success)")
-        ckpt_fp = ckpt_mod.run_fingerprint(
-            config, n, n_batches, seed, P_pad, n_dev, fx_bits,
-            data=ckpt_mod.data_digest(encoded))
-        saved = ckpt_store.load_for(ckpt_fp)
+        with tr.span("ckpt.restore", cat="checkpoint"):
+            ckpt_fp = ckpt_mod.run_fingerprint(
+                config, n, n_batches, seed, P_pad, n_dev, fx_bits,
+                data=ckpt_mod.data_digest(encoded))
+            saved = ckpt_store.load_for(ckpt_fp)
         if saved is not None:
             start_batch = saved.next_batch
             for name in acc:
@@ -616,9 +639,18 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
     else:
         row_sharding = None
 
-    t_stage = 0.0  # host staging + enqueue time across both passes
-    t_device = 0.0  # blocked on the device for batch outputs (fetch)
-    t_fold = 0.0  # host fold math after the fetch
+    # Phase timing now rides on spans: "ingest.stage" (host staging +
+    # enqueue, both passes), "ingest.fetch" (blocked on the device for
+    # batch outputs), "ingest.fold" (host fold math) — tr.total(name)
+    # is the derived accumulator the bench fields read.
+    obs.inc("ingest.streamed_runs")
+    # Only the rows THIS process will actually stage: a checkpoint
+    # resume skips the already-folded batch prefix, and the counter
+    # must not let a resumed partial run masquerade as a full one.
+    obs.inc("ingest.rows_ingested",
+            int(batch_rows[start_batch:].sum()))
+    obs.inc("ingest.executor_overlapped" if use_executor
+            else "ingest.executor_serial")
 
     # Plane-width tiers are decided ONCE from the global id maxima (the
     # jit signature must not vary per batch) and hoisted out of the
@@ -656,7 +688,6 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
         boundaries, so placement is a pure scatter). Yields
         (b, planes, values_d, nv, n_pid_planes) where ``nv`` is the
         device-ready valid-row count (scalar, or [n_dev] sharded)."""
-        nonlocal t_stage
         buf_len = n_dev * pad_rows
         zeros_dev = None  # shared zero values for COUNT-style runs
         n_sets = 1 if ring is None else ring.n_slots
@@ -681,65 +712,68 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                 # Blocks until the set staged two batches ago has had
                 # its outputs fetched; aborts promptly on teardown.
                 ring.acquire(cancelled)
-            t0 = _time.perf_counter()
-            s = staged % n_sets
-            staged += 1
-            pid_b, pk_b = pid_bufs[s], pk_bufs[s]
-            if copy_mode:
-                # Fresh values buffer every batch: the pass-B device
-                # cache may retain what ships, indefinitely.
-                values_b = (np.zeros(vshape, np.float32)
-                            if config.needs_values else None)
-            else:
-                values_b = val_bufs[s] if val_bufs is not None else None
-            # Narrow byte planes, padded on host to the uniform batch
-            # shape (uniform shape = ONE compile for every batch).
-            for d in range(n_dev):
-                cnt = int(ccounts[d])
-                rows = (slice(offset, offset + cnt) if order is None
-                        else order[offset:offset + cnt])
-                offset += cnt
-                s0 = d * pad_rows
-                if not config.bounds_already_enforced:
-                    pid_b[s0:s0 + cnt] = encoded.pid[rows]
-                    pid_b[s0 + cnt:s0 + pad_rows] = 0
-                pk_b[s0:s0 + cnt] = encoded.pk[rows]
-                pk_b[s0 + cnt:s0 + pad_rows] = 0
+            # The span is the former perf_counter pair: same region
+            # (after the ring gate, before the yield), same total.
+            with tr.span("ingest.stage", cat="ingest", batch=b):
+                s = staged % n_sets
+                staged += 1
+                pid_b, pk_b = pid_bufs[s], pk_bufs[s]
+                if copy_mode:
+                    # Fresh values buffer every batch: the pass-B
+                    # device cache may retain what ships, indefinitely.
+                    values_b = (np.zeros(vshape, np.float32)
+                                if config.needs_values else None)
+                else:
+                    values_b = (val_bufs[s] if val_bufs is not None
+                                else None)
+                # Narrow byte planes, padded on host to the uniform
+                # batch shape (uniform = ONE compile for every batch).
+                for d in range(n_dev):
+                    cnt = int(ccounts[d])
+                    rows = (slice(offset, offset + cnt) if order is None
+                            else order[offset:offset + cnt])
+                    offset += cnt
+                    s0 = d * pad_rows
+                    if not config.bounds_already_enforced:
+                        pid_b[s0:s0 + cnt] = encoded.pid[rows]
+                        pid_b[s0 + cnt:s0 + pad_rows] = 0
+                    pk_b[s0:s0 + cnt] = encoded.pk[rows]
+                    pk_b[s0 + cnt:s0 + pad_rows] = 0
+                    if values_b is not None:
+                        values_b[s0:s0 + cnt] = encoded.values[rows]
+                        if not copy_mode:
+                            values_b[s0 + cnt:s0 + pad_rows] = 0
+                pid_planes = je._narrow_ids(pid_b, pid_spec)
+                n_pid_planes = len(pid_planes)
+                host = [*pid_planes, *je._narrow_ids(pk_b, pk_spec)]
+                if copy_mode:
+                    # _narrow_ids returns fresh plane arrays except in
+                    # "i32" mode, where it returns the staging buffer
+                    # itself — copy those so a retained (cached) ship
+                    # list never aliases a reused buffer. In ring mode
+                    # the slot gating makes reuse safe without the copy.
+                    host = [p.copy() if (p is pid_b or p is pk_b) else p
+                            for p in host]
                 if values_b is not None:
-                    values_b[s0:s0 + cnt] = encoded.values[rows]
-                    if not copy_mode:
-                        values_b[s0 + cnt:s0 + pad_rows] = 0
-            pid_planes = je._narrow_ids(pid_b, pid_spec)
-            n_pid_planes = len(pid_planes)
-            host = [*pid_planes, *je._narrow_ids(pk_b, pk_spec)]
-            if copy_mode:
-                # _narrow_ids returns fresh plane arrays except in
-                # "i32" mode, where it returns the staging buffer
-                # itself — copy those so a retained (cached) ship list
-                # never aliases a reused buffer. In ring mode the slot
-                # gating makes the reuse safe without the copy.
-                host = [p.copy() if (p is pid_b or p is pk_b) else p
-                        for p in host]
-            if values_b is not None:
-                host.append(values_b)
-            if row_sharding is None:
-                dev = jax.device_put(tuple(host))  # one batched transfer
-                nv = jnp.int32(int(ccounts[0]))
-            else:
-                dev = jax.device_put(tuple(host), row_sharding)
-                nv = jax.device_put(ccounts.astype(np.int32),
-                                    row_sharding)
-            if values_b is not None:
-                planes, values_d = dev[:-1], dev[-1]
-            else:
-                planes = dev
-                if zeros_dev is None:
-                    zeros_dev = jnp.zeros(buf_len, jnp.float32)
-                    if row_sharding is not None:
-                        zeros_dev = jax.device_put(zeros_dev,
-                                                   row_sharding)
-                values_d = zeros_dev
-            t_stage += _time.perf_counter() - t0
+                    host.append(values_b)
+                if row_sharding is None:
+                    dev = jax.device_put(tuple(host))  # one transfer
+                    nv = jnp.int32(int(ccounts[0]))
+                else:
+                    dev = jax.device_put(tuple(host), row_sharding)
+                    nv = jax.device_put(ccounts.astype(np.int32),
+                                        row_sharding)
+                if values_b is not None:
+                    planes, values_d = dev[:-1], dev[-1]
+                else:
+                    planes = dev
+                    if zeros_dev is None:
+                        zeros_dev = jnp.zeros(buf_len, jnp.float32)
+                        if row_sharding is not None:
+                            zeros_dev = jax.device_put(zeros_dev,
+                                                       row_sharding)
+                    values_d = zeros_dev
+                obs.inc("ingest.batches_staged")
             yield b, planes, values_d, nv, n_pid_planes
 
     def fold_host(host, vec):
@@ -789,14 +823,17 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
 
     def save_ckpt(next_batch):
         nonlocal n_saves
-        arrays = {f"acc:{k}": v for k, v in acc.items()}
-        arrays.update({f"val:{k}": v for k, v in val_acc.items()})
-        if vec_acc is not None:
-            arrays["vec"] = vec_acc
-        if mid_acc is not None:
-            arrays["mid"] = np.asarray(mid_acc)
-        ckpt_store.save(ckpt_mod.StreamCheckpoint(ckpt_fp, next_batch,
-                                                  arrays))
+        with tr.span("ckpt.save", cat="checkpoint",
+                     next_batch=next_batch):
+            arrays = {f"acc:{k}": v for k, v in acc.items()}
+            arrays.update({f"val:{k}": v for k, v in val_acc.items()})
+            if vec_acc is not None:
+                arrays["vec"] = vec_acc
+            if mid_acc is not None:
+                arrays["mid"] = np.asarray(mid_acc)
+            ckpt_store.save(ckpt_mod.StreamCheckpoint(ckpt_fp,
+                                                      next_batch,
+                                                      arrays))
         n_saves += 1
 
     def fold_item(item):
@@ -806,18 +843,16 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
         the float64 operation sequence and the checkpoint-after-fold
         order are identical. The fetch BLOCKS until the batch's kernel
         finishes, which is what retires its staging-ring slot."""
-        nonlocal t_fold, t_device, mid_acc
+        nonlocal mid_acc
         pb, packed, vec, mid = item
-        t0 = _time.perf_counter()
-        host = np.asarray(packed)  # [C+1, P_pad] int32, one transfer
-        if ring is not None:
-            ring.retire()
-        t_device += _time.perf_counter() - t0
-        t0 = _time.perf_counter()
-        fold_host(host, vec)
-        if mid is not None:
-            mid_acc = mid if mid_acc is None else mid_acc + mid
-        t_fold += _time.perf_counter() - t0
+        with tr.span("ingest.fetch", cat="ingest", batch=pb):
+            host = np.asarray(packed)  # [C+1, P_pad] int32, 1 transfer
+            if ring is not None:
+                ring.retire()
+        with tr.span("ingest.fold", cat="ingest", batch=pb):
+            fold_host(host, vec)
+            if mid is not None:
+                mid_acc = mid if mid_acc is None else mid_acc + mid
         if ckpt_store is not None and (pb + 1) % ckpt_every == 0:
             save_ckpt(pb + 1)
 
@@ -832,14 +867,15 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
         # assert the checkpointed resume is bit-identical.
         faults.check_chunk(b)
         kb = jax.random.fold_in(k_bound, b)
-        if mesh is None:
-            packed, vec, mid = _partials_kernel(
-                config, P_pad, planes, values_d, nv, kb, fx_bits,
-                n_pid_planes=n_pid_planes)
-        else:
-            packed, vec, mid = _sharded_partials_kernel(
-                config, P_pad, mesh, planes, values_d, nv, kb, fx_bits,
-                n_pid_planes=n_pid_planes)
+        with obs.device_annotation("pdp.stream_partials"):
+            if mesh is None:
+                packed, vec, mid = _partials_kernel(
+                    config, P_pad, planes, values_d, nv, kb, fx_bits,
+                    n_pid_planes=n_pid_planes)
+            else:
+                packed, vec, mid = _sharded_partials_kernel(
+                    config, P_pad, mesh, planes, values_d, nv, kb,
+                    fx_bits, n_pid_planes=n_pid_planes)
         if cache is not None:
             # The budget is PER-DEVICE HBM: on a mesh the arrays are
             # row-sharded, so each device holds 1/n_dev of the bytes.
@@ -849,44 +885,58 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                 cache.append((b, planes, values_d, nv, n_pid_planes))
             else:
                 cache = None
+                obs.inc("stream.cache_overflow")
+                obs.event("stream.cache_overflow",
+                          cache_bytes=int(cache_bytes),
+                          cap=int(cache_cap))
         return b, packed, vec, mid
 
-    t_loop0 = _time.perf_counter()
-    if use_executor:
-        # Overlapped pass A: the stager prepares batch b+1 while the
-        # device computes batch b and the fold worker drains finished
-        # batches — three phases in flight at once. Any failure
-        # (including injected ChunkFailures) cancels both workers and
-        # joins them before propagating: no orphan threads, and the
-        # checkpoint on disk is a clean fold prefix.
-        folder = ingest.OrderedFoldWorker(fold_item, depth=2)
-        try:
-            with ingest.BackgroundStager(
-                    lambda cancelled: batches(start_batch, cancelled),
-                    depth=1) as stager:
-                for item in stager.items(poll=folder.raise_if_failed):
-                    folder.submit(launch(item))
-            folder.finish()
-        except BaseException:
-            folder.cancel()
-            raise
-    else:
-        # Serial pass A (the bit-parity reference): fold one batch
-        # late, so batch b's transfer + kernel are in flight while
-        # batch b-1's fetch waits.
-        pending = None
-        for item in batches(start_batch):
-            out = launch(item)
+    with tr.span("ingest.pass_a", cat="ingest", n_batches=n_batches,
+                 executor="overlapped" if use_executor
+                 else "serial") as pass_a:
+        if use_executor:
+            # Overlapped pass A: the stager prepares batch b+1 while
+            # the device computes batch b and the fold worker drains
+            # finished batches — three phases in flight at once. Any
+            # failure (including injected ChunkFailures) cancels both
+            # workers and joins them before propagating: no orphan
+            # threads, and the checkpoint on disk is a clean fold
+            # prefix.
+            folder = ingest.OrderedFoldWorker(fold_item, depth=2)
+            try:
+                with ingest.BackgroundStager(
+                        lambda cancelled: batches(start_batch,
+                                                  cancelled),
+                        depth=1) as stager:
+                    for item in stager.items(
+                            poll=folder.raise_if_failed):
+                        folder.submit(launch(item))
+                folder.finish()
+            except BaseException:
+                folder.cancel()
+                raise
+        else:
+            # Serial pass A (the bit-parity reference): fold one batch
+            # late, so batch b's transfer + kernel are in flight while
+            # batch b-1's fetch waits.
+            pending = None
+            for item in batches(start_batch):
+                out = launch(item)
+                if pending is not None:
+                    fold_item(pending)
+                pending = out
             if pending is not None:
                 fold_item(pending)
-            pending = out
-        if pending is not None:
-            fold_item(pending)
-    t_loop = _time.perf_counter() - t_loop0
+    t_loop = pass_a.duration
     # Overlap evidence for the bench: time the three host/device phases
-    # spent busy vs the wall clock of the whole pass-A loop. Serial
+    # spent busy vs the wall clock of the whole pass-A loop — all four
+    # now derived views over the run tracer's spans, same names and
+    # semantics as the former perf_counter accumulators. Serial
     # execution gives t_total ≈ busy (frac ~0); overlap hides phase
     # time inside the wall (t_total < busy, frac > 0).
+    t_stage = tr.total("ingest.stage")
+    t_device = tr.total("ingest.fetch")
+    t_fold = tr.total("ingest.fold")
     busy_a = t_stage + t_device + t_fold
     overlap = {"t_stage": t_stage, "t_device": t_device,
                "t_fold": t_fold, "t_total": t_loop,
@@ -910,11 +960,13 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
         # pass B below, from histograms, not rows): strip the percentile
         # list so _selection_and_metrics skips its row-based walk.
         sel_config = dataclasses.replace(config, percentiles=())
-        keep = np.asarray(_select_kernel(
-            sel_config, P_pad, jnp.asarray(nseg.astype(np.int32)),
-            jnp.asarray(keep_table), jnp.float32(sel_threshold),
-            jnp.float32(sel_scale), jnp.float32(sel_min_count),
-            jnp.float32(sel_rows_per_uid), k_sel))
+        with tr.span("ingest.select", cat="ingest"), \
+                obs.device_annotation("pdp.partition_select"):
+            keep = np.asarray(_select_kernel(
+                sel_config, P_pad, jnp.asarray(nseg.astype(np.int32)),
+                jnp.asarray(keep_table), jnp.float32(sel_threshold),
+                jnp.float32(sel_scale), jnp.float32(sel_min_count),
+                jnp.float32(sel_rows_per_uid), k_sel))
     stats = {"n_batches": n_batches, "chunk_rows": chunk,
              "fx_bits": fx_bits, "max_batch_rows": max_rows,
              "mesh_devices": n_dev,
@@ -939,8 +991,10 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                 "rows — beyond the int32 tree-histogram capacity")
         k_tree = jax.random.fold_in(k_noise, 0x7ee)
         scale = jnp.float32(np.asarray(scales)[-1])
-        lo, hi, target, leaf_lo, done = _walk_top_kernel(
-            config, P_pad, mid_acc, k_tree, scale)
+        with tr.span("walk.top", cat="walk"), \
+                obs.device_annotation("pdp.walk_top"):
+            lo, hi, target, leaf_lo, done = _walk_top_kernel(
+                config, P_pad, mid_acc, k_tree, scale)
         if mesh is not None:
             # The walk state is tiny ([P, Q]); host-fetch it once and
             # re-feed replicated — the sharded pass-B kernel's in_specs
@@ -985,30 +1039,41 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
         for q0 in range(0, Q, q_chunk):
             qsl = slice(q0, min(q0 + q_chunk, Q))
             for p0 in range(0, P_pad, p_blk):
-                Pb = min(p_blk, P_pad - p0)
-                psl = slice(p0, p0 + Pb)
-                ss_dev = jnp.asarray(sub_start[psl, qsl])
-                if cache is not None:
-                    sub_acc = run_pass_b(iter(cache), ss_dev, p0, Pb)
-                elif use_executor:
-                    # Overlapped re-ship: stage batch b+1 on the stager
-                    # thread while the device counts batch b's subtree
-                    # leaves (no folds in pass B — accumulation stays on
-                    # device, so only the stager is needed).
-                    with ingest.BackgroundStager(
-                            lambda cancelled: batches(
-                                cancelled=cancelled),
-                            depth=1) as stager_b:
-                        sub_acc = run_pass_b(stager_b.items(), ss_dev,
-                                             p0, Pb)
-                else:
-                    sub_acc = run_pass_b(batches(), ss_dev, p0, Pb)
-                vals_g = _walk_bottom_kernel(
-                    config, Pb, sub_acc, ss_dev, lo[psl, qsl],
-                    hi[psl, qsl], target[psl, qsl], leaf_lo[psl, qsl],
-                    done[psl, qsl], k_tree, scale, jnp.int32(p0))
-                vals[psl, qsl] = np.asarray(vals_g)
-                rounds += 1
+                with tr.span("ingest.pass_b_round", cat="ingest",
+                             q0=q0, p0=p0):
+                    Pb = min(p_blk, P_pad - p0)
+                    psl = slice(p0, p0 + Pb)
+                    ss_dev = jnp.asarray(sub_start[psl, qsl])
+                    if cache is not None:
+                        obs.inc("stream.pass_b_cache_hit_batches",
+                                len(cache))
+                        sub_acc = run_pass_b(iter(cache), ss_dev, p0,
+                                             Pb)
+                    elif use_executor:
+                        # Overlapped re-ship: stage batch b+1 on the
+                        # stager thread while the device counts batch
+                        # b's subtree leaves (no folds in pass B —
+                        # accumulation stays on device, so only the
+                        # stager is needed).
+                        obs.inc("stream.pass_b_reship_rounds")
+                        with ingest.BackgroundStager(
+                                lambda cancelled: batches(
+                                    cancelled=cancelled),
+                                depth=1) as stager_b:
+                            sub_acc = run_pass_b(stager_b.items(),
+                                                 ss_dev, p0, Pb)
+                    else:
+                        obs.inc("stream.pass_b_reship_rounds")
+                        sub_acc = run_pass_b(batches(), ss_dev, p0, Pb)
+                    with tr.span("walk.bottom", cat="walk", p0=p0), \
+                            obs.device_annotation("pdp.walk_bottom"):
+                        vals_g = _walk_bottom_kernel(
+                            config, Pb, sub_acc, ss_dev, lo[psl, qsl],
+                            hi[psl, qsl], target[psl, qsl],
+                            leaf_lo[psl, qsl], done[psl, qsl], k_tree,
+                            scale, jnp.int32(p0))
+                        vals[psl, qsl] = np.asarray(vals_g)
+                    rounds += 1
         stats["pass_b_rounds"] = rounds
         # The cross-quantile monotone step runs ONCE over the full
         # list (chunked walks must compose to the single-walk result).
@@ -1017,7 +1082,10 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
         stats["percentile_values"] = np.asarray(
             je._monotone_in_q(jnp.asarray(vals), quantiles))
 
-    stats["stage_s"] = t_stage
+    # Includes pass-B restaging (the stage spans keep accumulating
+    # through the re-ship rounds) — the same window the former
+    # accumulator covered.
+    stats["stage_s"] = tr.total("ingest.stage")
     if ckpt_store is not None:
         # The run released its outputs: the checkpoint must not survive
         # (resuming a FINISHED run into a fresh aggregation would skip

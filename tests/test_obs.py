@@ -1,0 +1,450 @@
+"""Observability-layer tests (``pipelinedp_tpu/obs``).
+
+Coverage contract (``make obscheck``):
+
+* tracer thread-safety under a LIVE overlapped-ingest run — the
+  ``BackgroundStager`` and ``OrderedFoldWorker`` threads emit spans
+  concurrently with the dispatch thread and none are dropped or
+  interleaved-corrupt;
+* no-op mode (``PIPELINEDP_TPU_TRACE`` unset) emits nothing: the
+  global tracer is the shared no-op singleton, a full streamed run
+  leaves zero spans in the ledger, and no attributes are added to hot
+  objects;
+* bench-field parity: with tracing on vs off the DP outputs are
+  bit-identical and every timing field keeps its name;
+* Chrome-trace export round-trips through ``json.loads`` with valid
+  ``ph``/``ts``/``dur`` fields;
+* the run report carries its schema version and environment
+  fingerprint;
+* resilience branches (retry attempts with backoff delays, checkpoint
+  resume/mismatch-refusal, health degradation, fault injection) emit
+  structured events;
+* lint twin: no raw ``time.perf_counter()`` phase timing outside
+  ``pipelinedp_tpu/obs/`` (``make noperf`` runs the same check).
+"""
+
+import json
+import os
+import re
+import threading
+
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import obs
+from pipelinedp_tpu.backends import JaxBackend
+from pipelinedp_tpu.obs import report as obs_report
+from pipelinedp_tpu.obs.tracer import RunLedger, Span
+from pipelinedp_tpu.resilience import (CheckpointStore, FaultPlan,
+                                       RetriesExhausted, RetryPolicy,
+                                       call_with_retry, injected_faults)
+from pipelinedp_tpu.resilience.checkpoint import (CheckpointMismatch,
+                                                  StreamCheckpoint)
+from pipelinedp_tpu.resilience.clock import FakeClock
+from pipelinedp_tpu.resilience.faults import ChunkFailure, check_chunk
+
+BIG_EPS = 1e12
+
+
+@pytest.fixture(autouse=True)
+def fresh_ledger(monkeypatch):
+    """Each test starts with an empty ledger, tiny stream chunks, and
+    tracing OFF unless it opts in."""
+    monkeypatch.setenv("PIPELINEDP_TPU_STREAM_CHUNK", "997")
+    monkeypatch.delenv(obs.ENV_VAR, raising=False)
+    obs.reset()
+    yield
+    obs.reset()
+
+
+def run_streamed(ds, params, seed=0, eps=BIG_EPS):
+    ds.invalidate_cache()
+    acc = pdp.NaiveBudgetAccountant(total_epsilon=eps, total_delta=1e-2)
+    engine = pdp.DPEngine(acc, JaxBackend(rng_seed=seed))
+    res = engine.aggregate(ds, params, pdp.DataExtractors())
+    acc.compute_budgets()
+    got = dict(res)
+    assert res.timings.get("stream_batches", 0) > 1, (
+        "dataset did not stream — test is not covering the chunked path")
+    return got, res.timings
+
+
+def make_ds(seed=1, n=9_000, users=2_000, parts=12):
+    rng = np.random.default_rng(seed)
+    return pdp.ArrayDataset(privacy_ids=rng.integers(0, users, n),
+                            partition_keys=rng.integers(0, parts, n),
+                            values=rng.uniform(0.0, 10.0, n)), parts
+
+
+def count_params(parts):
+    return pdp.AggregateParams(
+        metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM, pdp.Metrics.MEAN],
+        max_partitions_contributed=parts,
+        max_contributions_per_partition=50,
+        min_value=0.0, max_value=10.0)
+
+
+class TestTracerCore:
+    """The span substrate: totals, durations, ledger recording — all
+    driven by the injectable FakeClock (zero wall time)."""
+
+    def test_totals_and_duration_from_fake_clock(self):
+        clock = FakeClock()
+        tr = obs.Tracer(clock=clock)
+        with tr.span("outer", cat="t") as outer:
+            clock.sleep(2.0)
+            with tr.span("inner", cat="t"):
+                clock.sleep(0.5)
+        assert outer.duration == pytest.approx(2.5)
+        assert tr.total("outer") == pytest.approx(2.5)
+        assert tr.total("inner") == pytest.approx(0.5)
+        assert tr.count("outer") == 1
+        # Repeat spans accumulate (the bench-field accumulator rule).
+        with tr.span("inner"):
+            clock.sleep(1.0)
+        assert tr.total("inner") == pytest.approx(1.5)
+        assert tr.count("inner") == 2
+
+    def test_ledger_records_spans_with_thread_identity(self):
+        led = RunLedger()
+        tr = obs.Tracer(clock=FakeClock(), ledger=led)
+        with tr.span("a", cat="t", batch=3):
+            pass
+        snap = led.snapshot()
+        assert len(snap["spans"]) == 1
+        s = snap["spans"][0]
+        assert s.name == "a" and s.cat == "t"
+        assert s.args == {"batch": 3}
+        assert s.tid == threading.current_thread().ident
+        assert s.thread == threading.current_thread().name
+
+    def test_span_cap_counts_drops(self):
+        led = RunLedger()
+        led.spans = [None] * obs.MAX_SPANS  # simulate a full ledger
+        tr = obs.Tracer(clock=FakeClock(), ledger=led)
+        with tr.span("over"):
+            pass
+        assert led.dropped_spans == 1
+        assert len(led.spans) == obs.MAX_SPANS
+
+
+class TestNoopMode:
+    """PIPELINEDP_TPU_TRACE unset: the global tracer emits NOTHING and
+    adds no attributes to hot objects."""
+
+    def test_global_tracer_is_shared_noop(self):
+        t = obs.tracer()
+        assert t is obs.NOOP_TRACER
+        # span() hands back ONE shared context manager — no per-call
+        # allocation on the hot path.
+        assert t.span("x", batch=1) is obs.NOOP_SPAN
+        assert t.span("y") is obs.NOOP_SPAN
+        with t.span("z") as sp:
+            assert sp.duration == 0.0
+        # No instance dict anywhere a hot loop could bloat.
+        assert not hasattr(obs.NOOP_SPAN, "__dict__")
+        assert not hasattr(obs.NOOP_TRACER, "__dict__")
+
+    def test_streamed_run_emits_no_spans(self):
+        ds, parts = make_ds(seed=3)
+        run_streamed(ds, count_params(parts), seed=11)
+        snap = obs.ledger().snapshot()
+        assert snap["spans"] == [], (
+            "no-op mode leaked spans into the ledger")
+
+    def test_run_tracer_still_measures(self):
+        """Bench fields need real totals with tracing off: run_tracer
+        measures always, it just does not RECORD."""
+        clock = FakeClock()
+        tr = obs.run_tracer(clock=clock)
+        assert not tr.recording
+        with tr.span("phase"):
+            clock.sleep(1.25)
+        assert tr.total("phase") == pytest.approx(1.25)
+        assert obs.ledger().snapshot()["spans"] == []
+
+
+class TestLiveExecutorThreadSafety:
+    """Tracing ON under a live BackgroundStager + OrderedFoldWorker run:
+    spans arrive from three threads concurrently; none may be dropped
+    or corrupt."""
+
+    def test_spans_complete_and_well_formed(self, monkeypatch):
+        monkeypatch.setenv(obs.ENV_VAR, "1")
+        monkeypatch.setenv("PIPELINEDP_TPU_INGEST_EXECUTOR", "1")
+        ds, parts = make_ds(seed=5, n=9_000)
+        _, timings = run_streamed(ds, count_params(parts), seed=7)
+        assert timings["stream_executor"] == "overlapped"
+        n_batches = timings["stream_batches"]
+        snap = obs.ledger().snapshot()
+        by_name = {}
+        for s in snap["spans"]:
+            assert isinstance(s, Span)
+            assert isinstance(s.name, str) and s.name
+            assert isinstance(s.ts, float)
+            assert isinstance(s.dur, float) and s.dur >= 0.0
+            assert isinstance(s.tid, int)
+            by_name.setdefault(s.name, []).append(s)
+        assert snap["dropped_spans"] == 0
+        # One stage/fetch/fold span per batch — none dropped, none
+        # double-counted, batch args intact (interleaving corruption
+        # would duplicate or lose batch ids).
+        for name in ("ingest.stage", "ingest.fetch", "ingest.fold"):
+            batches = sorted(s.args["batch"] for s in by_name[name])
+            assert batches == list(range(n_batches)), (
+                f"{name}: expected one span per batch, got {batches}")
+        assert len(by_name["ingest.pass_a"]) == 1
+        # The three pipeline roles really ran on distinct threads.
+        tids = {s.tid for s in snap["spans"]}
+        assert len(tids) >= 3, (
+            "expected spans from stager + fold + dispatch threads")
+        stage_tids = {s.tid for s in by_name["ingest.stage"]}
+        fold_tids = {s.tid for s in by_name["ingest.fold"]}
+        assert stage_tids.isdisjoint(fold_tids)
+
+    def test_percentile_pass_b_spans(self, monkeypatch):
+        monkeypatch.setenv(obs.ENV_VAR, "1")
+        rng = np.random.default_rng(30)
+        n = 6_000
+        ds = pdp.ArrayDataset(privacy_ids=rng.integers(0, 1_500, n),
+                              partition_keys=rng.integers(0, 4, n),
+                              values=rng.uniform(0, 10, n))
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.PERCENTILE(50),
+                     pdp.Metrics.PERCENTILE(90)],
+            max_partitions_contributed=4,
+            max_contributions_per_partition=50,
+            min_value=0.0, max_value=10.0)
+        run_streamed(ds, params, seed=3)
+        names = {s.name for s in obs.ledger().snapshot()["spans"]}
+        assert {"walk.top", "walk.bottom", "ingest.pass_b_round",
+                "ingest.stage", "ingest.fetch", "ingest.fold",
+                "ingest.pass_a"} <= names
+
+
+class TestParity:
+    """Acceptance: tracing on/off changes ONLY observability — DP
+    outputs bit-identical, every timing field present either way."""
+
+    TIMING_KEYS = ("host_encode_s", "device_s", "host_decode_s",
+                   "stream_batches", "stream_stage_s",
+                   "stream_fold_wait_s", "stream_t_stage",
+                   "stream_t_fold", "stream_t_device", "stream_t_total",
+                   "stream_overlap_frac", "stream_executor")
+
+    def test_outputs_bit_identical_and_fields_stable(self, monkeypatch):
+        ds, parts = make_ds(seed=9)
+        params = count_params(parts)
+        results, timings = {}, {}
+        for mode in ("off", "on"):
+            obs.reset()
+            if mode == "on":
+                monkeypatch.setenv(obs.ENV_VAR, "1")
+            else:
+                monkeypatch.delenv(obs.ENV_VAR, raising=False)
+            results[mode], timings[mode] = run_streamed(ds, params,
+                                                        seed=17)
+        assert set(results["off"]) == set(results["on"])
+        for k in results["off"]:
+            ta, tb = results["off"][k], results["on"][k]
+            assert ta._fields == tb._fields
+            for f in ta._fields:
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(ta, f)),
+                    np.asarray(getattr(tb, f)),
+                    err_msg=f"partition {k}.{f}")
+        for mode in ("off", "on"):
+            for key in self.TIMING_KEYS:
+                assert key in timings[mode], (mode, key)
+            assert timings[mode]["stream_t_total"] > 0.0
+            # Phase totals really accumulated (spans measured even with
+            # tracing off).
+            busy = (timings[mode]["stream_t_stage"] +
+                    timings[mode]["stream_t_fold"] +
+                    timings[mode]["stream_t_device"])
+            assert busy > 0.0
+
+
+class TestChromeTrace:
+    """Export round-trip: valid JSON, valid ph/ts/dur, thread lanes."""
+
+    def _ledger_with_spans(self):
+        led = RunLedger(clock=FakeClock())
+        clock = FakeClock(10.0)
+        tr = obs.Tracer(clock=clock, ledger=led)
+
+        def worker():
+            with tr.span("w", cat="test", batch=1):
+                clock.sleep(0.25)
+
+        t = threading.Thread(target=worker, name="obs-test-worker")
+        with tr.span("main", cat="test"):
+            t.start()
+            t.join()
+            clock.sleep(0.5)
+        led.event("marker", detail="hello")
+        return led
+
+    def test_round_trip(self, tmp_path):
+        led = self._ledger_with_spans()
+        path = str(tmp_path / "trace.json")
+        obs_report.write_chrome_trace(path, led.snapshot())
+        with open(path, encoding="utf-8") as f:
+            payload = json.loads(f.read())
+        events = payload["traceEvents"]
+        xs = [e for e in events if e["ph"] == "X"]
+        assert {e["name"] for e in xs} == {"w", "main"}
+        for e in xs:
+            assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+            assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+            assert isinstance(e["tid"], int)
+            assert e["pid"] == os.getpid()
+        w = next(e for e in xs if e["name"] == "w")
+        assert w["dur"] == pytest.approx(0.25e6)
+        assert w["args"]["batch"] == 1
+        instants = [e for e in events if e["ph"] == "i"]
+        assert any(e["name"] == "marker" for e in instants)
+        metas = [e for e in events if e["ph"] == "M"]
+        assert {m["args"]["name"] for m in metas} >= {"obs-test-worker"}
+
+    def test_global_export_path(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(obs.ENV_VAR, str(tmp_path / "t.json"))
+        with obs.tracer().span("one", cat="test"):
+            pass
+        out = obs.write_chrome_trace()
+        assert out == str(tmp_path / "t.json")
+        payload = json.load(open(out, encoding="utf-8"))
+        assert any(e["name"] == "one" for e in payload["traceEvents"])
+
+
+class TestRunReport:
+    """Schema version, environment fingerprint, counters, summaries."""
+
+    def test_schema_version_and_sections(self, monkeypatch):
+        monkeypatch.setenv(obs.ENV_VAR, "1")
+        with obs.tracer().span("phase", cat="test"):
+            pass
+        obs.inc("retry.attempts", 2)
+        obs.event("health.degraded", target="cpu_platform")
+        report = obs.build_run_report(extra={"note": "t"})
+        assert report["schema_version"] == obs.SCHEMA_VERSION == 1
+        assert report["counters"]["retry.attempts"] == 2
+        assert report["spans"]["phase"]["count"] == 1
+        assert any(e["name"] == "health.degraded"
+                   for e in report["events"])
+        assert report["note"] == "t"
+        assert report["dropped"] == {"spans": 0, "events": 0}
+
+    def test_environment_fingerprint(self, monkeypatch):
+        monkeypatch.setenv("PIPELINEDP_TPU_STREAM_CHUNK", "4242")
+        fp = obs.environment_fingerprint()
+        assert fp["jax_version"]
+        assert fp["device_count"] >= 1
+        assert fp["platform"]
+        assert fp["flags"]["PIPELINEDP_TPU_STREAM_CHUNK"] == "4242"
+        assert fp["degraded"] is False
+        # The repo is a git work tree: the SHA must resolve.
+        assert re.fullmatch(r"[0-9a-f]{40}", fp["git_sha"] or "")
+
+
+class TestResilienceEvents:
+    """Formerly-silent resilience branches now land in the ledger."""
+
+    def test_retry_attempts_with_backoff_delays(self):
+        policy = RetryPolicy(max_attempts=3, base_delay_s=1.0,
+                             multiplier=2.0, max_delay_s=30.0,
+                             jitter=0.1, seed=4)
+        clock = FakeClock()
+
+        def always_fails():
+            raise ValueError("boom")
+
+        with pytest.raises(RetriesExhausted):
+            call_with_retry(always_fails, policy, clock,
+                            label="test.op")
+        snap = obs.ledger().snapshot()
+        attempts = [e for e in snap["events"]
+                    if e["name"] == "retry.attempt"]
+        assert [e["attempt"] for e in attempts] == [0, 1]
+        assert all(e["label"] == "test.op" for e in attempts)
+        # The recorded delays ARE the policy's deterministic schedule.
+        assert [e["delay_s"] for e in attempts] == (
+            pytest.approx(policy.delays()))
+        assert snap["counters"]["retry.attempts"] == 2
+        exhausted = [e for e in snap["events"]
+                     if e["name"] == "retry.exhausted"]
+        assert len(exhausted) == 1 and "boom" in exhausted[0]["error"]
+
+    def test_checkpoint_resume_and_mismatch_events(self, tmp_path):
+        store = CheckpointStore(str(tmp_path / "s.ckpt"))
+        store.save(StreamCheckpoint("fp_a", 3,
+                                    {"acc:count": np.arange(4)}))
+        assert store.load_for("fp_a").next_batch == 3
+        with pytest.raises(CheckpointMismatch):
+            store.load_for("fp_b")
+        snap = obs.ledger().snapshot()
+        assert snap["counters"]["checkpoint.saves"] == 1
+        assert snap["counters"]["checkpoint.resumes"] == 1
+        assert snap["counters"]["checkpoint.mismatch_refusals"] == 1
+        refusal = next(e for e in snap["events"]
+                       if e["name"] == "checkpoint.mismatch_refusal")
+        assert refusal["expected"] == "fp_b"[:16]
+
+    def test_health_degradation_event(self):
+        from pipelinedp_tpu.resilience import health
+        env = {}
+        with injected_faults(FaultPlan(wedged_init=5)):
+            report = health.ensure_device_or_degrade(
+                policy=RetryPolicy(max_attempts=2, base_delay_s=1.0,
+                                   seed=0),
+                clock=FakeClock(), env=env)
+        assert report.degraded
+        snap = obs.ledger().snapshot()
+        assert snap["counters"]["health.degradations"] == 1
+        ev = next(e for e in snap["events"]
+                  if e["name"] == "health.degraded")
+        assert ev["target"] == "cpu_platform"
+        # The injected wedges themselves are on the record too.
+        assert snap["counters"]["faults.injected"] == 2
+
+    def test_fault_injection_event(self):
+        with injected_faults(FaultPlan(fail_chunks=(2,))):
+            check_chunk(0)
+            with pytest.raises(ChunkFailure):
+                check_chunk(2)
+        ev = next(e for e in obs.ledger().snapshot()["events"]
+                  if e["name"] == "fault.injected")
+        assert ev["kind"] == "chunk_failure" and ev["index"] == 2
+
+
+class TestNoRawPerfCounter:
+    """Lint twin of ``make noperf``: raw ``time.perf_counter()`` phase
+    timing is banned outside ``pipelinedp_tpu/obs/`` — timing must flow
+    through obs spans so every measured phase lands in the run ledger
+    (bench.py routes through ``obs.run_tracer``)."""
+
+    def test_no_perf_counter_outside_obs(self):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        # No \b before "perf": aliases like _time.perf_counter match.
+        pattern = re.compile(r"perf_counter\s*\(")
+        offenders = []
+        roots = [os.path.join(repo, "pipelinedp_tpu"),
+                 os.path.join(repo, "bench.py")]
+        for root in roots:
+            files = ([root] if root.endswith(".py") else
+                     [os.path.join(dp, f)
+                      for dp, _, fs in os.walk(root)
+                      for f in fs if f.endswith(".py")])
+            for path in files:
+                rel = os.path.relpath(path, repo).replace(os.sep, "/")
+                if rel.startswith("pipelinedp_tpu/obs/"):
+                    continue
+                with open(path, encoding="utf-8") as f:
+                    for ln, line in enumerate(f, 1):
+                        if pattern.search(line):
+                            offenders.append(f"{rel}:{ln}: "
+                                             f"{line.strip()}")
+        assert not offenders, (
+            "raw perf_counter timing found — use pipelinedp_tpu.obs "
+            "spans:\n" + "\n".join(offenders))
