@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast bench smoke multichip lint dev clean faultcheck nosleep perfcheck nofoldin obscheck noperf
+.PHONY: test test-fast bench smoke multichip lint dev clean faultcheck nosleep perfcheck nofoldin obscheck noperf nostager
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -31,10 +31,12 @@ faultcheck: nosleep
 # fault-kill drain (no orphan threads), O(n) assignment, id-narrowing
 # tiers, sweep checkpoint/resume, the kill/resume fault tests — plus
 # the quantile-walk suite (counter-noise generator, three-way walk
-# bit-parity, partition-block chunking, guard-cliff boundaries).
-perfcheck: nosleep nofoldin
+# bit-parity, partition-block chunking, guard-cliff boundaries) and
+# the pass-B sweep suite (planner invariants, multi-tile-vs-per-tile
+# bit-parity, hybrid prefix cache, pass-B fault drain).
+perfcheck: nosleep nofoldin nostager
 	$(PYTHON) -m pytest tests/test_ingest.py tests/test_faults.py \
-	  tests/test_walk.py -q
+	  tests/test_walk.py tests/test_pass_b.py -q
 
 # Observability acceptance suite: tracer thread-safety under a live
 # overlapped-ingest run, no-op-mode zero emission, bench-field parity
@@ -75,6 +77,32 @@ nofoldin:
 	  exit 1; \
 	fi; \
 	echo "nofoldin: OK"
+
+# Lint-style check: pass-B restreaming must flow through the sweep
+# planner's ONE stream source (streaming.py run_sweep) — a new direct
+# BackgroundStager construction outside pipelinedp_tpu/ingest/ and the
+# two blessed streaming.py sites (pass A's overlapped loop + the
+# pass-B sweep source) silently re-introduces per-tile restreaming.
+# (tests/test_pass_b.py enforces the same rule in-tree, AST-precise.)
+nostager:
+	@bad=$$(grep -rn "BackgroundStager *(" --include='*.py' pipelinedp_tpu bench.py \
+	  | grep -v "pipelinedp_tpu/ingest/" \
+	  | grep -v "pipelinedp_tpu/streaming\.py" || true); \
+	if [ -n "$$bad" ]; then \
+	  echo "$$bad"; \
+	  echo "ERROR: direct BackgroundStager construction — only"; \
+	  echo "pipelinedp_tpu/ingest/ and the two blessed streaming.py"; \
+	  echo "sites (pass A + the pass-B sweep source) may build stagers"; \
+	  exit 1; \
+	fi; \
+	n=$$(grep -c "BackgroundStager *(" pipelinedp_tpu/streaming.py); \
+	if [ "$$n" -gt 2 ]; then \
+	  echo "ERROR: $$n BackgroundStager sites in pipelinedp_tpu/streaming.py"; \
+	  echo "(max 2: pass A + the sweep planner's run_sweep) — pass-B"; \
+	  echo "restreaming must go through the sweep planner"; \
+	  exit 1; \
+	fi; \
+	echo "nostager: OK"
 
 # Lint-style check: no library/bench code path may call time.sleep
 # directly — waits must route through the injectable

@@ -389,6 +389,129 @@ def bench_streaming(n_rows):
     return rec
 
 
+def bench_streamed_percentile(n_rows):
+    """Streamed two-pass percentiles: the pass-B sweep planner's
+    driver-witnessed evidence. Emits TWO records:
+
+    * ``dp_streamed_percentile_rows_per_sec`` — the default-cap run,
+      with the pass-B source (device_cache / hybrid / reship), sweep
+      count and reshipped bytes in the record;
+    * ``pass_b_sweep`` — the same workload under a shrunken
+      ``je._SUBHIST_BYTE_CAP`` seam that forces the multi-tile sweep
+      path (>= 4 tiles), so a CPU bench run witnesses the round-count
+      collapse (``pass_b_sweeps`` < ``pass_b_tiles``) and the
+      bit-parity against the default-cap run — not just the one-tile
+      fast case."""
+    import os
+
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu import jax_engine as je
+    from pipelinedp_tpu import streaming as streaming_mod
+    from pipelinedp_tpu.backends import JaxBackend
+
+    rng = np.random.default_rng(13)
+    parts = 3_000
+    ds = pdp.ArrayDataset(
+        privacy_ids=rng.integers(0, 1 << 20, n_rows).astype(np.int32),
+        partition_keys=(rng.zipf(1.3, n_rows) % parts).astype(np.int32),
+        values=rng.uniform(0.0, 10.0, n_rows).astype(np.float32))
+    params = pdp.AggregateParams(
+        metrics=[pdp.Metrics.PERCENTILE(50), pdp.Metrics.PERCENTILE(90),
+                 pdp.Metrics.PERCENTILE(99), pdp.Metrics.VARIANCE],
+        noise_kind=pdp.NoiseKind.LAPLACE,
+        max_partitions_contributed=4, max_contributions_per_partition=2,
+        min_value=0.0, max_value=10.0)
+
+    prev = os.environ.get(streaming_mod._CHUNK_ENV)
+    did_set = False
+    if n_rows <= streaming_mod.stream_chunk_rows():
+        os.environ[streaming_mod._CHUNK_ENV] = str(max(n_rows // 6,
+                                                       1000))
+        did_set = True
+
+    def run(label):
+        ds.invalidate_cache()
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                        total_delta=1e-6)
+        engine = pdp.DPEngine(acc, JaxBackend(rng_seed=0))
+        result = engine.aggregate(ds, params, pdp.DataExtractors(),
+                                  public_partitions=list(range(parts)))
+        acc.compute_budgets()
+        with tracer().span(f"bench.pct_stream.{label}",
+                           cat="bench") as sp:
+            out = dict(result)
+        return out, sp.duration, result.timings or {}
+
+    try:
+        out, dt, timings = run("default")
+        rec = {
+            "metric": "dp_streamed_percentile_rows_per_sec",
+            "value": round(n_rows / dt),
+            "unit": "rows/s",
+            "rows": n_rows,
+            "partitions": parts,
+            "total_s": round(dt, 3),
+            "stream_batches": timings.get("stream_batches"),
+            "pass_b_source": timings.get("stream_pass_b"),
+            "pass_b_sweeps": timings.get("stream_pass_b_sweeps"),
+            "pass_b_tiles": timings.get("stream_pass_b_tiles"),
+            "pass_b_reshipped_bytes": timings.get(
+                "stream_pass_b_reshipped_bytes"),
+        }
+        log(f"## streamed percentiles: {n_rows} rows "
+            f"({rec['stream_batches']} batches) in {dt:.1f}s; pass B "
+            f"{rec['pass_b_sweeps']} sweep(s) over "
+            f"{rec['pass_b_tiles']} tile(s) from {rec['pass_b_source']}"
+            f", {rec['pass_b_reshipped_bytes']} bytes reshipped")
+        emit(rec)
+
+        # The multi-tile sweep path under an injected cap: budget for
+        # 5/8 of one [P_pad, 1, span] block, so the planner must tile
+        # AND pack (sweeps strictly below tiles on this shape).
+        _, _, _, span = streaming_mod._tree_consts()
+        P_pad = je._pad_pow2(parts)
+        cap = max(4, (5 * P_pad) // 8) * span * 4
+        saved_cap = je._SUBHIST_BYTE_CAP
+        je._SUBHIST_BYTE_CAP = cap
+        try:
+            out2, dt2, t2 = run("capped")
+        finally:
+            je._SUBHIST_BYTE_CAP = saved_cap
+        fields = ("percentile_50", "percentile_90", "percentile_99")
+        parity = all(getattr(out2[p], f) == getattr(out[p], f)
+                     for p in range(parts) for f in fields)
+        rec2 = {
+            "metric": "pass_b_sweep",
+            "rows": n_rows,
+            "partitions": parts,
+            "subhist_cap_bytes": cap,
+            "pass_b_tiles": t2.get("stream_pass_b_tiles"),
+            "pass_b_tiles_per_sweep": t2.get(
+                "stream_pass_b_tiles_per_sweep"),
+            "pass_b_sweeps": t2.get("stream_pass_b_sweeps"),
+            "pass_b_source": t2.get("stream_pass_b"),
+            "pass_b_reshipped_bytes": t2.get(
+                "stream_pass_b_reshipped_bytes"),
+            "total_s": round(dt2, 3),
+            "parity_vs_default_cap": "ok" if parity else "MISMATCH",
+        }
+        if not parity:
+            log("## PASS-B SWEEP PARITY MISMATCH vs the default cap")
+        log(f"## pass-B sweep (cap {cap >> 20} MiB): "
+            f"{rec2['pass_b_sweeps']} sweeps over "
+            f"{rec2['pass_b_tiles']} tiles "
+            f"({rec2['pass_b_tiles_per_sweep']}/sweep) in {dt2:.1f}s, "
+            f"parity {rec2['parity_vs_default_cap']}")
+        emit(rec2)
+        return rec, rec2
+    finally:
+        if did_set:
+            if prev is None:
+                os.environ.pop(streaming_mod._CHUNK_ENV, None)
+            else:
+                os.environ[streaming_mod._CHUNK_ENV] = prev
+
+
 def roofline_probe(ds):
     """Roofline numbers for the fused kernel's dominant device ops on this
     chip: the 3-key lexsort and one per-pk segment_sum, reported as
@@ -761,6 +884,11 @@ def main():
         # claims (t_noise / t_hist / t_walk / t_total).
         walk_breakdown_probe(max(1 << 16, q_parts),
                              min(q_rows, 4_000_000))
+
+        # Streamed two-pass percentiles + the pass-B multi-tile sweep
+        # record (shrunken cap seam, so CPU runs witness the
+        # round-count collapse too).
+        bench_streamed_percentile(60_000 if args.smoke else 2_000_000)
 
         # Config 5: the analysis epsilon-sweep.
         bench_analysis_sweep(a_rows, max(1000, a_rows // 25),

@@ -51,6 +51,14 @@ class JaxBackend(LocalBackend):
         (default) follows ``PIPELINEDP_TPU_INGEST_EXECUTOR`` (on unless
         0). Both modes are bit-identical; off = the serial reference
         path.
+      stream_cache: per-device HBM budget (bytes) for keeping streamed
+        batches device-resident so percentile pass B re-reads them from
+        HBM instead of re-shipping over the host link. None (default)
+        follows ``PIPELINEDP_TPU_STREAM_CACHE`` (4 GiB); 0 disables.
+        The cache is a PREFIX cache: on overflow the cached batch
+        prefix stays resident and only the suffix re-ships each pass-B
+        sweep (``pass_b_source: "hybrid"``). All three sources —
+        device_cache / hybrid / reship — are bit-identical.
 
     Constructing the backend also wires JAX's persistent compilation
     cache when ``PIPELINEDP_TPU_COMPILE_CACHE`` names a directory, so
@@ -62,7 +70,8 @@ class JaxBackend(LocalBackend):
     def __init__(self, mesh=None, rng_seed: Optional[int] = None,
                  checkpoint=None, health_policy=None, clock=None,
                  probe_timeout_s: Optional[float] = None,
-                 ingest_executor: Optional[bool] = None):
+                 ingest_executor: Optional[bool] = None,
+                 stream_cache: Optional[int] = None):
         import os
 
         from pipelinedp_tpu.ingest import maybe_enable_compile_cache
@@ -73,6 +82,7 @@ class JaxBackend(LocalBackend):
         self.rng_seed = rng_seed
         self.checkpoint = checkpoint
         self.ingest_executor = ingest_executor
+        self.stream_cache = stream_cache
         # A prior degradation in this process pinned the platform to
         # CPU for EVERY later backend — the flag must say so even when
         # this construction ran no probe of its own.

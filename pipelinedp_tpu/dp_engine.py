@@ -117,19 +117,21 @@ class DPEngine:
     _supports_fused_dispatch = True
 
     def _fused_backend_options(self):
-        """(fused?, rng_seed, mesh, checkpoint, ingest_executor) — the
-        one place probing the backend's fused capability and options."""
+        """(fused?, rng_seed, mesh, checkpoint, ingest_executor,
+        stream_cache) — the one place probing the backend's fused
+        capability and options."""
         if not (self._supports_fused_dispatch and getattr(
                 self._backend, "supports_fused_aggregation", False)):
-            return False, None, None, None, None
+            return False, None, None, None, None, None
         return (True, getattr(self._backend, "rng_seed", None),
                 getattr(self._backend, "mesh", None),
                 getattr(self._backend, "checkpoint", None),
-                getattr(self._backend, "ingest_executor", None))
+                getattr(self._backend, "ingest_executor", None),
+                getattr(self._backend, "stream_cache", None))
 
     def _aggregate(self, col, params, data_extractors, public_partitions):
-        (fused, rng_seed, mesh, checkpoint,
-         ingest_executor) = self._fused_backend_options()
+        (fused, rng_seed, mesh, checkpoint, ingest_executor,
+         stream_cache) = self._fused_backend_options()
         if fused:
             from pipelinedp_tpu import jax_engine
             if jax_engine.params_are_fusable(params):
@@ -138,7 +140,8 @@ class DPEngine:
                     self._budget_accountant,
                     self._current_report_generator,
                     rng_seed=rng_seed, mesh=mesh, checkpoint=checkpoint,
-                    ingest_executor=ingest_executor)
+                    ingest_executor=ingest_executor,
+                    stream_cache=stream_cache)
         from pipelinedp_tpu import jax_engine
         if isinstance(col, jax_engine.ArrayDataset):
             col, data_extractors = jax_engine.array_dataset_to_rows(
@@ -225,7 +228,7 @@ class DPEngine:
                                           budget=budget)
 
     def _select_partitions(self, col, params, data_extractors):
-        fused, rng_seed, mesh, _, _ = self._fused_backend_options()
+        fused, rng_seed, mesh, _, _, _ = self._fused_backend_options()
         if fused:
             from pipelinedp_tpu import jax_engine
             return jax_engine.build_fused_select_partitions(

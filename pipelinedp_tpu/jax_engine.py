@@ -1189,8 +1189,7 @@ def _percentile_values(config: FusedConfig, P, qrows, scale, key):
     monotone post-processing) mirrors ``QuantileTree.compute_quantiles``.
     """
     qpk, leaf, kept = qrows
-    b = quantile_tree_ops.DEFAULT_BRANCHING_FACTOR
-    height = quantile_tree_ops.DEFAULT_TREE_HEIGHT
+    b, height, n_mid, bucket_w = quantile_tree_ops.tree_constants()
     quantiles = np.asarray([p / 100.0 for p in config.percentiles],
                            np.float32)
     Q = quantiles.shape[0]
@@ -1204,8 +1203,6 @@ def _percentile_values(config: FusedConfig, P, qrows, scale, key):
     # is a 536M-segment scatter plus 2GB temps.
     hist = None
     if height >= 2:
-        n_mid = b * b
-        bucket_w = b**(height - 2)
         hist = jax.ops.segment_sum(
             kept.astype(jnp.int32),
             qpk * n_mid + jnp.minimum(leaf // bucket_w, n_mid - 1),
@@ -1510,6 +1507,22 @@ def _subtree_counts(qpk, leaf, kept, sub_start, P, span, p_offset=None):
                                         num_segments=P * span
                                         ).reshape(P, span))
     return jnp.stack(subs, axis=1)
+
+
+def _subtree_counts_multi(qpk, leaf, kept, sub_starts, p_offsets, Pb,
+                          span):
+    """Several tiles' subtree-leaf counts from ONE pass over the rows:
+    ``sub_starts`` is [T, Pb, Qc] (each tile's walk-start leaves),
+    ``p_offsets`` [T] (each tile's first global partition), output
+    [T, Pb, Qc, span] int32. The multi-tile pass-B kernels call this so
+    one batch recompute (bounding + leaf mapping) serves every tile the
+    sweep planner packed into the round — per tile it is EXACTLY
+    ``_subtree_counts`` on the same rows, so the packed result is
+    bit-identical to the per-tile loop by construction."""
+    return jnp.stack([
+        _subtree_counts(qpk, leaf, kept, sub_starts[t], Pb, span,
+                        p_offset=p_offsets[t])
+        for t in range(sub_starts.shape[0])])
 
 
 def _walk_step(noisy, lo, hi, target, leaf_lo, done, b, w):
@@ -1900,8 +1913,10 @@ class LazyFusedResult:
     def __init__(self, rows, params: AggregateParams, config: FusedConfig,
                  data_extractors, public_partitions, specs,
                  selection_spec, rng_seed: Optional[int] = None,
-                 mesh=None, checkpoint=None, ingest_executor=None):
+                 mesh=None, checkpoint=None, ingest_executor=None,
+                 stream_cache=None):
         self._ingest_executor = ingest_executor
+        self._stream_cache = stream_cache
         self._rows = rows
         self._params = params
         self._config = config
@@ -1972,7 +1987,8 @@ class LazyFusedResult:
                         s_scale, min_count, rows_per_uid,
                         self._rng_seed, mesh=self._mesh,
                         checkpoint=self._checkpoint,
-                        executor=self._ingest_executor))
+                        executor=self._ingest_executor,
+                        cache_bytes=self._stream_cache))
             self.timings["device_s"] = tr.total("engine.device")
             self.timings["stream_batches"] = stream_stats["n_batches"]
             if "resumed_from_batch" in stream_stats:
@@ -1994,6 +2010,15 @@ class LazyFusedResult:
                 self.timings["stream_pass_b"] = stream_stats["pass_b_source"]
                 self.timings["stream_pass_b_rounds"] = (
                     stream_stats["pass_b_rounds"])
+                # Sweep-planner evidence: how many stream traversals
+                # pass B actually paid for how many (quantile-group x
+                # partition-block) tiles, and the bytes re-shipped over
+                # the host link past the device cache's prefix.
+                for k in ("pass_b_sweeps", "pass_b_tiles",
+                          "pass_b_tiles_per_sweep",
+                          "pass_b_cached_batches",
+                          "pass_b_reshipped_bytes"):
+                    self.timings[f"stream_{k}"] = stream_stats[k]
             with tr.span("engine.release", cat="engine"):
                 part64 = {k: v[:P] for k, v in part64.items()}
                 if self._public is not None:
@@ -2261,7 +2286,8 @@ def build_fused_aggregation(col, params: AggregateParams, data_extractors,
                             public_partitions, budget_accountant,
                             report_gen, rng_seed=None,
                             mesh=None, checkpoint=None,
-                            ingest_executor=None) -> LazyFusedResult:
+                            ingest_executor=None,
+                            stream_cache=None) -> LazyFusedResult:
     """Engine entry point for the fused plane: requests budgets (same
     pattern as the generic path, so the privacy semantics are identical),
     registers report stages, returns the lazy result."""
@@ -2310,4 +2336,5 @@ def build_fused_aggregation(col, params: AggregateParams, data_extractors,
                            public_partitions, specs, selection_spec,
                            rng_seed=rng_seed, mesh=mesh,
                            checkpoint=checkpoint,
-                           ingest_executor=ingest_executor)
+                           ingest_executor=ingest_executor,
+                           stream_cache=stream_cache)

@@ -17,7 +17,12 @@ This package is the one place that knowledge accumulates:
   on: retries, health degradations, checkpoint saves/resumes/refusals,
   fault injections, cache hits, and which fallback path fired are rare
   and load-bearing — invisible branches are how artifacts stop being
-  self-describing.
+  self-describing. The streamed pass-B sweep planner reports through
+  here too: ``stream.pass_b_stream_sweeps`` (batch-stream traversals
+  paid), ``stream.pass_b_tiles`` (tiles those traversals served — the
+  collapse evidence is sweeps < tiles), and
+  ``stream.pass_b_reshipped_bytes`` (host-link bytes past the device
+  cache's resident prefix).
 * :func:`build_run_report` / :func:`write_chrome_trace` — exporters:
   the schema-versioned run report (merged into bench records) and the
   Perfetto-loadable Chrome-trace file.

@@ -233,6 +233,20 @@ class QuantileTree:
 # ---------------------------------------------------------------------------
 
 
+def tree_constants(height: int = DEFAULT_TREE_HEIGHT,
+                   branching_factor: int = DEFAULT_BRANCHING_FACTOR
+                   ) -> tuple:
+    """``(b, height, n_mid, subtree_span)`` — the one derivation of the
+    fused walk's histogram shapes from the tree shape. ``n_mid = b^2``
+    is the mid-level histogram width (bucket width ``b^(height-2)``
+    serves every level whose node width is at least that), and
+    ``subtree_span = b^(height-2)`` is the leaf count of one chosen
+    subtree at the first bottom level — the trailing dimension of every
+    pass-B ``[P, Q, span]`` block the sweep planner budgets against."""
+    b = branching_factor
+    return b, height, b * b, b**(height - 2)
+
+
 def dense_level_slices(height: int = DEFAULT_TREE_HEIGHT,
                        branching_factor: int = DEFAULT_BRANCHING_FACTOR
                        ) -> List[tuple]:

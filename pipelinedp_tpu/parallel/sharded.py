@@ -58,6 +58,21 @@ _CHECK_KW = ("check_vma" if "check_vma" in inspect.signature(
     shard_map).parameters else "check_rep")
 
 
+def combine_shards(x, axis, dim, replicate):
+    """The ONE cross-shard exchange policy for every streaming kernel:
+    owner-block ``psum_scatter`` along ``dim`` (state/ICI O(P/n_dev))
+    when each device should keep only its owned partition block, a
+    replicating ``psum`` (every device holds the full result) when the
+    output must be host-addressable everywhere — multi-process meshes
+    (another process's owner block is not host-addressable) and pass-B
+    tile blocks (at most the sub-histogram byte cap by construction,
+    and ``psum`` has no divisibility constraint on the block size)."""
+    if replicate:
+        return jax.lax.psum(x, axis)
+    return jax.lax.psum_scatter(x, axis, scatter_dimension=dim,
+                                tiled=True)
+
+
 def make_mesh(n_devices: Optional[int] = None, axis_name: str = "data"
               ) -> Mesh:
     from pipelinedp_tpu import obs

@@ -47,6 +47,11 @@ class FaultPlan:
     wedged_init: int = 0
     #: streaming chunk indices whose processing raises ``ChunkFailure``.
     fail_chunks: Tuple[int, ...] = ()
+    #: batch indices whose percentile pass-B sweep dispatch raises
+    #: ``ChunkFailure`` (pass A re-uses the same indices and survives,
+    #: so the kill lands mid-sweep — the pass-B drain tests need a
+    #: fault that pass A cannot consume first).
+    fail_pass_b_chunks: Tuple[int, ...] = ()
     #: first N coordinator connections raise ``CoordinatorTimeout``.
     coordinator_timeouts: int = 0
 
@@ -57,6 +62,9 @@ class FaultPlan:
         if self.fail_chunks:
             parts.append("fail_chunks=" +
                          ":".join(str(c) for c in self.fail_chunks))
+        if self.fail_pass_b_chunks:
+            parts.append("fail_pass_b_chunks=" +
+                         ":".join(str(c) for c in self.fail_pass_b_chunks))
         if self.coordinator_timeouts:
             parts.append(f"coordinator_timeouts={self.coordinator_timeouts}")
         return ",".join(parts)
@@ -69,7 +77,7 @@ def plan_from_env(spec: str) -> FaultPlan:
         if not item:
             continue
         k, _, v = item.partition("=")
-        if k == "fail_chunks":
+        if k in ("fail_chunks", "fail_pass_b_chunks"):
             kw[k] = tuple(int(c) for c in v.split(":") if c)
         else:
             kw[k] = int(v)
@@ -140,6 +148,14 @@ def check_chunk(index: int) -> None:
     if plan is not None and index in plan.fail_chunks:
         _record("chunk_failure", index=int(index))
         raise ChunkFailure(f"injected failure at streaming chunk {index}")
+
+
+def check_pass_b_chunk(index: int) -> None:
+    plan = active()
+    if plan is not None and index in plan.fail_pass_b_chunks:
+        _record("pass_b_chunk_failure", index=int(index))
+        raise ChunkFailure(
+            f"injected failure at pass-B sweep batch {index}")
 
 
 def check_coordinator() -> None:

@@ -38,7 +38,16 @@ walk's adaptive descent needs the chosen subtrees' leaf counts, which
 only exist after the top levels are walked — so pass A accumulates the
 additive mid-level tree histogram alongside the scalar partials, the
 top levels walk on it, pass B re-streams the same deterministic batches
-for the subtree leaf histograms, and the bottom levels finish. With the
+for the subtree leaf histograms, and the bottom levels finish. When the
+full [P, Q, span] subtree block exceeds ``je._SUBHIST_BYTE_CAP``, the
+SWEEP PLANNER (:func:`plan_pass_b_sweeps`) tiles the (quantile x
+partition) grid and packs as many tiles as fit under the cap into each
+batch-stream traversal — the multi-tile kernels scatter one batch's
+rows into every packed tile's histogram from a single bounding
+recompute, so pass B pays ``ceil(tiles / tiles_per_sweep)`` sweeps
+instead of one per tile. Batches re-read from the device-resident
+PREFIX cache where it reaches (overflow keeps the cached prefix and
+reships only the suffix — the hybrid source). With the
 engine's seed the streamed walk sees the same exact histograms, the
 same counter-keyed node noise (a pure function of (partition, node id)
 — ``ops/counter_rng.py``) and the same selection/noise key splits as
@@ -149,21 +158,17 @@ def _rank1_names(config, fx_bits: int):
 
 def _tree_consts():
     from pipelinedp_tpu.ops import quantile_tree as qt
-    b = qt.DEFAULT_BRANCHING_FACTOR
-    height = qt.DEFAULT_TREE_HEIGHT
-    return b, height, b * b, b**(height - 2)  # (b, height, n_mid, bucket_w)
+    return qt.tree_constants()  # (b, height, n_mid, bucket_w == span)
 
 
 def _combine_shards(x, axis, dim, multiproc):
-    """The ONE cross-shard exchange policy for every streaming kernel:
-    owner-block ``psum_scatter`` along ``dim`` (state/ICI O(P/n_dev))
-    on a single-controller mesh; replicating ``psum`` (every process
-    fetches its own copy — another process's owner block is not
-    host-addressable) on a multi-process mesh."""
-    if multiproc:
-        return jax.lax.psum(x, axis)
-    return jax.lax.psum_scatter(x, axis, scatter_dimension=dim,
-                                tiled=True)
+    """Delegates to :func:`parallel.sharded.combine_shards` — the ONE
+    cross-shard exchange policy: owner-block ``psum_scatter`` along
+    ``dim`` on a single-controller mesh; replicating ``psum`` on a
+    multi-process mesh (another process's owner block is not
+    host-addressable)."""
+    from pipelinedp_tpu.parallel import sharded as psh
+    return psh.combine_shards(x, axis, dim, multiproc)
 
 
 def _chunk_body(config, num_partitions, planes, values, n_valid, key,
@@ -240,6 +245,124 @@ def _pct_sub_kernel(config, num_partitions, planes, values, n_valid, key,
     _, _, _, span = _tree_consts()
     return je._subtree_counts(qpk, leaf, kept, sub_start, n_block, span,
                               p_offset=p_offset)
+
+
+@functools.partial(jax.jit, static_argnames=("config", "num_partitions",
+                                             "fx_bits", "n_pid_planes",
+                                             "n_block"))
+def _pct_multi_sub_kernel(config, num_partitions, planes, values, n_valid,
+                          key, fx_bits, n_pid_planes, sub_starts,
+                          p_offsets, n_block):
+    """Multi-tile pass B: ONE bounding recompute of the chunk's rows
+    (same key -> identical bounding sample as pass A) scatters into
+    EVERY tile the sweep planner packed into this round —
+    ``sub_starts`` [T, Pb, Qc], ``p_offsets`` [T], output
+    [T, Pb, Qc, span] int32, additive across chunks. Per tile the
+    counts are exactly ``_pct_sub_kernel``'s, so the packed sweep is
+    bit-identical to the per-tile loop while paying the batch stream
+    (and the row recompute) once instead of T times."""
+    _, _, qrows = _chunk_body(config, num_partitions, planes, values,
+                              n_valid, key, fx_bits, n_pid_planes)
+    qpk, leaf, kept = qrows
+    _, _, _, span = _tree_consts()
+    return je._subtree_counts_multi(qpk, leaf, kept, sub_starts,
+                                    p_offsets, n_block, span)
+
+
+@dataclasses.dataclass(frozen=True)
+class PassBPlan:
+    """The sweep planner's output: how pass B covers the (quantile x
+    partition) grid. ``tiles`` are [p_blk, q_chunk]-shaped
+    (quantile-group, partition-block) units in walk order (quantile
+    groups outer, partition blocks inner — the last group/block may be
+    smaller); ``sweeps`` packs consecutive same-shape tiles so that one
+    batch-stream traversal serves every tile in the pack while the
+    combined [T, Pb, Qc, span] sub-histogram stays within the byte
+    cap. One tile covering the full grid (the common case) is one
+    sweep; the planner's job is to make the chunked regime pay
+    ``len(sweeps)`` stream reads instead of ``len(tiles)``."""
+    q_chunk: int                 #: quantiles per (full) tile
+    p_blk: int                   #: partitions per (full) tile
+    tiles_per_sweep: int         #: cap // tile_units for a full tile
+    tiles: Tuple[Tuple[int, int, int], ...]        #: (q0, qc, p0)
+    sweeps: Tuple[Tuple[Tuple[int, int, int], ...], ...]
+
+    @property
+    def n_tiles(self) -> int:
+        return len(self.tiles)
+
+    @property
+    def n_sweeps(self) -> int:
+        return len(self.sweeps)
+
+    @property
+    def chunked(self) -> bool:
+        return len(self.tiles) > 1
+
+
+def plan_pass_b_sweeps(P_pad, Q, span, cap) -> PassBPlan:
+    """Sizes pass B's stream sweeps BEFORE anything streams. The device
+    budget is ``cap`` bytes of int32 [.., span] subtree block; the unit
+    of account is one [1, 1, span] block. The planner searches the
+    (q_chunk, p_blk) tile grids whose tiles fit the cap and picks the
+    one minimizing STREAM SWEEPS — the round count the host link pays —
+    tie-breaking toward fewer tiles (fewer scatters + walk launches),
+    then larger partition blocks (the historical per-tile shapes, so
+    the non-packable regimes keep their exact old round structure).
+    Past the cap, capacity becomes extra sweeps (a time cost), never a
+    refusal; only a cap below a single [1, 1, span] block (necessarily
+    test-shrunken) raises."""
+    unit = span * 4
+    if unit > cap:
+        raise NotImplementedError(
+            f"streamed percentiles need one [1, 1, {span}] "
+            f"subtree block ({unit} bytes) within "
+            "_SUBHIST_BYTE_CAP — the cap is below a single "
+            "partition's block")
+    budget = cap // unit  # [1, 1, span] blocks per sweep
+    if P_pad * Q <= budget:
+        tile = ((0, Q, 0),)
+        return PassBPlan(Q, P_pad, 1, tile, (tile,))
+    # Candidate partition blocks: the full axis (which may be a
+    # non-pow2 multiple of the mesh size) plus the powers of two that
+    # DIVIDE it — divisibility keeps every partition block full-size,
+    # so the sweep estimate below is exactly what the greedy packer
+    # produces (a non-dividing pb would alternate block shapes per
+    # q-group and fragment the same-shape packing runs).
+    pbs = sorted({P_pad} | {1 << k for k in range(P_pad.bit_length())
+                            if P_pad % (1 << k) == 0},
+                 reverse=True)
+    best = None
+    for qc in range(1, Q + 1):
+        for pb in pbs:
+            if qc * pb > budget:
+                continue
+            t_full = budget // (qc * pb)
+            n_pb = P_pad // pb
+            n_fullq, rq = divmod(Q, qc)
+            n_tiles = (n_fullq + (1 if rq else 0)) * n_pb
+            sweeps = -(-(n_fullq * n_pb) // t_full)
+            if rq:
+                sweeps += -(-n_pb // (budget // (rq * pb)))
+            key = (sweeps, n_tiles, -pb, -qc)
+            if best is None or key < best[0]:
+                best = (key, qc, pb, t_full)
+    _, qc, pb, t_full = best
+    tiles = tuple((q0, min(qc, Q - q0), p0)
+                  for q0 in range(0, Q, qc)
+                  for p0 in range(0, P_pad, pb))
+    sweeps = []
+    i = 0
+    while i < len(tiles):
+        qn, pn = tiles[i][1], min(pb, P_pad - tiles[i][2])
+        t_cap = max(1, budget // (qn * pn))
+        j = i
+        while (j < len(tiles) and j - i < t_cap and tiles[j][1] == qn
+               and min(pb, P_pad - tiles[j][2]) == pn):
+            j += 1
+        sweeps.append(tiles[i:j])
+        i = j
+    return PassBPlan(qc, pb, t_full, tiles, tuple(sweeps))
 
 
 @functools.partial(jax.jit, static_argnames=("config", "num_partitions",
@@ -333,6 +456,44 @@ def _sharded_pct_sub_kernel(config, num_partitions, mesh, planes, values,
         out_specs=repl if (multiproc or blocked) else shard,
         **{psh._CHECK_KW: False})
     return mapped(planes, values, n_valid_shard, key, sub_start, p_offset)
+
+
+@functools.partial(jax.jit, static_argnames=("config", "num_partitions",
+                                             "mesh", "fx_bits",
+                                             "n_pid_planes", "n_block"))
+def _sharded_pct_multi_sub_kernel(config, num_partitions, mesh, planes,
+                                  values, n_valid_shard, key, fx_bits,
+                                  n_pid_planes, sub_starts, p_offsets,
+                                  n_block):
+    """Mesh twin of ``_pct_multi_sub_kernel``: each shard recomputes its
+    bounded rows once (same per-shard key derivation as pass A) and
+    scatters them into every packed tile's [Pb, Qc, span] block; the
+    [T, Pb, Qc, span] stack combines across shards with the replicating
+    ``psum`` (the blocked-tile policy of ``_sharded_pct_sub_kernel``:
+    the combined stack is at most the byte cap by construction, and
+    psum has no divisibility constraint on the block sizes)."""
+    from pipelinedp_tpu.parallel import sharded as psh
+    axis = mesh.axis_names[0]
+    _, _, _, span = _tree_consts()
+
+    def local_fn(planes, values, n_valid, key, sub_starts, p_offsets):
+        k_bound = jax.random.fold_in(key, jax.lax.axis_index(axis))
+        _, _, qrows = _chunk_body(config, num_partitions, planes,
+                                  values, n_valid[0], k_bound, fx_bits,
+                                  n_pid_planes)
+        qpk, leaf, kept = qrows
+        sub = je._subtree_counts_multi(qpk, leaf, kept, sub_starts,
+                                       p_offsets, n_block, span)
+        return psh.combine_shards(sub, axis, 0, True)
+
+    shard, repl = psh.PSpec(axis), psh.PSpec()
+    mapped = psh.shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(tuple(shard for _ in planes), shard, shard, repl, repl,
+                  repl),
+        out_specs=repl, **{psh._CHECK_KW: False})
+    return mapped(planes, values, n_valid_shard, key, sub_starts,
+                  p_offsets)
 
 
 @functools.partial(jax.jit, static_argnames=("config", "P"))
@@ -450,7 +611,8 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                                sel_threshold, sel_scale, sel_min_count,
                                sel_rows_per_uid, rng_seed: Optional[int],
                                mesh=None, checkpoint=None,
-                               executor: Optional[bool] = None
+                               executor: Optional[bool] = None,
+                               cache_bytes: Optional[int] = None
                                ) -> Tuple[np.ndarray, Dict, Dict]:
     """Runs the streaming aggregation. Returns ``(keep[P_pad] bool,
     part64, stats)`` where ``part64`` holds the combined float64/int64
@@ -467,6 +629,13 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
     set to 0). The overlapped and serial paths are BIT-IDENTICAL —
     the fold worker preserves the exact left-fold float64 operation
     sequence and checkpoint order — proven by ``tests/test_ingest.py``.
+
+    ``cache_bytes`` overrides the pass-B device-cache budget
+    (``PIPELINEDP_TPU_STREAM_CACHE`` when None; 0 disables). The cache
+    is a PREFIX cache: on overflow the already-cached batch prefix
+    stays device-resident and only the suffix re-ships per pass-B sweep
+    (``pass_b_source: "hybrid"``) — one batch over budget no longer
+    forces 100% reship.
 
     ``checkpoint`` (a ``resilience.checkpoint.CheckpointStore`` or path)
     enables budget-safe resume: the host accumulators are pure monoid
@@ -535,41 +704,35 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
     k_bound, k_sel, k_noise = jax.random.split(key, 3)
 
     if config.percentiles:
-        # Size pass B's [Pb, Qc, span] subtree blocks BEFORE streaming
-        # anything: quantiles walk in groups of ``q_chunk`` so the
-        # block never exceeds the device budget, and when even ONE
-        # quantile's [P_pad, 1, span] block overflows, the partition
-        # axis chunks into blocks of ``p_blk`` walked one at a time —
-        # past the cap, capacity becomes extra pass-B rounds (a time
-        # cost), never a refusal. Node noise is a pure function of the
-        # GLOBAL (partition, node id), so the chunked walk is
-        # bit-identical to the unchunked one wherever both run. Only a
-        # cap below a single [1, 1, span] block (necessarily
-        # test-shrunken) is refused.
+        # Plan pass B's sweeps BEFORE streaming anything: the planner
+        # tiles the (quantile x partition) grid so each [Pb, Qc, span]
+        # tile fits the device budget, then packs as many tiles as fit
+        # under ``je._SUBHIST_BYTE_CAP`` into one stream sweep — past
+        # the cap, capacity becomes extra sweeps (a time cost), never a
+        # refusal. Node noise is a pure function of the GLOBAL
+        # (partition, node id), so any tiling walks bit-identically to
+        # the unchunked descent. Only a cap below a single [1, 1, span]
+        # block (necessarily test-shrunken) is refused.
         _, _, _, span = _tree_consts()
-        per_q_bytes = P_pad * span * 4
-        q_chunk = max(1, je._SUBHIST_BYTE_CAP // per_q_bytes)
-        p_blk = P_pad
-        if per_q_bytes > je._SUBHIST_BYTE_CAP:
-            if span * 4 > je._SUBHIST_BYTE_CAP:
-                obs.inc("walk.path_streamed_refusal")
-                obs.event("walk.fallback", path="streamed_refusal",
-                          span_bytes=span * 4,
-                          cap=int(je._SUBHIST_BYTE_CAP))
-                raise NotImplementedError(
-                    f"streamed percentiles need one [1, 1, {span}] "
-                    f"subtree block ({span * 4} bytes) within "
-                    "_SUBHIST_BYTE_CAP — the cap is below a single "
-                    "partition's block")
-            p_blk = 1 << ((je._SUBHIST_BYTE_CAP // (span * 4))
-                          .bit_length() - 1)
-        if p_blk < P_pad or q_chunk < len(config.percentiles):
-            # The guard-cliff path fired: extra pass-B rounds instead
-            # of a refusal — record WHICH shape triggered it.
+        try:
+            plan = plan_pass_b_sweeps(P_pad, len(config.percentiles),
+                                      span, je._SUBHIST_BYTE_CAP)
+        except NotImplementedError:
+            obs.inc("walk.path_streamed_refusal")
+            obs.event("walk.fallback", path="streamed_refusal",
+                      span_bytes=span * 4,
+                      cap=int(je._SUBHIST_BYTE_CAP))
+            raise
+        if plan.chunked:
+            # The guard-cliff path fired: extra pass-B sweeps instead
+            # of a refusal — record WHICH shape triggered it and how
+            # the planner packed it.
             obs.inc("walk.path_partition_block_chunked")
             obs.event("walk.fallback", path="partition_block_chunked",
-                      p_blk=int(p_blk), q_chunk=int(q_chunk),
-                      P_pad=int(P_pad))
+                      p_blk=int(plan.p_blk), q_chunk=int(plan.q_chunk),
+                      P_pad=int(P_pad), tiles=plan.n_tiles,
+                      tiles_per_sweep=plan.tiles_per_sweep,
+                      sweeps=plan.n_sweeps)
 
     order, counts = _batch_assignment(config, encoded, n_batches, seed,
                                       n_dev)
@@ -660,25 +823,48 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                 je._plane_spec(int(encoded.pid.max(initial=0))))
     pk_spec = je._plane_spec(int(encoded.pk.max(initial=0)))
 
-    # Staging-buffer strategy. Percentile configs may RETAIN shipped
-    # arrays (the device cache feeds pass B), so they keep fresh-copy
+    # Device-resident batch cache: percentile pass B re-reads shipped
+    # batches from HBM instead of paying the host link twice. Bounded
+    # by the per-device HBM budget; overflow FREEZES the cache (the
+    # prefix stays resident, pass B reships only the suffix — the
+    # hybrid source). A RESUMED run never caches: the skipped batch
+    # prefix is absent, so a partial cache would silently drop those
+    # rows from pass B.
+    cache_cap = (stream_cache_bytes() if cache_bytes is None
+                 else int(cache_bytes))
+    cache: Optional[list] = ([] if config.percentiles and
+                             start_batch == 0 and cache_cap > 0
+                             else None)
+    cache_used = 0
+    cache_frozen = False
+    cache_upto = 0   # first batch index NOT in the cached prefix
+    reship_bytes = [0]  # pass-B host->device traffic (mutated by gen)
+
+    # Staging-buffer strategy. Runs that FEED the device cache may
+    # retain shipped arrays indefinitely, so they keep fresh-copy
     # semantics: a fresh values buffer per batch, i32-mode planes
-    # copied. Everything else stages into a rotating PAIR of buffer
+    # copied. Everything else (including percentile runs whose cache is
+    # disabled or resumed away) stages into a rotating PAIR of buffer
     # sets and ships the narrowed planes without defensive copies:
     # ``device_put`` may zero-copy a numpy array, so a set is reused
     # only after the batch staged from it had its OUTPUTS fetched
     # (``StagingRing`` — a fetch proves the kernel consumed its
     # inputs), i.e. two batches later at the earliest.
-    copy_mode = bool(config.percentiles)
+    copy_mode = cache is not None
     ring = None if copy_mode else ingest.StagingRing(2)
 
-    def batches(start_at=0, cancelled=None):
+    def batches(start_at=0, cancelled=None, ring=ring,
+                track_reship=False):
         """Ships the deterministic batch sequence to the device; pass A
         and pass B (percentiles) iterate it identically, on the caller's
         thread (serial path) or on the executor's stager thread
-        (``cancelled`` is the stager's teardown event). Staging buffers
-        rotate per the ``copy_mode``/``ring`` policy above; tails past
-        each shard cell's row count are re-zeroed on reuse (the kernel
+        (``cancelled`` is the stager's teardown event). ``ring`` is the
+        buffer-reuse gate: None means fresh-copy staging (retention
+        safe — the pass-A path that feeds the device cache), a
+        ``StagingRing`` means rotating buffer sets (pass A's default,
+        and every pass-B reship sweep — retention is only needed while
+        FEEDING the cache, which pass B never does). Tails past each
+        shard cell's row count are re-zeroed on reuse (the kernel
         masks rows past n_valid, so no invariant rests on padding
         content — the zeroing just keeps shipped bytes deterministic).
 
@@ -689,14 +875,15 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
         (b, planes, values_d, nv, n_pid_planes) where ``nv`` is the
         device-ready valid-row count (scalar, or [n_dev] sharded)."""
         buf_len = n_dev * pad_rows
+        copy = ring is None  # fresh-copy staging vs rotating buffers
         zeros_dev = None  # shared zero values for COUNT-style runs
-        n_sets = 1 if ring is None else ring.n_slots
+        n_sets = 1 if copy else ring.n_slots
         pid_bufs = [np.zeros(buf_len, np.int32) for _ in range(n_sets)]
         pk_bufs = [np.zeros(buf_len, np.int32) for _ in range(n_sets)]
         vshape = ((buf_len, config.vector_size)
                   if config.vector_size else (buf_len,))
         val_bufs = ([np.zeros(vshape, np.float32) for _ in range(n_sets)]
-                    if config.needs_values and not copy_mode else None)
+                    if config.needs_values and not copy else None)
         offset = 0
         staged = 0
         for b in range(n_batches):
@@ -718,7 +905,7 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                 s = staged % n_sets
                 staged += 1
                 pid_b, pk_b = pid_bufs[s], pk_bufs[s]
-                if copy_mode:
+                if copy:
                     # Fresh values buffer every batch: the pass-B
                     # device cache may retain what ships, indefinitely.
                     values_b = (np.zeros(vshape, np.float32)
@@ -741,12 +928,12 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                     pk_b[s0 + cnt:s0 + pad_rows] = 0
                     if values_b is not None:
                         values_b[s0:s0 + cnt] = encoded.values[rows]
-                        if not copy_mode:
+                        if not copy:
                             values_b[s0 + cnt:s0 + pad_rows] = 0
                 pid_planes = je._narrow_ids(pid_b, pid_spec)
                 n_pid_planes = len(pid_planes)
                 host = [*pid_planes, *je._narrow_ids(pk_b, pk_spec)]
-                if copy_mode:
+                if copy:
                     # _narrow_ids returns fresh plane arrays except in
                     # "i32" mode, where it returns the staging buffer
                     # itself — copy those so a retained (cached) ship
@@ -756,6 +943,13 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                             for p in host]
                 if values_b is not None:
                     host.append(values_b)
+                if track_reship:
+                    # Pass-B reship accounting: the host->device bytes
+                    # this sweep pays past the cached prefix — the
+                    # evidence the hybrid cache exists to shrink.
+                    nb = sum(int(a.nbytes) for a in host)
+                    reship_bytes[0] += nb
+                    obs.inc("stream.pass_b_reshipped_bytes", nb)
                 if row_sharding is None:
                     dev = jax.device_put(tuple(host))  # one transfer
                     nv = jnp.int32(int(ccounts[0]))
@@ -800,16 +994,6 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
             v64 = np.asarray(vec).astype(np.float64)
             vec_acc = v64 if vec_acc is None else vec_acc + v64
 
-    # Device-resident batch cache: percentile pass B re-reads shipped
-    # batches from HBM instead of paying the host link twice. Bounded
-    # by ``stream_cache_bytes()``; overflow drops the WHOLE cache (a
-    # partial cache would split pass B across two iteration sources).
-    # A RESUMED run never caches: the skipped batch prefix is absent, so
-    # a partial cache would silently drop those rows from pass B.
-    cache: Optional[list] = ([] if config.percentiles and
-                             start_batch == 0 else None)
-    cache_bytes = 0
-    cache_cap = stream_cache_bytes()
     n_saves = 0
     # Folds between checkpoint writes; clamped to >= 1 (0 would divide
     # by zero below — disable checkpointing by not passing a store).
@@ -861,7 +1045,7 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
         returns device futures) — always on the dispatch thread, so
         injected ``ChunkFailure``s sever the run at a deterministic
         chunk boundary in both executor modes."""
-        nonlocal cache, cache_bytes
+        nonlocal cache_used, cache_frozen, cache_upto
         b, planes, values_d, nv, n_pid_planes = item
         # Injectable kill point: tests sever the run at chunk b and
         # assert the checkpointed resume is bit-identical.
@@ -876,19 +1060,25 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                 packed, vec, mid = _sharded_partials_kernel(
                     config, P_pad, mesh, planes, values_d, nv, kb,
                     fx_bits, n_pid_planes=n_pid_planes)
-        if cache is not None:
+        if cache is not None and not cache_frozen:
             # The budget is PER-DEVICE HBM: on a mesh the arrays are
             # row-sharded, so each device holds 1/n_dev of the bytes.
-            cache_bytes += (sum(int(p.nbytes) for p in planes) +
-                            int(values_d.nbytes)) // n_dev
-            if cache_bytes <= cache_cap:
+            batch_hbm = (sum(int(p.nbytes) for p in planes) +
+                         int(values_d.nbytes)) // n_dev
+            if cache_used + batch_hbm <= cache_cap:
+                cache_used += batch_hbm
                 cache.append((b, planes, values_d, nv, n_pid_planes))
+                cache_upto = b + 1
             else:
-                cache = None
+                # Overflow FREEZES the cache instead of dropping it:
+                # the resident prefix keeps serving pass B from HBM and
+                # only the suffix reships per sweep (hybrid source).
+                cache_frozen = True
                 obs.inc("stream.cache_overflow")
                 obs.event("stream.cache_overflow",
-                          cache_bytes=int(cache_bytes),
-                          cap=int(cache_cap))
+                          cache_bytes=int(cache_used + batch_hbm),
+                          cap=int(cache_cap),
+                          prefix_batches=len(cache))
         return b, packed, vec, mid
 
     with tr.span("ingest.pass_a", cat="ingest", n_batches=n_batches,
@@ -995,86 +1185,156 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                 obs.device_annotation("pdp.walk_top"):
             lo, hi, target, leaf_lo, done = _walk_top_kernel(
                 config, P_pad, mid_acc, k_tree, scale)
-        if mesh is not None:
-            # The walk state is tiny ([P, Q]); host-fetch it once and
-            # re-feed replicated — the sharded pass-B kernel's in_specs
-            # stay simple and independent of what sharding GSPMD chose
-            # for the top walk's outputs.
-            lo, hi, target, leaf_lo, done = (
-                np.asarray(lo), np.asarray(hi), np.asarray(target),
-                np.asarray(leaf_lo), np.asarray(done))
+        # The walk state is tiny ([P, Q]); host-fetch it once so the
+        # planner slices plain numpy tiles (and on a mesh the sharded
+        # pass-B kernel's in_specs stay independent of what sharding
+        # GSPMD chose for the top walk's outputs).
+        lo, hi, target, leaf_lo, done = (
+            np.asarray(lo), np.asarray(hi), np.asarray(target),
+            np.asarray(leaf_lo), np.asarray(done))
         sub_start = leaf_lo
-        # Re-read shipped batches from the device cache when they all
-        # fit (same (b, arrays) tuples -> identical kernel inputs, zero
-        # extra link traffic); otherwise re-stream from host.
-        stats["pass_b_source"] = ("device_cache" if cache is not None
-                                  else "reship")
+        # Batch sources per sweep: the device-cached prefix re-reads
+        # from HBM (same (b, arrays) tuples -> identical kernel inputs,
+        # zero link traffic); past it — overflow suffix (hybrid) or the
+        # whole stream (reship) — batches re-ship from host through the
+        # rotating StagingRing (fresh-copy retention is only needed
+        # while FEEDING the cache, which pass B never does).
+        prefix = cache or []
+        complete = cache is not None and not cache_frozen
+        stats["pass_b_source"] = ("device_cache" if complete
+                                  else "hybrid" if prefix else "reship")
         Q = len(config.percentiles)
         vals = np.empty((P_pad, Q), np.float32)
-        rounds = 0
 
-        def run_pass_b(source, ss_dev, p0, n_blk):
-            sub_acc = None
-            for b, planes, values_d, nv, n_pid_planes in source:
-                kb = jax.random.fold_in(k_bound, b)
-                if mesh is None:
-                    sub = _pct_sub_kernel(
-                        config, P_pad, planes, values_d, nv, kb,
-                        fx_bits, n_pid_planes=n_pid_planes,
-                        sub_start=ss_dev, p_offset=jnp.int32(p0),
-                        n_block=n_blk)
+        def run_sweep(consume):
+            """ONE traversal of the batch stream, feeding every batch
+            to ``consume(item, ring)`` — the single pass-B stream
+            source (the ``nostager`` lint pins restreaming to this
+            planner-driven loop, so per-tile restreaming cannot quietly
+            come back)."""
+            if prefix:
+                obs.inc("stream.pass_b_cache_hit_batches", len(prefix))
+            for item in prefix:
+                consume(item, None)
+            if complete:
+                return
+            obs.inc("stream.pass_b_reship_rounds")
+            ring_b = ingest.StagingRing(2)
+            if use_executor:
+                # Overlapped re-ship: stage batch b+1 on the stager
+                # thread while the device counts batch b's subtree
+                # leaves (no folds in pass B — accumulation stays on
+                # device, so only the stager is needed).
+                with ingest.BackgroundStager(
+                        lambda cancelled: batches(
+                            cache_upto, cancelled, ring=ring_b,
+                            track_reship=True),
+                        depth=1) as stager_b:
+                    for item in stager_b.items():
+                        consume(item, ring_b)
+            else:
+                for item in batches(cache_upto, ring=ring_b,
+                                    track_reship=True):
+                    consume(item, ring_b)
+
+        # One stream sweep per PACK of (quantile-group, partition-
+        # block) tiles: every tile in the sweep accumulates its
+        # [Pb, Qc, span] block from the same batch pass, then the
+        # bottom walk runs per tile off the packed result —
+        # bit-identical to the per-tile loop by construction (node
+        # noise is a pure function of the global (partition, node id),
+        # and the per-tile histograms are the same integers).
+        single_full = not plan.chunked
+        for sweep in plan.sweeps:
+            q0_s, qn, p0_s = sweep[0]
+            Pb = min(plan.p_blk, P_pad - p0_s)
+            with tr.span("ingest.pass_b_sweep", cat="ingest",
+                         tiles=len(sweep), q0=q0_s, p0=p0_s):
+                if single_full:
+                    ss_dev = jnp.asarray(sub_start)
+                    p_offs = None
                 else:
-                    sub = _sharded_pct_sub_kernel(
-                        config, P_pad, mesh, planes, values_d, nv, kb,
-                        fx_bits, n_pid_planes=n_pid_planes,
-                        sub_start=ss_dev, p_offset=jnp.int32(p0),
-                        n_block=n_blk)
-                sub_acc = sub if sub_acc is None else sub_acc + sub
-            return sub_acc
+                    ss_dev = jnp.asarray(np.stack(
+                        [sub_start[p0:p0 + Pb, q0:q0 + qn]
+                         for q0, _, p0 in sweep]))
+                    p_offs = jnp.asarray(
+                        np.asarray([p0 for _, _, p0 in sweep],
+                                   np.int32))
+                sub_cell = [None]
 
-        # One pass-B round per (quantile group, partition block); the
-        # unchunked case is exactly one block (p_blk == P_pad) and the
-        # q-chunked and p-blocked walks compose — each round streams
-        # the batches once (from the device cache when it fits).
-        for q0 in range(0, Q, q_chunk):
-            qsl = slice(q0, min(q0 + q_chunk, Q))
-            for p0 in range(0, P_pad, p_blk):
-                with tr.span("ingest.pass_b_round", cat="ingest",
-                             q0=q0, p0=p0):
-                    Pb = min(p_blk, P_pad - p0)
+                def consume(item, ring_b, ss_dev=ss_dev,
+                            p_offs=p_offs, Pb=Pb):
+                    b, planes, values_d, nv, n_pid_planes = item
+                    # Injectable kill point for the pass-B drain tests
+                    # (pass A re-uses the plain chunk indices, so a
+                    # pass-A fault could never land here).
+                    faults.check_pass_b_chunk(b)
+                    kb = jax.random.fold_in(k_bound, b)
+                    with obs.device_annotation("pdp.stream_pass_b"):
+                        if single_full and mesh is None:
+                            sub = _pct_sub_kernel(
+                                config, P_pad, planes, values_d, nv,
+                                kb, fx_bits,
+                                n_pid_planes=n_pid_planes,
+                                sub_start=ss_dev,
+                                p_offset=jnp.int32(0), n_block=P_pad)
+                        elif single_full:
+                            sub = _sharded_pct_sub_kernel(
+                                config, P_pad, mesh, planes, values_d,
+                                nv, kb, fx_bits,
+                                n_pid_planes=n_pid_planes,
+                                sub_start=ss_dev,
+                                p_offset=jnp.int32(0), n_block=P_pad)
+                        elif mesh is None:
+                            sub = _pct_multi_sub_kernel(
+                                config, P_pad, planes, values_d, nv,
+                                kb, fx_bits,
+                                n_pid_planes=n_pid_planes,
+                                sub_starts=ss_dev, p_offsets=p_offs,
+                                n_block=Pb)
+                        else:
+                            sub = _sharded_pct_multi_sub_kernel(
+                                config, P_pad, mesh, planes, values_d,
+                                nv, kb, fx_bits,
+                                n_pid_planes=n_pid_planes,
+                                sub_starts=ss_dev, p_offsets=p_offs,
+                                n_block=Pb)
+                    sub_cell[0] = (sub if sub_cell[0] is None
+                                   else sub_cell[0] + sub)
+                    if ring_b is not None:
+                        # A one-element fetch of this batch's output
+                        # proves its kernel (and so its input
+                        # transfer) completed before the staging slot
+                        # is reused — the pass-B analogue of the
+                        # pass-A fold fetch retiring the slot.
+                        np.asarray(sub[(0,) * sub.ndim])
+                        ring_b.retire()
+
+                run_sweep(consume)
+                sub_acc = sub_cell[0]
+                for ti, (q0, _, p0) in enumerate(sweep):
                     psl = slice(p0, p0 + Pb)
-                    ss_dev = jnp.asarray(sub_start[psl, qsl])
-                    if cache is not None:
-                        obs.inc("stream.pass_b_cache_hit_batches",
-                                len(cache))
-                        sub_acc = run_pass_b(iter(cache), ss_dev, p0,
-                                             Pb)
-                    elif use_executor:
-                        # Overlapped re-ship: stage batch b+1 on the
-                        # stager thread while the device counts batch
-                        # b's subtree leaves (no folds in pass B —
-                        # accumulation stays on device, so only the
-                        # stager is needed).
-                        obs.inc("stream.pass_b_reship_rounds")
-                        with ingest.BackgroundStager(
-                                lambda cancelled: batches(
-                                    cancelled=cancelled),
-                                depth=1) as stager_b:
-                            sub_acc = run_pass_b(stager_b.items(),
-                                                 ss_dev, p0, Pb)
-                    else:
-                        obs.inc("stream.pass_b_reship_rounds")
-                        sub_acc = run_pass_b(batches(), ss_dev, p0, Pb)
-                    with tr.span("walk.bottom", cat="walk", p0=p0), \
+                    qsl = slice(q0, q0 + qn)
+                    with tr.span("walk.bottom", cat="walk", p0=p0,
+                                 q0=q0), \
                             obs.device_annotation("pdp.walk_bottom"):
                         vals_g = _walk_bottom_kernel(
-                            config, Pb, sub_acc, ss_dev, lo[psl, qsl],
-                            hi[psl, qsl], target[psl, qsl],
-                            leaf_lo[psl, qsl], done[psl, qsl], k_tree,
-                            scale, jnp.int32(p0))
+                            config, Pb,
+                            sub_acc if single_full else sub_acc[ti],
+                            ss_dev if single_full else ss_dev[ti],
+                            lo[psl, qsl], hi[psl, qsl],
+                            target[psl, qsl], leaf_lo[psl, qsl],
+                            done[psl, qsl], k_tree, scale,
+                            jnp.int32(p0))
                         vals[psl, qsl] = np.asarray(vals_g)
-                    rounds += 1
-        stats["pass_b_rounds"] = rounds
+                obs.inc("stream.pass_b_stream_sweeps")
+                obs.inc("stream.pass_b_tiles", len(sweep))
+        stats["pass_b_rounds"] = plan.n_sweeps
+        stats["pass_b_sweeps"] = plan.n_sweeps
+        stats["pass_b_tiles"] = plan.n_tiles
+        stats["pass_b_tiles_per_sweep"] = plan.tiles_per_sweep
+        stats["pass_b_cached_batches"] = len(prefix)
+        stats["pass_b_reshipped_bytes"] = reship_bytes[0]
         # The cross-quantile monotone step runs ONCE over the full
         # list (chunked walks must compose to the single-walk result).
         quantiles = np.asarray([p / 100.0 for p in config.percentiles],

@@ -175,7 +175,10 @@ class TestExecutorBitParity:
 
     def test_percentile_reship_parity(self, monkeypatch):
         """Pass B with the device cache disabled re-streams through a
-        fresh BackgroundStager per quantile group."""
+        fresh BackgroundStager per sweep, staging into the rotating
+        StagingRing buffers (fresh-copy retention is only needed while
+        feeding the cache — see tests/test_pass_b.py for the staging-
+        mode parity matrix)."""
         monkeypatch.setenv("PIPELINEDP_TPU_STREAM_CACHE", "0")
         self.test_percentile_two_pass_parity()
 

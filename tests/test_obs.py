@@ -218,7 +218,7 @@ class TestLiveExecutorThreadSafety:
             min_value=0.0, max_value=10.0)
         run_streamed(ds, params, seed=3)
         names = {s.name for s in obs.ledger().snapshot()["spans"]}
-        assert {"walk.top", "walk.bottom", "ingest.pass_b_round",
+        assert {"walk.top", "walk.bottom", "ingest.pass_b_sweep",
                 "ingest.stage", "ingest.fetch", "ingest.fold",
                 "ingest.pass_a"} <= names
 

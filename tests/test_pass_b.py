@@ -1,0 +1,425 @@
+"""Pass-B sweep-planner + hybrid prefix-cache tests (PR 5).
+
+The streamed percentile pass B used to pay one full batch-stream
+traversal per (quantile group x partition block) round; the sweep
+planner (``streaming.plan_pass_b_sweeps``) packs as many tiles as fit
+under ``je._SUBHIST_BYTE_CAP`` into one traversal, and the multi-tile
+kernels scatter one batch's rows into every packed tile's histogram in
+a single launch. Covered here:
+
+* planner invariants (exact grid coverage, per-sweep byte bound, never
+  more sweeps than the per-tile loop, refusal only below one block);
+* the acceptance case: a shrunken cap forcing >= 4 tiles runs
+  ``ceil(tiles / tiles_per_sweep)`` sweeps — strictly fewer than tiles
+  — with released values and kept-partition sets BIT-IDENTICAL to the
+  per-tile loop and to the unchunked walk, on one device and the
+  8-device mesh;
+* the hybrid prefix cache: overflow keeps the cached batch prefix and
+  reships only the suffix, bit-identical to full reship with
+  strictly fewer reshipped bytes;
+* reship staging parity: the rotating-StagingRing reship (cache
+  disabled) equals the fresh-copy cached path bit-for-bit;
+* fault-kill mid-sweep drains the stager with zero orphan threads;
+* the in-tree ``nostager`` lint twin: pass-B restreaming is confined
+  to the planner-driven sweep loop.
+"""
+
+import ast
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import ingest
+from pipelinedp_tpu import jax_engine as je
+from pipelinedp_tpu import streaming
+from pipelinedp_tpu.backends import JaxBackend
+from pipelinedp_tpu.resilience.faults import (ChunkFailure, FaultPlan,
+                                              injected_faults)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BIG_EPS = 1e12
+
+_, _, _, SPAN = streaming._tree_consts()
+UNIT = SPAN * 4  # bytes of one [1, 1, span] int32 block
+
+
+def ingest_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith(ingest.THREAD_PREFIX) and t.is_alive()]
+
+
+@pytest.fixture(autouse=True)
+def no_orphan_threads():
+    yield
+    assert not ingest_threads(), (
+        f"orphan ingest threads: {[t.name for t in ingest_threads()]}")
+
+
+class TestSweepPlanner:
+    """``plan_pass_b_sweeps`` is pure host arithmetic — pin its
+    invariants directly."""
+
+    def _coverage(self, plan, P_pad, Q):
+        cells = set()
+        for q0, qc, p0 in plan.tiles:
+            pb = min(plan.p_blk, P_pad - p0)
+            for q in range(q0, q0 + qc):
+                for p in range(p0, p0 + pb):
+                    assert (q, p) not in cells, "tile overlap"
+                    cells.add((q, p))
+        assert cells == {(q, p) for q in range(Q) for p in range(P_pad)}
+
+    def test_fast_path_is_one_sweep_one_tile(self):
+        plan = streaming.plan_pass_b_sweeps(1 << 17, 3, SPAN, 600 << 20)
+        assert plan.n_tiles == plan.n_sweeps == 1
+        assert not plan.chunked
+        assert (plan.q_chunk, plan.p_blk) == (3, 1 << 17)
+
+    @pytest.mark.parametrize("P_pad,Q,budget", [
+        (8, 4, 8), (8, 4, 5), (8, 2, 2), (16, 3, 7), (64, 5, 48),
+        (8, 4, 31), (1 << 10, 3, 1000),
+    ])
+    def test_coverage_byte_bound_and_no_regression(self, P_pad, Q,
+                                                   budget):
+        plan = streaming.plan_pass_b_sweeps(P_pad, Q, SPAN,
+                                            budget * UNIT)
+        self._coverage(plan, P_pad, Q)
+        for sweep in plan.sweeps:
+            # Uniform tile shape within a sweep (one stacked kernel
+            # launch) and the packed block within the byte cap.
+            qn = {qc for _, qc, _ in sweep}
+            pn = {min(plan.p_blk, P_pad - p0) for _, _, p0 in sweep}
+            assert len(qn) == 1 and len(pn) == 1
+            assert (len(sweep) * qn.pop() * pn.pop()) <= budget
+        # Never more stream traversals than the per-tile loop paid.
+        per_q = P_pad
+        q_chunk = max(1, budget // per_q)
+        if per_q <= budget:
+            old_rounds = -(-Q // q_chunk)
+        else:
+            p_blk = 1 << (budget.bit_length() - 1)
+            old_rounds = Q * -(-P_pad // p_blk)
+        assert plan.n_sweeps <= old_rounds
+
+    def test_packing_beats_per_tile_rounds(self):
+        """The collapse the tentpole exists for: budget 5 on an
+        [8 x 4] grid packs 32 unit tiles into ceil(32/5) = 7 sweeps
+        where the per-tile loop paid 8 rounds."""
+        plan = streaming.plan_pass_b_sweeps(8, 4, SPAN, 5 * UNIT)
+        assert plan.n_tiles == 32
+        assert plan.tiles_per_sweep == 5
+        assert plan.n_sweeps == 7 == -(-plan.n_tiles //
+                                       plan.tiles_per_sweep)
+        assert plan.n_sweeps < plan.n_tiles
+
+    def test_refusal_below_one_block(self):
+        with pytest.raises(NotImplementedError, match="subtree block"):
+            streaming.plan_pass_b_sweeps(8, 2, SPAN, UNIT - 4)
+
+
+def _pct_params(percentiles=(25, 50, 75, 95), hi=20.0, parts=5):
+    return pdp.AggregateParams(
+        metrics=[pdp.Metrics.PERCENTILE(p) for p in percentiles] +
+        [pdp.Metrics.COUNT],
+        noise_kind=pdp.NoiseKind.LAPLACE,
+        max_partitions_contributed=parts,
+        max_contributions_per_partition=50,
+        min_value=0.0, max_value=hi)
+
+
+def _dataset(seed=88, n=6_000, parts=5, hi=20.0, users=1_500):
+    rng = np.random.default_rng(seed)
+    return pdp.ArrayDataset(privacy_ids=rng.integers(0, users, n),
+                            partition_keys=rng.integers(0, parts, n),
+                            values=rng.uniform(0.0, hi, n))
+
+
+def _pct_fields(got):
+    return [f for f in got[next(iter(got))]._fields
+            if f.startswith("percentile_") or f == "count"]
+
+
+def _run(ds, params, *, seed=7, chunk=997, public=None, eps=BIG_EPS,
+         backend=None, monkeypatch=None, **backend_kw):
+    if monkeypatch is not None:
+        monkeypatch.setenv("PIPELINEDP_TPU_STREAM_CHUNK", str(chunk))
+    ds.invalidate_cache()
+    acc = pdp.NaiveBudgetAccountant(total_epsilon=eps, total_delta=1e-2)
+    engine = pdp.DPEngine(acc, backend or JaxBackend(rng_seed=seed,
+                                                     **backend_kw))
+    res = engine.aggregate(ds, params, pdp.DataExtractors(),
+                           public_partitions=public)
+    acc.compute_budgets()
+    got = dict(res)
+    assert res.timings["stream_batches"] > 1
+    return got, res.timings
+
+
+def _force_per_tile(monkeypatch):
+    """Degrade the planner to the pre-sweep behavior: one tile per
+    sweep (= one stream traversal per tile) — the bit-parity reference
+    the multi-tile packing must reproduce exactly."""
+    orig = streaming.plan_pass_b_sweeps
+
+    def per_tile(P_pad, Q, span, cap):
+        p = orig(P_pad, Q, span, cap)
+        return streaming.PassBPlan(p.q_chunk, p.p_blk, 1, p.tiles,
+                                   tuple((t,) for t in p.tiles))
+
+    monkeypatch.setattr(streaming, "plan_pass_b_sweeps", per_tile)
+
+
+class TestMultiTileSweepParity:
+    """Acceptance: with the cap seam shrunk to force >= 4 tiles, pass B
+    runs ceil(tiles / tiles_per_sweep) sweeps — strictly fewer than
+    tiles — and releases values and kept-partition sets bit-identical
+    to the per-tile loop and to the unchunked walk."""
+
+    def _assert_same(self, a, b, tag):
+        assert set(a) == set(b), tag  # kept-partition sets
+        for k in a:
+            for f in _pct_fields(a):
+                assert getattr(a[k], f) == getattr(b[k], f), (tag, k, f)
+
+    def test_single_device(self, monkeypatch):
+        ds = _dataset()
+        params = _pct_params()  # Q=4; P_pad = 8
+        # Private selection at finite eps: the kept SET is part of the
+        # parity claim, not just the values.
+        full, t_full = _run(ds, params, eps=4.0, monkeypatch=monkeypatch)
+        assert t_full["stream_pass_b_sweeps"] == 1
+        assert len(full) >= 4
+        monkeypatch.setattr(je, "_SUBHIST_BYTE_CAP", 5 * UNIT)
+        multi, t_multi = _run(ds, params, eps=4.0,
+                              monkeypatch=monkeypatch)
+        assert t_multi["stream_pass_b_tiles"] == 32
+        assert t_multi["stream_pass_b_tiles_per_sweep"] == 5
+        assert t_multi["stream_pass_b_sweeps"] == 7 == -(
+            -t_multi["stream_pass_b_tiles"] //
+            t_multi["stream_pass_b_tiles_per_sweep"])
+        assert (t_multi["stream_pass_b_sweeps"] <
+                t_multi["stream_pass_b_tiles"])
+        _force_per_tile(monkeypatch)
+        per_tile, t_tile = _run(ds, params, eps=4.0,
+                                monkeypatch=monkeypatch)
+        assert t_tile["stream_pass_b_sweeps"] == 32
+        self._assert_same(full, multi, "multi-tile vs unchunked")
+        self._assert_same(full, per_tile, "per-tile vs unchunked")
+
+    def test_mesh(self, monkeypatch):
+        from pipelinedp_tpu.parallel import make_mesh
+
+        ds = _dataset(seed=17)
+        params = _pct_params()
+
+        def run(**kw):
+            return _run(ds, params, eps=4.0, chunk=499,
+                        backend=JaxBackend(mesh=make_mesh(8),
+                                           rng_seed=7),
+                        monkeypatch=monkeypatch, **kw)
+
+        full, t_full = run()
+        assert t_full["stream_pass_b_sweeps"] == 1
+        monkeypatch.setattr(je, "_SUBHIST_BYTE_CAP", 5 * UNIT)
+        multi, t_multi = run()
+        assert (t_multi["stream_pass_b_sweeps"] <
+                t_multi["stream_pass_b_tiles"] == 32)
+        _force_per_tile(monkeypatch)
+        per_tile, _ = run()
+        self._assert_same(full, multi, "mesh multi-tile vs unchunked")
+        self._assert_same(full, per_tile, "mesh per-tile vs unchunked")
+
+    def test_sweep_counters_reach_ledger(self, monkeypatch):
+        from pipelinedp_tpu import obs
+
+        obs.reset()
+        ds = _dataset(seed=3)
+        params = _pct_params()
+        monkeypatch.setattr(je, "_SUBHIST_BYTE_CAP", 5 * UNIT)
+        _, t = _run(ds, params, monkeypatch=monkeypatch,
+                    public=list(range(5)))
+        counters = obs.ledger().snapshot()["counters"]
+        assert (counters["stream.pass_b_stream_sweeps"] ==
+                t["stream_pass_b_sweeps"])
+        assert counters["stream.pass_b_tiles"] == 32
+
+
+class TestHybridPrefixCache:
+    """Cache overflow no longer zeroes the cache: the resident batch
+    prefix keeps serving pass B from HBM and only the suffix reships —
+    bit-identical to both the all-cached and the all-reshipped runs,
+    with strictly fewer reshipped bytes than full reship."""
+
+    def _run_with_cache(self, ds, params, cache, monkeypatch):
+        return _run(ds, params, public=list(range(5)),
+                    monkeypatch=monkeypatch, stream_cache=cache)
+
+    def test_hybrid_reships_only_the_suffix(self, monkeypatch):
+        ds = _dataset(seed=21)
+        params = _pct_params(percentiles=(50, 95))
+        cached, t_c = self._run_with_cache(ds, params, 1 << 30,
+                                           monkeypatch)
+        reship, t_r = self._run_with_cache(ds, params, 0, monkeypatch)
+        assert t_c["stream_pass_b"] == "device_cache"
+        assert t_c["stream_pass_b_reshipped_bytes"] == 0
+        assert t_r["stream_pass_b"] == "reship"
+        full_bytes = t_r["stream_pass_b_reshipped_bytes"]
+        assert full_bytes > 0
+        n_batches = t_r["stream_batches"]
+        # Budget for ~2.5 batches: the prefix caches, the rest reships.
+        per_batch = full_bytes // n_batches
+        hybrid, t_h = self._run_with_cache(ds, params,
+                                           per_batch * 5 // 2,
+                                           monkeypatch)
+        assert t_h["stream_pass_b"] == "hybrid"
+        assert 1 <= t_h["stream_pass_b_cached_batches"] < n_batches
+        assert 0 < t_h["stream_pass_b_reshipped_bytes"] < full_bytes
+        for p in range(5):
+            for f in _pct_fields(cached):
+                v = getattr(cached[p], f)
+                assert getattr(hybrid[p], f) == v, (p, f, "hybrid")
+                assert getattr(reship[p], f) == v, (p, f, "reship")
+
+    def test_overflow_event_keeps_prefix(self, monkeypatch):
+        from pipelinedp_tpu import obs
+
+        obs.reset()
+        ds = _dataset(seed=22)
+        params = _pct_params(percentiles=(50,))
+        _, t_r = self._run_with_cache(ds, params, 0, monkeypatch)
+        per_batch = (t_r["stream_pass_b_reshipped_bytes"] //
+                     t_r["stream_batches"])
+        obs.reset()
+        _, t_h = self._run_with_cache(ds, params, per_batch * 3 // 2,
+                                      monkeypatch)
+        assert t_h["stream_pass_b"] == "hybrid"
+        events = [e for e in obs.ledger().snapshot()["events"]
+                  if e["name"] == "stream.cache_overflow"]
+        assert events and events[0]["prefix_batches"] >= 1
+
+    def test_hybrid_composes_with_multi_tile_sweeps(self, monkeypatch):
+        """The two tentpole halves together: shrunken cap (multi-tile
+        sweeps) + overflowing cache (hybrid source) still bit-identical
+        to the unconstrained run."""
+        ds = _dataset(seed=23)
+        params = _pct_params()
+        full, _ = self._run_with_cache(ds, params, 1 << 30, monkeypatch)
+        _, t_r = self._run_with_cache(ds, params, 0, monkeypatch)
+        per_batch = (t_r["stream_pass_b_reshipped_bytes"] //
+                     t_r["stream_batches"])
+        monkeypatch.setattr(je, "_SUBHIST_BYTE_CAP", 5 * UNIT)
+        hybrid, t_h = self._run_with_cache(ds, params,
+                                           per_batch * 5 // 2,
+                                           monkeypatch)
+        assert t_h["stream_pass_b"] == "hybrid"
+        assert t_h["stream_pass_b_sweeps"] == 7
+        for p in range(5):
+            for f in _pct_fields(full):
+                assert getattr(hybrid[p], f) == getattr(full[p], f), (
+                    p, f)
+
+
+class TestReshipStagingModes:
+    """Satellite: reship-only sweeps stage through the rotating
+    StagingRing (fresh-copy retention is only needed while feeding the
+    cache) — parity across both staging modes and both executors."""
+
+    @pytest.mark.parametrize("executor", [True, False])
+    def test_ring_reship_equals_copy_cached(self, executor,
+                                            monkeypatch):
+        ds = _dataset(seed=31)
+        params = _pct_params(percentiles=(50, 90))
+        copied, _ = _run(ds, params, public=list(range(5)),
+                         monkeypatch=monkeypatch, stream_cache=1 << 30,
+                         ingest_executor=executor)
+        ringed, t = _run(ds, params, public=list(range(5)),
+                         monkeypatch=monkeypatch, stream_cache=0,
+                         ingest_executor=executor)
+        assert t["stream_pass_b"] == "reship"
+        for p in range(5):
+            for f in _pct_fields(copied):
+                assert getattr(ringed[p], f) == getattr(copied[p], f), (
+                    p, f, executor)
+
+
+class TestPassBFaultDrain:
+    """A fault-injected kill DURING a pass-B sweep severs the run at a
+    deterministic batch and drains every worker thread — zero orphans
+    (the autouse fixture re-asserts after each test)."""
+
+    @pytest.mark.parametrize("executor", [True, False])
+    def test_kill_mid_sweep_drains(self, executor, monkeypatch):
+        ds = _dataset(seed=41)
+        params = _pct_params(percentiles=(50,))
+        with injected_faults(FaultPlan(fail_pass_b_chunks=(1,))):
+            with pytest.raises(ChunkFailure, match="pass-B"):
+                _run(ds, params, public=list(range(5)),
+                     monkeypatch=monkeypatch, stream_cache=0,
+                     ingest_executor=executor)
+        assert not ingest_threads(), "pass-B kill left orphan threads"
+
+    def test_kill_in_cached_sweep_drains(self, monkeypatch):
+        """The kill also lands when the sweep reads the device cache
+        (no stager running) — same deterministic failure, no orphans."""
+        ds = _dataset(seed=42)
+        params = _pct_params(percentiles=(50,))
+        with injected_faults(FaultPlan(fail_pass_b_chunks=(0,))):
+            with pytest.raises(ChunkFailure, match="pass-B"):
+                _run(ds, params, public=list(range(5)),
+                     monkeypatch=monkeypatch, stream_cache=1 << 30)
+        assert not ingest_threads()
+
+
+class TestNoStagerLint:
+    """In-tree twin of ``make nostager``: pass-B restreaming must flow
+    through the sweep planner's ONE stream source. Any new
+    ``BackgroundStager`` construction in ``streaming.py`` outside pass
+    A's overlapped loop or ``run_sweep`` re-introduces per-tile
+    restreaming and must fail here."""
+
+    ALLOWED = {"stream_partials_and_select", "run_sweep"}
+
+    def test_stager_sites_confined_to_sweep_loop(self):
+        path = os.path.join(REPO, "pipelinedp_tpu", "streaming.py")
+        with open(path, encoding="utf-8") as fh:
+            tree = ast.parse(fh.read())
+        sites = []
+
+        def visit(node, func):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                func = node.name
+            if isinstance(node, ast.Call):
+                callee = node.func
+                name = (callee.attr if isinstance(callee, ast.Attribute)
+                        else getattr(callee, "id", None))
+                if name == "BackgroundStager":
+                    sites.append((func, node.lineno))
+            for child in ast.iter_child_nodes(node):
+                visit(child, func)
+
+        visit(tree, "<module>")
+        assert len(sites) == 2, sites
+        assert {f for f, _ in sites} <= self.ALLOWED, sites
+
+    def test_outside_streaming_no_stager(self):
+        """No other library/bench module may construct a stager at all
+        (the Makefile grep enforces the same rule)."""
+        offenders = []
+        targets = [os.path.join(REPO, "bench.py")]
+        for root, _, files in os.walk(os.path.join(REPO,
+                                                   "pipelinedp_tpu")):
+            targets += [os.path.join(root, f) for f in files
+                        if f.endswith(".py")]
+        for path in targets:
+            rel = os.path.relpath(path, REPO)
+            if (rel.startswith(os.path.join("pipelinedp_tpu", "ingest"))
+                    or rel.endswith("streaming.py")):
+                continue
+            with open(path, encoding="utf-8") as fh:
+                for i, line in enumerate(fh, 1):
+                    if "BackgroundStager(" in line:
+                        offenders.append(f"{rel}:{i}")
+        assert not offenders, offenders
