@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast bench smoke multichip lint dev clean faultcheck nosleep perfcheck nofoldin obscheck noperf nostager
+.PHONY: test test-fast bench smoke multichip lint dev clean faultcheck nosleep perfcheck nofoldin obscheck noperf nostager ledgercheck noartifacts
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -42,9 +42,35 @@ perfcheck: nosleep nofoldin nostager
 # overlapped-ingest run, no-op-mode zero emission, bench-field parity
 # (names/semantics unchanged, DP outputs bit-identical trace on/off),
 # Chrome-trace round-trip, run-report schema, resilience/fault event
-# coverage — plus the no-raw-perf-counter lint below.
-obscheck: noperf
+# coverage — plus the no-raw-perf-counter and no-ad-hoc-artifact lints.
+obscheck: noperf noartifacts
 	$(PYTHON) -m pytest tests/test_obs.py -q
+
+# Audit-record + run-ledger acceptance suite: schema-v2 privacy section
+# (per-mechanism eps/delta + noise stddevs, selection pre/post counts,
+# expected errors), audit on/off DP bit-parity, durable store semantics
+# (fsync'd appends, v1->v2 reader tolerance, truncated-trailing-line
+# recovery, concurrent appends, degraded-baseline exclusion) and the
+# bench --compare regression gate (two in-process runs).
+ledgercheck: noartifacts
+	$(PYTHON) -m pytest tests/test_ledger.py tests/test_obs.py -q
+
+# Lint-style check: no ad-hoc run-report/JSON-artifact writes — every
+# json.dump( file write in library/bench code must live in
+# pipelinedp_tpu/obs/ (the exporters + the durable ledger store) or
+# bench.py (the one artifact emitter), so run knowledge lands in the
+# schema-versioned report/store instead of scattered one-off files.
+# (tests/test_ledger.py enforces the same rule in-tree, AST-precise.)
+noartifacts:
+	@bad=$$(grep -rn "json\.dump *(" --include='*.py' pipelinedp_tpu \
+	  | grep -v "pipelinedp_tpu/obs/" || true); \
+	if [ -n "$$bad" ]; then \
+	  echo "$$bad"; \
+	  echo "ERROR: ad-hoc JSON artifact write — route run reports/"; \
+	  echo "artifacts through pipelinedp_tpu/obs (report/store) or bench.py"; \
+	  exit 1; \
+	fi; \
+	echo "noartifacts: OK"
 
 # Lint-style check: no bare time.perf_counter() phase timing outside
 # pipelinedp_tpu/obs/ — every measured phase must flow through obs

@@ -22,10 +22,18 @@ Prints ONE JSON line on stdout (the flagship config), including the
 host/device timing split. Per-config JSON lines go to stderr, prefixed
 with nothing — each is itself valid JSON preceded by "##" comment lines
 for humans.
+
+Every record (and the final run report) also appends to the durable
+run-ledger store (``obs.store``; ``PIPELINEDP_TPU_LEDGER_DIR``, else a
+compile-cache sibling, else ``./.pdp_ledger``). ``--compare`` diffs the
+run against the store's last-known-good entries for the same
+environment fingerprint — degraded captures are never baselines — and
+``--strict`` turns a >10% rate drop into a nonzero exit.
 """
 
 import argparse
 import json
+import os
 import sys
 
 import numpy as np
@@ -65,10 +73,129 @@ def env_fingerprint():
     return _ENV_FP
 
 
+class _BenchLedger:
+    """The bench's connection to the durable run-ledger store
+    (``obs.store``): every emitted record appends one fsync'd entry, and
+    ``--compare`` reads baselines from a snapshot taken BEFORE this
+    run's first append — a run never compares against itself. An
+    unavailable store (unwritable dir) degrades to a logged no-op; the
+    bench must never die to its own bookkeeping."""
+
+    def __init__(self):
+        import uuid
+
+        from pipelinedp_tpu.obs import store as obs_store
+        self._store = None
+        self.fingerprint = None
+        self.run_id = uuid.uuid4().hex[:12]
+        self._baseline_entries = []
+        self._failed_runs = set()
+        try:
+            directory = obs_store.ledger_dir(
+                default=os.path.join(os.getcwd(), ".pdp_ledger"))
+            self._store = obs_store.LedgerStore(directory)
+            self.fingerprint = obs_store.fingerprint_key(env_fingerprint())
+            self._baseline_entries = self._store.entries()
+            # Runs that FAILED a --strict gate marked themselves
+            # (bench.gate_failed): their regressed numbers must not
+            # become the next run's baseline, or the gate would fire
+            # once and then self-clear without any fix.
+            self._failed_runs = {
+                e.get("run_id") for e in self._baseline_entries
+                if e.get("name") == "bench.gate_failed" and
+                e.get("run_id") is not None}
+            log(f"## run ledger: {self._store.path} "
+                f"({len(self._baseline_entries)} prior entries, "
+                f"fingerprint {self.fingerprint})")
+        except OSError as e:
+            log(f"## run-ledger store unavailable ({e}); records will "
+                "not persist")
+            self._store = None
+
+    def append(self, name, payload):
+        if self._store is None:
+            return
+        try:
+            self._store.append(name, payload, env=env_fingerprint(),
+                               run_id=self.run_id)
+        except OSError as e:
+            log(f"## run-ledger append failed for {name}: {e}")
+
+    @staticmethod
+    def _entry_value(entry):
+        v = ((entry.get("payload") or {}).get("record") or {}).get("value")
+        return v if isinstance(v, (int, float)) else None
+
+    def baseline(self, name):
+        """(baseline pre-run entry or None, skipped_degraded) for this
+        run's fingerprint. The baseline is the BEST sample of ``name``
+        from the most recent eligible run — the same best-of rule the
+        headline applies within a run, so a slow-window re-sample never
+        becomes the bar. Ineligible: ``degraded: true`` entries (the
+        tunnel-wedged capture) and entries from runs that failed a
+        --strict gate. ``skipped_degraded`` is True when a NEWER
+        degraded entry was passed over."""
+        if self._store is None:
+            return None, False
+        pool = [e for e in self._baseline_entries
+                if e.get("name") == name and
+                e.get("fingerprint") == self.fingerprint]
+        if not pool:
+            return None, False
+        eligible_i = [i for i, e in enumerate(pool)
+                      if not e.get("degraded") and
+                      e.get("run_id") not in self._failed_runs]
+        if not eligible_i:
+            return None, any(e.get("degraded") for e in pool)
+        eligible = [pool[i] for i in eligible_i]
+        last = eligible[-1]
+        best = last
+        for e in eligible:
+            if e.get("run_id") != last.get("run_id"):
+                continue  # best WITHIN the most recent eligible run
+            v, b = self._entry_value(e), self._entry_value(best)
+            if v is not None and (b is None or v > b):
+                best = e
+        # ANY newer degraded capture was passed over — not just when it
+        # happens to be the single newest entry (a gate-failed run in
+        # between must not mask the skip notification).
+        skipped = any(e.get("degraded")
+                      for e in pool[eligible_i[-1] + 1:])
+        return best, skipped
+
+
+_BENCH_LEDGER = None
+_RUN_RECORDS = []
+
+
+def _bench_ledger():
+    global _BENCH_LEDGER
+    if _BENCH_LEDGER is None:
+        _BENCH_LEDGER = _BenchLedger()
+    return _BENCH_LEDGER
+
+
+def reset_run_state():
+    """Fresh bench 'run' within one process (tests simulating two
+    driver invocations): clears the cached tracer / fingerprint /
+    ledger connection / record list and the obs process ledger."""
+    global _TRACER, _ENV_FP, _BENCH_LEDGER, _RUN_RECORDS
+    _TRACER = None
+    _ENV_FP = None
+    _BENCH_LEDGER = None
+    _RUN_RECORDS = []
+    from pipelinedp_tpu import obs
+    obs.reset()
+
+
 def emit(rec):
-    """Log one record (with the env fingerprint merged) as JSON."""
+    """Log one record (with the env fingerprint merged) as JSON, and
+    append it to the durable run-ledger store keyed by the environment
+    fingerprint."""
     rec["env"] = env_fingerprint()
     log(json.dumps(rec))
+    _RUN_RECORDS.append(rec)
+    _bench_ledger().append(rec["metric"], {"record": rec})
 
 
 def zipf_dataset(n_rows, n_users, n_partitions, seed=0, value_hi=10.0):
@@ -749,6 +876,97 @@ def walk_breakdown_probe(n_partitions, n_rows, n_quantiles=3):
     return rec
 
 
+def record_run_report(snapshot=None):
+    """Build this run's schema-v2 run report (env fingerprint + spans +
+    counters/events + the privacy audit section) and append it to the
+    store as the ``run_report`` entry — the span-total baseline future
+    ``--compare`` runs diff against. Returns the report."""
+    from pipelinedp_tpu import obs
+    report = obs.build_run_report(env=env_fingerprint(),
+                                  snapshot=snapshot)
+    _bench_ledger().append("run_report",
+                           {"run_report": report, "env": env_fingerprint()})
+    return report
+
+
+def compare_to_baseline(records=None, run_report=None, threshold=0.10):
+    """The regression gate behind ``--compare``: diff this run's
+    headline rates (every record with a ``.../s`` unit) and span totals
+    against the store's last-known-good entries for the SAME environment
+    fingerprint. Degraded baselines are never used — when a newer
+    degraded capture is passed over, a ``bench.compare_skipped_degraded``
+    event goes on the record. Returns the artifact's ``regressions``
+    section; ``regressed`` lists metrics whose rate dropped more than
+    ``threshold`` (the ``--strict`` exit condition)."""
+    from pipelinedp_tpu import obs
+    led = _bench_ledger()
+    records = _RUN_RECORDS if records is None else records
+    rates, spans, regressed = [], [], []
+    skipped_degraded = 0
+    # One comparison per metric, at its BEST value this run — the same
+    # best-sample rule the headline applies (the flagship re-sample
+    # emits the metric twice; a slow-window sample must not fail a gate
+    # the headline passed).
+    best, order = {}, []
+    for rec in records:
+        value = rec.get("value")
+        unit = rec.get("unit") or ""
+        if not isinstance(value, (int, float)) or not unit.endswith("/s"):
+            continue
+        prev = best.get(rec["metric"])
+        if prev is None:
+            order.append(rec["metric"])
+            best[rec["metric"]] = rec
+        elif value > prev["value"]:
+            best[rec["metric"]] = rec
+    for name in order:
+        rec = best[name]
+        value = rec["value"]
+        base, skipped = led.baseline(rec["metric"])
+        if skipped:
+            skipped_degraded += 1
+            obs.inc("bench.compare_skipped_degraded")
+            obs.event("bench.compare_skipped_degraded",
+                      metric=rec["metric"], fingerprint=led.fingerprint)
+            log(f"## compare: skipped a DEGRADED newer capture of "
+                f"{rec['metric']} (never a baseline)")
+        base_val = None
+        if base is not None:
+            base_val = ((base.get("payload") or {}).get("record")
+                        or {}).get("value")
+        if not isinstance(base_val, (int, float)) or base_val <= 0:
+            rates.append({"metric": rec["metric"], "current": value,
+                          "baseline": None})
+            continue
+        entry = {"metric": rec["metric"], "current": value,
+                 "baseline": base_val,
+                 "ratio": round(value / base_val, 3),
+                 "baseline_ts": base.get("ts")}
+        if value < (1.0 - threshold) * base_val:
+            entry["regressed"] = True
+            regressed.append(rec["metric"])
+        rates.append(entry)
+    if run_report:
+        base_rr, _ = led.baseline("run_report")
+        base_spans = {}
+        if base_rr is not None:
+            base_spans = (((base_rr.get("payload") or {})
+                           .get("run_report") or {}).get("spans") or {})
+        for name, agg in sorted((run_report.get("spans") or {}).items()):
+            b = base_spans.get(name)
+            if not b or not b.get("total_s"):
+                continue
+            spans.append({"span": name,
+                          "total_s": agg["total_s"],
+                          "baseline_total_s": b["total_s"],
+                          "ratio": round(agg["total_s"] / b["total_s"],
+                                         3)})
+    return {"fingerprint": led.fingerprint, "threshold": threshold,
+            "rates": rates, "spans": spans,
+            "skipped_degraded_baselines": skipped_degraded,
+            "regressed": regressed}
+
+
 def _ensure_device_or_degrade():
     """Probe the accelerator with bounded retry + exponential backoff
     (jax backend initialization can block indefinitely on a wedged TPU
@@ -787,6 +1005,15 @@ def main():
         "--stream-rows", type=int, default=None,
         help="streaming-ingest benchmark row count (default: 150M full "
         "runs / 200k smoke; 0 disables)")
+    parser.add_argument(
+        "--compare", action="store_true",
+        help="diff this run's rates and span totals against the run "
+        "ledger's last-known-good for the same environment fingerprint "
+        "and emit a 'regressions' section in the artifact")
+    parser.add_argument(
+        "--strict", action="store_true",
+        help="with --compare: exit nonzero when any rate dropped more "
+        "than 10%% vs its last-known-good baseline")
     args = parser.parse_args()
     if args.stream_rows is None:
         args.stream_rows = 200_000 if args.smoke else 150_000_000
@@ -929,22 +1156,23 @@ def main():
     # (CPU-fallback) run says so — its numbers measure the fallback, not
     # the accelerator. The env fingerprint rides on every record; with
     # PIPELINEDP_TPU_TRACE set the headline additionally carries the
-    # schema-versioned run report (spans + counters + events) and a
-    # Chrome-trace file lands next to it for Perfetto.
+    # schema-versioned run report (spans + counters/events + the privacy
+    # audit section) and a Chrome-trace file lands next to it for
+    # Perfetto. Every run — traced or not — appends its report to the
+    # durable run-ledger store as the "run_report" entry.
     from pipelinedp_tpu import obs
     headline = {k: flagship[k] for k in
                 ("metric", "value", "unit", "vs_baseline",
                  "host_s", "device_s") if k in flagship}
     headline["degraded"] = bool(health_report.degraded)
     headline["env"] = env_fingerprint()
+    # ONE ledger snapshot feeds every exporter, so the trace file, the
+    # report and the stored ledger entry agree span-for-span; the
+    # cached fingerprint skips a second device/git probe.
+    snap = obs.ledger().snapshot()
+    report = record_run_report(snapshot=snap)
     if obs.trace_enabled():
-        # ONE ledger snapshot feeds both exporters, so the trace file
-        # and the report agree span-for-span; the cached fingerprint
-        # skips a second device/git probe.
-        snap = obs.ledger().snapshot()
         trace_path = obs.write_chrome_trace(snapshot=snap)
-        report = obs.build_run_report(env=env_fingerprint(),
-                                      snapshot=snap)
         with open(trace_path + ".report.json", "w",
                   encoding="utf-8") as f:
             json.dump(report, f, indent=1)
@@ -952,7 +1180,25 @@ def main():
         log(f"## chrome trace: {trace_path} (open at "
             f"https://ui.perfetto.dev); run report: "
             f"{trace_path}.report.json")
+    regressions = None
+    if args.compare:
+        regressions = compare_to_baseline(run_report=report)
+        headline["regressions"] = regressions
+        if regressions["regressed"]:
+            log(f"## REGRESSIONS: rates dropped "
+                f">{regressions['threshold']:.0%} vs last-known-good: "
+                f"{regressions['regressed']}")
+        else:
+            log("## compare: no rate regressions vs last-known-good "
+                f"(fingerprint {regressions['fingerprint']})")
     print(json.dumps(headline))
+    if args.strict and regressions and regressions["regressed"]:
+        # Mark this run as gate-failed so its regressed numbers never
+        # become the next run's baseline (the gate must stay red until
+        # the regression is actually fixed, not self-clear).
+        _bench_ledger().append("bench.gate_failed",
+                               {"regressed": regressions["regressed"]})
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -50,16 +50,25 @@ class MechanismSpec:
                  mechanism_type: MechanismType,
                  _eps: Optional[float] = None,
                  _delta: Optional[float] = None,
-                 _count: int = 1):
+                 _count: int = 1,
+                 metric: Optional[str] = None):
         self._mechanism_type = mechanism_type
         self._eps = _eps
         self._delta = _delta
         self._count = _count
+        self._metric = metric
         self._noise_standard_deviation: Optional[float] = None
 
     @property
     def mechanism_type(self) -> MechanismType:
         return self._mechanism_type
+
+    @property
+    def metric(self) -> Optional[str]:
+        """Which metric/release this mechanism serves — the audit label
+        threaded through ``request_budget(metric=...)`` (None for callers
+        that predate the audit record)."""
+        return self._metric
 
     @property
     def eps(self) -> float:
@@ -270,12 +279,14 @@ class BudgetAccountant(abc.ABC):
                        weight: float = 1,
                        count: int = 1,
                        noise_standard_deviation: Optional[float] = None,
-                       internal_splits: int = 1) -> MechanismSpec:
+                       internal_splits: int = 1,
+                       metric: Optional[str] = None) -> MechanismSpec:
         """Registers a mechanism; returns a lazy spec.
 
         ``internal_splits``: the consumer will divide the granted budget
         evenly into this many internal sub-mechanisms (see
-        MechanismSpecInternal)."""
+        MechanismSpecInternal). ``metric`` labels the release this
+        mechanism serves in the privacy audit record."""
 
     def compute_budgets(self) -> None:
         """Distributes the total budget over all registered mechanisms,
@@ -288,8 +299,75 @@ class BudgetAccountant(abc.ABC):
         self._finalized = True
         if not self._mechanisms:
             logging.warning("No budgets were requested.")
-            return
-        self._compute_budgets()
+        else:
+            self._compute_budgets()
+        self._record_audit()
+
+    @property
+    def finalized(self) -> bool:
+        return self._finalized
+
+    # --- privacy audit record ---
+
+    def audit_record(self) -> dict:
+        """Machine-readable twin of the explain report's budget lines:
+        every registered mechanism's metric label, mechanism type,
+        granted (eps, delta) split, and noise standard deviation — the
+        per-request audit section that today dies with the accountant at
+        exit. Meaningful after ``compute_budgets()`` (before it, the
+        lazy eps/delta render as None)."""
+        mechanisms = []
+        for i, m in enumerate(self._mechanisms):
+            spec = m.mechanism_spec
+            mechanisms.append({
+                "metric": spec.metric or f"mechanism_{i}",
+                "mechanism_type": spec.mechanism_type.value,
+                "eps": spec._eps,
+                "delta": spec._delta,
+                "noise_standard_deviation": self._spec_noise_std(m),
+                "weight": m.weight,
+                "sensitivity": m.sensitivity,
+                "count": spec.count,
+                "internal_splits": m.internal_splits,
+            })
+        return {
+            "accountant": type(self).__name__,
+            "total_epsilon": self._total_epsilon,
+            "total_delta": self._total_delta,
+            "finalized": self._finalized,
+            "mechanisms": mechanisms,
+        }
+
+    def _spec_noise_std(self, m: MechanismSpecInternal) -> Optional[float]:
+        """Noise stddev of ONE of the spec's ``internal_splits``
+        sub-mechanisms at the registered sensitivity: the PLD-granted
+        value when set, else the standard calibration of the even
+        (eps, delta)/k split (None for GENERIC mechanisms and before
+        finalization)."""
+        spec = m.mechanism_spec
+        if spec._noise_standard_deviation is not None:
+            return spec._noise_standard_deviation
+        if not spec._eps:
+            return None
+        k = max(m.internal_splits, 1)
+        if spec.mechanism_type == MechanismType.LAPLACE:
+            return math.sqrt(2.0) * m.sensitivity * k / spec._eps
+        if spec.mechanism_type == MechanismType.GAUSSIAN and spec._delta:
+            from pipelinedp_tpu.ops import noise as noise_ops
+            return noise_ops.gaussian_sigma(spec._eps / k, spec._delta / k,
+                                            m.sensitivity)
+        return None
+
+    def _record_audit(self) -> None:
+        """Push the finalized audit record into the obs audit registry
+        (the run report's ``privacy`` section reads it from there). Never
+        lets audit capture take budget accounting down."""
+        try:
+            from pipelinedp_tpu.obs import audit as obs_audit
+            if obs_audit.audit_enabled():
+                obs_audit.record_accountant(self.audit_record())
+        except Exception:  # pragma: no cover - audit must never raise
+            logging.warning("privacy audit capture failed", exc_info=True)
 
     @abc.abstractmethod
     def _compute_budgets(self) -> None:
@@ -308,7 +386,8 @@ class NaiveBudgetAccountant(BudgetAccountant):
                        weight: float = 1,
                        count: int = 1,
                        noise_standard_deviation: Optional[float] = None,
-                       internal_splits: int = 1) -> MechanismSpec:
+                       internal_splits: int = 1,
+                       metric: Optional[str] = None) -> MechanismSpec:
         if noise_standard_deviation is not None:
             raise NotImplementedError(
                 "noise_standard_deviation is not implemented for "
@@ -319,7 +398,7 @@ class NaiveBudgetAccountant(BudgetAccountant):
                 "The Gaussian mechanism requires delta > 0")
         if internal_splits < 1:
             raise ValueError("internal_splits must be >= 1")
-        spec = MechanismSpec(mechanism_type, _count=count)
+        spec = MechanismSpec(mechanism_type, _count=count, metric=metric)
         self._register_mechanism(
             MechanismSpecInternal(sensitivity=sensitivity,
                                   weight=weight,
@@ -376,7 +455,8 @@ class PLDBudgetAccountant(BudgetAccountant):
                        weight: float = 1,
                        count: int = 1,
                        noise_standard_deviation: Optional[float] = None,
-                       internal_splits: int = 1) -> MechanismSpec:
+                       internal_splits: int = 1,
+                       metric: Optional[str] = None) -> MechanismSpec:
         if count != 1 or noise_standard_deviation is not None:
             raise NotImplementedError(
                 "count/noise_standard_deviation are not supported by "
@@ -390,7 +470,7 @@ class PLDBudgetAccountant(BudgetAccountant):
                 "The Gaussian mechanism requires delta > 0")
         if internal_splits < 1:
             raise ValueError("internal_splits must be >= 1")
-        spec = MechanismSpec(mechanism_type)
+        spec = MechanismSpec(mechanism_type, metric=metric)
         self._register_mechanism(
             MechanismSpecInternal(sensitivity=sensitivity,
                                   weight=weight,

@@ -515,14 +515,16 @@ def create_compound_combiner(
     metrics = aggregate_params.metrics
     weight = aggregate_params.budget_weight
 
-    def request(internal_splits: int = 1):
+    def request(metric: str, internal_splits: int = 1):
         # internal_splits declares how many sub-mechanisms the combiner
         # will evenly split the granted budget into (mean = count +
         # normalized sum, variance adds the normalized sum of squares,
         # vectors release per coordinate, quantile trees per level) — the
-        # PLD accountant composes them individually.
+        # PLD accountant composes them individually. ``metric`` labels
+        # the mechanism in the privacy audit record.
         return budget_accountant.request_budget(
-            mechanism_type, weight=weight, internal_splits=internal_splits)
+            mechanism_type, weight=weight, internal_splits=internal_splits,
+            metric=metric)
 
     if Metrics.VARIANCE in metrics:
         metrics_to_compute = ["variance"]
@@ -534,7 +536,7 @@ def create_compound_combiner(
             metrics_to_compute.append("sum")
         combiners.append(
             VarianceCombiner(
-                CombinerParams(request(internal_splits=3),
+                CombinerParams(request("variance", internal_splits=3),
                                aggregate_params), metrics_to_compute))
     elif Metrics.MEAN in metrics:
         metrics_to_compute = ["mean"]
@@ -544,24 +546,28 @@ def create_compound_combiner(
             metrics_to_compute.append("sum")
         combiners.append(
             MeanCombiner(
-                CombinerParams(request(internal_splits=2),
+                CombinerParams(request("mean", internal_splits=2),
                                aggregate_params), metrics_to_compute))
     else:
         if Metrics.COUNT in metrics:
             combiners.append(
-                CountCombiner(CombinerParams(request(), aggregate_params)))
+                CountCombiner(
+                    CombinerParams(request("count"), aggregate_params)))
         if Metrics.SUM in metrics:
             combiners.append(
-                SumCombiner(CombinerParams(request(), aggregate_params)))
+                SumCombiner(
+                    CombinerParams(request("sum"), aggregate_params)))
     if Metrics.PRIVACY_ID_COUNT in metrics:
         combiners.append(
             PrivacyIdCountCombiner(
-                CombinerParams(request(), aggregate_params)))
+                CombinerParams(request("privacy_id_count"),
+                               aggregate_params)))
     if Metrics.VECTOR_SUM in metrics:
         combiners.append(
             VectorSumCombiner(
                 CombinerParams(
-                    request(internal_splits=aggregate_params.vector_size),
+                    request("vector_sum",
+                            internal_splits=aggregate_params.vector_size),
                     aggregate_params)))
     percentiles_to_compute = [
         m.parameter for m in metrics if m.is_percentile
@@ -570,7 +576,7 @@ def create_compound_combiner(
         combiners.append(
             QuantileCombiner(
                 CombinerParams(
-                    request(internal_splits=(
+                    request("percentile", internal_splits=(
                         quantile_tree_ops.DEFAULT_TREE_HEIGHT)),
                     aggregate_params), percentiles_to_compute))
     return CompoundCombiner(combiners, return_named_tuple=True)

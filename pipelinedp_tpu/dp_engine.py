@@ -79,6 +79,51 @@ class DPEngine:
     def explain_computations_report(self):
         return [gen.report() for gen in self._report_generators]
 
+    def explain_computations_structured(self):
+        """Machine-readable twin of :meth:`explain_computations_report`:
+        one dict per aggregation (method, params string, structured
+        stages) — the same stages the string view renders, as data."""
+        return [gen.structured() for gen in self._report_generators]
+
+    def _record_aggregation_audit(self, method: str, params,
+                                  public_partitions=None) -> None:
+        """Push this aggregation's shape into the obs audit registry —
+        the run report's ``privacy`` section pairs it with the
+        accountant's per-mechanism eps/delta record. Never raises."""
+        try:
+            from pipelinedp_tpu.obs import audit as obs_audit
+            if not obs_audit.audit_enabled():
+                return
+            rec: dict = {"method": method,
+                         "backend": type(self._backend).__name__}
+            if isinstance(params, AggregateParams):
+                rec["metrics"] = [repr(m) for m in (params.metrics or [])]
+                rec["noise_kind"] = (params.noise_kind.value
+                                     if params.noise_kind else None)
+                rec["contribution_bounds"] = {
+                    "max_partitions_contributed":
+                        params.max_partitions_contributed,
+                    "max_contributions_per_partition":
+                        params.max_contributions_per_partition,
+                    "max_contributions": params.max_contributions,
+                    "min_value": params.min_value,
+                    "max_value": params.max_value,
+                    "min_sum_per_partition": params.min_sum_per_partition,
+                    "max_sum_per_partition": params.max_sum_per_partition,
+                }
+            rec["budget_weight"] = getattr(params, "budget_weight", None)
+            strategy = getattr(params, "partition_selection_strategy",
+                               None)
+            rec["partition_selection"] = (
+                "public" if public_partitions is not None else
+                (strategy.value if strategy is not None else None))
+            pre_threshold = getattr(params, "pre_threshold", None)
+            if pre_threshold is not None:
+                rec["pre_threshold"] = pre_threshold
+            obs_audit.record_aggregation(rec)
+        except Exception:
+            pass  # the audit trail must never take an aggregation down
+
     # ------------------------------------------------------------------
     # aggregate
     # ------------------------------------------------------------------
@@ -97,6 +142,8 @@ class DPEngine:
         ``budget_accountant.compute_budgets()``.
         """
         self._check_aggregate_params(col, params, data_extractors)
+        self._record_aggregation_audit("aggregate", params,
+                                       public_partitions)
 
         with self._budget_accountant.scope(weight=params.budget_weight):
             self._report_generators.append(
@@ -216,6 +263,7 @@ class DPEngine:
                           data_extractors: DataExtractors):
         """DP set of partition keys present in the data (reference :204)."""
         self._check_select_private_partitions(col, params, data_extractors)
+        self._record_aggregation_audit("select_partitions", params)
 
         with self._budget_accountant.scope(weight=params.budget_weight):
             self._report_generators.append(
@@ -307,7 +355,8 @@ class DPEngine:
         """DP filter keeping only partitions whose (estimated) privacy-id
         count passes the selection strategy (reference :312-362)."""
         budget = self._budget_accountant.request_budget(
-            mechanism_type=MechanismType.GENERIC)
+            mechanism_type=MechanismType.GENERIC,
+            metric="partition_selection")
         # functools.partial over the MODULE-LEVEL _selection_filter_fn:
         # cluster runners pickle this closure to ship it to workers, and
         # only importable functions survive the stdlib pickler (reference

@@ -1834,31 +1834,35 @@ def request_budgets(config: FusedConfig, params: AggregateParams,
     names = set(config.metrics)
     specs: Dict[str, Any] = {}
 
-    def request(internal_splits: int = 1):
+    def request(metric: str, internal_splits: int = 1):
         # Same split declarations as the generic factory: the release path
         # divides the granted budget evenly into this many sub-mechanisms,
-        # which the PLD accountant composes individually.
+        # which the PLD accountant composes individually. ``metric``
+        # labels the mechanism in the privacy audit record, matching the
+        # generic factory's labels.
         return budget_accountant.request_budget(
-            mechanism_type, weight=weight, internal_splits=internal_splits)
+            mechanism_type, weight=weight, internal_splits=internal_splits,
+            metric=metric)
 
     if "VARIANCE" in names:
-        specs["mean_var"] = request(internal_splits=3)
+        specs["mean_var"] = request("variance", internal_splits=3)
     elif "MEAN" in names:
-        specs["mean_var"] = request(internal_splits=2)
+        specs["mean_var"] = request("mean", internal_splits=2)
     else:
         if "COUNT" in names:
-            specs["count"] = request()
+            specs["count"] = request("count")
         if "SUM" in names:
-            specs["sum"] = request()
+            specs["sum"] = request("sum")
     if "PRIVACY_ID_COUNT" in names:
-        specs["privacy_id_count"] = request()
+        specs["privacy_id_count"] = request("privacy_id_count")
     if "VECTOR_SUM" in names:
         specs["vector_sum"] = request(
-            internal_splits=int(config.vector_size))
+            "vector_sum", internal_splits=int(config.vector_size))
     if config.percentiles:
         # One budget for all percentiles, requested last — same order as
         # the generic factory (combiners.py:552-558).
         specs["percentile"] = request(
+            "percentile",
             internal_splits=quantile_tree_ops.DEFAULT_TREE_HEIGHT)
     return specs
 
@@ -1903,6 +1907,93 @@ def _assemble_output(config: FusedConfig, vocab, metric_arrays, rel_sel,
             "MetricsTuple", tuple_fields, vals))
         for i, vals in zip(vocab_idx.tolist(), zip(*columns))
     ]
+
+
+def _record_selection_audit(strategy, pre: int, post: int,
+                            path: str) -> None:
+    """The selection-seam audit counters: pre/post-selection partition
+    counts + one structured event per selection, feeding the run
+    report's ``privacy.partition_selection`` section. Gated on the
+    audit knob (``PIPELINEDP_TPU_AUDIT``); pure host-side bookkeeping —
+    DP outputs are bit-identical on or off."""
+    from pipelinedp_tpu import obs
+    if not obs.audit.audit_enabled():
+        return
+    obs.inc("selection.partitions_pre", int(pre))
+    obs.inc("selection.partitions_post", int(post))
+    obs.event("selection.applied", strategy=str(strategy.value),
+              pre=int(pre), post=int(post), path=path)
+
+
+def _audit_expected_errors(config: FusedConfig, specs, metric_arrays,
+                           rel_sel) -> None:
+    """Per-metric expected relative error into the audit registry: the
+    calibrated noise stddev (where the standard predictors apply)
+    against the mean |released aggregate| — the machine-readable twin of
+    the utility-analysis engine's ``error_expected``, captured at the
+    release seam where both sides are known. Never raises."""
+    from pipelinedp_tpu import obs
+    if not obs.audit.audit_enabled():
+        return
+    try:
+        names = set(config.metrics)
+        stds: Dict[str, float] = {}
+        if "VARIANCE" in names or "MEAN" in names:
+            # The combiner splits the granted budget evenly into its
+            # count / normalized-sum (/ sum-of-squares) sub-mechanisms;
+            # predict the count leg's noise at that per-sub share.
+            spec = specs["mean_var"]
+            k = 3 if "VARIANCE" in names else 2
+            sub = dataclasses.replace(
+                _release_noise_params(config, spec),
+                eps=spec.eps / k, delta=(spec.delta or 0.0) / k)
+            stds["count"] = dp_computations.compute_dp_count_noise_std(sub)
+        else:
+            if "COUNT" in names:
+                stds["count"] = dp_computations.compute_dp_count_noise_std(
+                    _release_noise_params(config, specs["count"]))
+            if "SUM" in names:
+                stds["sum"] = dp_computations.compute_dp_sum_noise_std(
+                    _release_noise_params(config, specs["sum"]))
+        if "PRIVACY_ID_COUNT" in names:
+            snp = _release_noise_params(config,
+                                        specs["privacy_id_count"])
+            l0, linf = snp.pid_count_sensitivities()
+            stds["privacy_id_count"] = dp_computations._noise_std(
+                snp.eps, snp.delta, l0, linf, snp.noise_kind)
+        for field in _metric_field_order(config):
+            arr = metric_arrays.get(field)
+            if arr is None:
+                continue
+            arr = np.asarray(arr)
+            if arr.ndim != 1:
+                continue  # vector metrics: no scalar scale
+            released = arr[rel_sel] if len(rel_sel) else arr[:0]
+            scale = (float(np.mean(np.abs(released)))
+                     if released.size else None)
+            std = stds.get(field)
+            rec = {"metric": field, "noise_stddev": std,
+                   "aggregate_scale": scale,
+                   "partitions": int(released.size)}
+            if std is not None and scale:
+                rec["expected_relative_error"] = float(std / scale)
+            obs.audit.record_metric_error(rec)
+    except Exception:
+        pass  # an error estimate must never take the release down
+
+
+def _maybe_append_run_ledger(name: str = "engine.aggregate",
+                             mesh=None) -> None:
+    """Traced engine runs persist their run report into the durable
+    ledger store (when a store directory resolves — see
+    ``obs.store.ledger_dir``): the per-request audit record that
+    otherwise dies with the process. Each append carries only this
+    request's delta; ``mesh`` keys the fingerprint on the mesh shape
+    the request actually ran on."""
+    from pipelinedp_tpu import obs
+    if not obs.trace_enabled():
+        return
+    obs.store.maybe_append_run_report(name, mesh=mesh)
 
 
 class LazyFusedResult:
@@ -2050,6 +2141,9 @@ class LazyFusedResult:
                                        metric_arrays, rel_sel,
                                        vocab_idx)
             self.timings["host_decode_s"] = tr.total("engine.release")
+            _audit_expected_errors(config, self._specs, metric_arrays,
+                                   rel_sel)
+            _maybe_append_run_ledger(mesh=self._mesh)
             return out
 
         with tr.span("engine.device", cat="engine", path="single_batch"):
@@ -2116,6 +2210,12 @@ class LazyFusedResult:
                     else:
                         fetched[name] = np.asarray(arr)[:P]
         self.timings["device_s"] = tr.total("engine.device")
+        if config.selection is not None:
+            # The selection seam: every vocab entry is a populated
+            # partition, so P is the pre-selection count and the kept
+            # index set is the post-selection count.
+            _record_selection_audit(config.selection, P, len(kept_idx),
+                                    "single_batch")
 
         # The scalar DP release, in float64 via the shared mechanisms.
         # Integer columns stay integral: the hardened noise path
@@ -2151,6 +2251,8 @@ class LazyFusedResult:
             out = _assemble_output(config, encoded.pk_vocab,
                                    metric_arrays, rel_sel, vocab_idx)
         self.timings["host_decode_s"] = tr.total("engine.release")
+        _audit_expected_errors(config, self._specs, metric_arrays, rel_sel)
+        _maybe_append_run_ledger(mesh=self._mesh)
         return out
 
 
@@ -2240,7 +2342,10 @@ class LazySelectResult:
                 thr, s_scale, min_count, 1.0, self._rng_seed,
                 mesh=self._mesh)
             vocab = encoded.pk_vocab
-            return [vocab[i] for i in np.flatnonzero(keep_np[:P])]
+            out = [vocab[i] for i in np.flatnonzero(keep_np[:P])]
+            _maybe_append_run_ledger("engine.select_partitions",
+                                     mesh=self._mesh)
+            return out
         keep_pk, _, _ = _run_fused_kernel(
             config, encoded, np.zeros(0, np.float32), keep_table, thr,
             s_scale, min_count, 1.0, self._rng_seed, self._mesh)
@@ -2253,8 +2358,14 @@ class LazySelectResult:
         n_keep = int(packed[0, 0])
         if n_keep > cap:
             keep_np = np.asarray(keep_pk)[:P]
-            return [vocab[i] for i in np.flatnonzero(keep_np)]
-        return [vocab[i] for i in packed[1, :n_keep].tolist()]
+            out = [vocab[i] for i in np.flatnonzero(keep_np)]
+        else:
+            out = [vocab[i] for i in packed[1, :n_keep].tolist()]
+        _record_selection_audit(config.selection, P, len(out),
+                                "select_partitions")
+        _maybe_append_run_ledger("engine.select_partitions",
+                                 mesh=self._mesh)
+        return out
 
 
 def build_fused_select_partitions(col, params, data_extractors,
@@ -2267,7 +2378,7 @@ def build_fused_select_partitions(col, params, data_extractors,
     from pipelinedp_tpu.aggregate_params import MechanismType
 
     spec = budget_accountant.request_budget(
-        mechanism_type=MechanismType.GENERIC)
+        mechanism_type=MechanismType.GENERIC, metric="partition_selection")
     strategy = params.partition_selection_strategy
     report_gen.add_stage(
         f"Cross-partition contribution bounding: for each privacy_id "
@@ -2299,7 +2410,8 @@ def build_fused_aggregation(col, params: AggregateParams, data_extractors,
     selection_spec = None
     if not public:
         selection_spec = budget_accountant.request_budget(
-            mechanism_type=MechanismType.GENERIC)
+            mechanism_type=MechanismType.GENERIC,
+            metric="partition_selection")
 
     if not config.bounds_already_enforced:
         if config.max_contributions is not None:

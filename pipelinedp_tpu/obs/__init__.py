@@ -29,6 +29,19 @@ This package is the one place that knowledge accumulates:
 * :func:`device_annotation` — optional ``jax.profiler`` trace
   annotation around device kernel dispatches, active only under
   ``PIPELINEDP_TPU_TRACE``.
+* :mod:`~pipelinedp_tpu.obs.audit` — the structured privacy/utility
+  audit registry behind the run report's schema-v2 ``privacy`` section:
+  per-mechanism eps/delta splits + noise stddevs (pushed by
+  ``BudgetAccountant.compute_budgets``), aggregation shapes (pushed by
+  ``DPEngine``), selection pre/post counts, per-metric expected errors.
+  Default-on; ``PIPELINEDP_TPU_AUDIT=0`` opts out (DP outputs are
+  bit-identical either way).
+* :mod:`~pipelinedp_tpu.obs.store` — the durable append-only JSONL
+  run-ledger store (``PIPELINEDP_TPU_LEDGER_DIR``, default a sibling of
+  the compile cache): fsync'd per-entry appends keyed by an
+  environment-fingerprint hash, torn-line-tolerant reads, and
+  ``last_known_good`` queries that never hand back a degraded run —
+  the substrate ``bench.py --compare`` gates regressions on.
 
 Threading/cycles: this package imports only the stdlib at module level
 (``resilience`` and the engine import it lazily or downstream), and the
@@ -40,6 +53,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
+from pipelinedp_tpu.obs import audit, store
 from pipelinedp_tpu.obs import report as _report
 from pipelinedp_tpu.obs.tracer import (ENV_VAR, MAX_EVENTS, MAX_SPANS,
                                        NOOP_SPAN, NOOP_TRACER, NoopTracer,
@@ -54,7 +68,7 @@ __all__ = [
     "trace_enabled", "trace_destination",
     "ledger", "tracer", "run_tracer", "span", "inc", "event", "reset",
     "environment_fingerprint", "build_run_report", "write_chrome_trace",
-    "device_annotation",
+    "device_annotation", "audit", "store",
 ]
 
 #: The process-global run ledger.
@@ -99,8 +113,11 @@ def event(name: str, **attrs) -> None:
 
 
 def reset() -> None:
-    """Start a fresh ledger (tests; bench run boundaries)."""
+    """Start a fresh ledger AND audit registry (tests; bench run
+    boundaries)."""
     _LEDGER.reset()
+    audit.reset()
+    store.reset_run_report_cursor()
 
 
 def build_run_report(mesh=None, extra: Optional[Dict[str, Any]] = None,

@@ -21,30 +21,54 @@ from __future__ import annotations
 import json
 import os
 import subprocess
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
+
+from pipelinedp_tpu.obs import audit as _audit
 
 #: Version of the run-report layout. Bump on any breaking change to the
 #: top-level keys; readers refuse (or warn on) unknown majors.
-SCHEMA_VERSION = 1
+#: v2 (run-ledger PR): adds the structured ``privacy`` audit section;
+#: v1 reports differ only by its absence, so readers treat v1 as
+#: "privacy unknown", never as an error.
+SCHEMA_VERSION = 2
 
-_git_sha_cache: Optional[str] = None
+_git_probe_cache: Optional[Tuple[str, bool]] = None
 
 
 def _git_sha() -> Optional[str]:
-    """Best-effort git SHA of the source tree this process imported
-    (cached; None outside a work tree or without git)."""
-    global _git_sha_cache
-    if _git_sha_cache is None:
+    """Best-effort git SHA of the source tree this process imported,
+    with ``-dirty`` appended when ``git status --porcelain`` is
+    non-empty — an env fingerprint must never alias uncommitted code to
+    a committed SHA. Both probes run once and cache together (None
+    outside a work tree or without git)."""
+    global _git_probe_cache
+    if _git_probe_cache is None:
+        sha, dirty = "", False
+        here = os.path.dirname(os.path.abspath(__file__))
         try:
-            here = os.path.dirname(os.path.abspath(__file__))
             out = subprocess.run(
                 ["git", "rev-parse", "HEAD"], cwd=here, timeout=10,
                 capture_output=True, text=True)
-            _git_sha_cache = (out.stdout.strip()
-                              if out.returncode == 0 else "")
+            sha = out.stdout.strip() if out.returncode == 0 else ""
         except Exception:
-            _git_sha_cache = ""
-    return _git_sha_cache or None
+            sha = ""
+        if sha:
+            # An unreadable/failed status is NOT clean evidence: keep
+            # the resolved SHA but flag dirty unless status says clean
+            # — discarding the SHA here would silently re-key the
+            # ledger fingerprint and orphan every baseline.
+            try:
+                st = subprocess.run(
+                    ["git", "status", "--porcelain"], cwd=here,
+                    timeout=10, capture_output=True, text=True)
+                dirty = (st.returncode != 0) or bool(st.stdout.strip())
+            except Exception:
+                dirty = True
+        _git_probe_cache = (sha, dirty)
+    sha, dirty = _git_probe_cache
+    if not sha:
+        return None
+    return sha + ("-dirty" if dirty else "")
 
 
 def environment_fingerprint(mesh=None) -> Dict[str, Any]:
@@ -116,6 +140,11 @@ def build_run_report(snapshot: Dict[str, Any], mesh=None,
         "counters": dict(snapshot.get("counters", {})),
         "events": list(snapshot.get("events", [])),
         "spans": span_summary(snapshot.get("spans", [])),
+        # v2: the structured privacy/utility audit — per-mechanism
+        # eps/delta splits and noise stddevs, aggregation shapes,
+        # selection pre/post counts, expected errors (obs.audit).
+        "privacy": _audit.build_privacy_section(
+            counters=snapshot.get("counters", {})),
         "dropped": {"spans": snapshot.get("dropped_spans", 0),
                     "events": snapshot.get("dropped_events", 0)},
     }

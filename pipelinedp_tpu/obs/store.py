@@ -1,0 +1,310 @@
+"""Durable cross-run ledger store: append-only JSONL of run reports.
+
+PR 4 made one run self-describing; this store makes that knowledge
+**outlive the process** — the substrate the self-tuning planner ("fit
+from accumulated run reports, persisted next to the compile cache") and
+the long-lived DP service's per-request audit records both build on.
+
+One entry per line::
+
+    {"schema_version": 2, "name": "<record name>",
+     "fingerprint": "<16-hex env hash>", "degraded": false,
+     "ts": <unix seconds>, "payload": {...}}
+
+* **Location** — ``PIPELINEDP_TPU_LEDGER_DIR`` names the directory;
+  unset, it defaults to a ``pdp_run_ledger`` sibling of the persistent
+  compile cache (``PIPELINEDP_TPU_COMPILE_CACHE``) so the two durable
+  artifacts live together. With neither set, callers may pass their own
+  default (bench uses ``./.pdp_ledger``); library code appends nothing.
+* **Durability** — every append is one line written under a lock and
+  fsync'd before returning; a crash can lose at most the in-flight
+  line, never a previously acknowledged one.
+* **Torn-line tolerance** — the reader skips unparseable lines (the
+  truncated trailing line a crash mid-write leaves) and counts them in
+  ``skipped_lines``; the appender re-establishes line-start first, so a
+  store with a torn tail keeps accepting records.
+* **Fingerprint keying** — entries key on a hash of the STABLE
+  environment-fingerprint fields (versions, device kind/count, git SHA
+  incl. ``-dirty``, mesh shape) — NOT the volatile flag set, so a
+  traced and an untraced run on the same build compare against each
+  other.
+* **Baseline discipline** — ``last_known_good`` NEVER returns a
+  ``degraded: true`` entry: a tunnel-wedged CPU-fallback capture (the
+  r4/r5 failure mode) can neither become a baseline nor mask one.
+
+Readers tolerate schema v1 entries (pre-``privacy``-section reports):
+``schema_version``/``degraded`` default to 1/False when absent.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from pipelinedp_tpu.obs.report import SCHEMA_VERSION
+
+ENV_VAR = "PIPELINEDP_TPU_LEDGER_DIR"
+LEDGER_FILENAME = "run_ledger.jsonl"
+
+#: Environment-fingerprint fields that define "the same setup" across
+#: runs. Deliberately excludes ``flags`` (PIPELINEDP_TPU_TRACE etc. must
+#: not split baselines) and ``degraded`` (tracked per entry instead).
+FINGERPRINT_FIELDS = ("jax_version", "jaxlib_version", "platform",
+                      "device_kind", "device_count", "process_count",
+                      "git_sha", "mesh_shape")
+
+
+def ledger_dir(default: Optional[str] = None) -> Optional[str]:
+    """Resolve the store directory: ``PIPELINEDP_TPU_LEDGER_DIR``, else
+    a ``pdp_run_ledger`` sibling of the compile cache, else
+    ``default`` (None: no store — library code then appends nothing)."""
+    path = os.environ.get(ENV_VAR)
+    if path:
+        return path
+    cache = os.environ.get("PIPELINEDP_TPU_COMPILE_CACHE")
+    if cache:
+        return os.path.join(os.path.dirname(os.path.abspath(cache)),
+                            "pdp_run_ledger")
+    return default
+
+
+def fingerprint_key(env: Optional[Dict[str, Any]]) -> str:
+    """16-hex digest of the stable fingerprint fields of ``env`` (an
+    ``obs.environment_fingerprint()`` dict)."""
+    env = env or {}
+    basis = {k: env.get(k) for k in FINGERPRINT_FIELDS}
+    blob = json.dumps(basis, sort_keys=True, default=repr)
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]
+
+
+class LedgerStore:
+    """Append-only JSONL store over one ``run_ledger.jsonl`` file.
+
+    Thread-safe within a process (one lock per store instance; share
+    the instance across threads). Cross-process appends rely on
+    O_APPEND single-write lines; the tolerant reader absorbs the rare
+    torn line either way."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        self.path = os.path.join(directory, LEDGER_FILENAME)
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+        #: Unparseable lines seen by the last ``entries()`` read.
+        self.skipped_lines = 0
+
+    # --- writing ---
+
+    def append(self, name: str, payload: Dict[str, Any],
+               env: Optional[Dict[str, Any]] = None,
+               degraded: Optional[bool] = None,
+               run_id: Optional[str] = None) -> Dict[str, Any]:
+        """Append one entry; fsync before returning. ``env`` is the
+        environment fingerprint the entry keys on (falls back to a
+        ``payload["env"]`` if present); ``degraded`` defaults to the
+        fingerprint's flag. ``run_id`` groups entries emitted by one
+        process run (bench re-samples a metric within a run; baseline
+        queries use the grouping to apply per-run best-sample rules)."""
+        if env is None and isinstance(payload, dict):
+            env = payload.get("env")
+        env = env or {}
+        entry = {
+            "schema_version": SCHEMA_VERSION,
+            "name": name,
+            "fingerprint": fingerprint_key(env),
+            "degraded": (bool(env.get("degraded")) if degraded is None
+                         else bool(degraded)),
+            "ts": time.time(),
+            "payload": payload,
+        }
+        if run_id is not None:
+            entry["run_id"] = run_id
+        line = (json.dumps(entry, default=repr) + "\n").encode("utf-8")
+        with self._lock:
+            with open(self.path, "ab") as f:
+                if f.tell() > 0 and not self._ends_with_newline():
+                    # A torn trailing line from a crashed writer: start a
+                    # fresh line so THIS record stays parseable (the torn
+                    # one is skipped by the tolerant reader).
+                    f.write(b"\n")
+                f.write(line)
+                f.flush()
+                os.fsync(f.fileno())
+        return entry
+
+    def _ends_with_newline(self) -> bool:
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(-1, os.SEEK_END)
+                return f.read(1) == b"\n"
+        except OSError:
+            return True
+
+    # --- reading ---
+
+    def entries(self) -> List[Dict[str, Any]]:
+        """All parseable entries in append order. Skips (and counts)
+        torn/corrupt lines instead of failing the read — a crashed
+        writer must not take the whole history down."""
+        out: List[Dict[str, Any]] = []
+        skipped = 0
+        try:
+            with open(self.path, "rb") as f:
+                data = f.read()
+        except OSError:
+            self.skipped_lines = 0
+            return out
+        for raw in data.split(b"\n"):
+            if not raw.strip():
+                continue
+            try:
+                entry = json.loads(raw.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError):
+                skipped += 1
+                continue
+            if not isinstance(entry, dict):
+                skipped += 1
+                continue
+            # v1 tolerance: absent fields read as their v1 meaning.
+            entry.setdefault("schema_version", 1)
+            entry.setdefault("degraded", False)
+            out.append(entry)
+        self.skipped_lines = skipped
+        return out
+
+    @staticmethod
+    def _matches(entry: Dict[str, Any], name: str,
+                 fingerprint: Optional[str]) -> bool:
+        return entry.get("name") == name and (
+            fingerprint is None or entry.get("fingerprint") == fingerprint)
+
+    def latest(self, name: str, fingerprint: Optional[str] = None,
+               entries: Optional[List[Dict[str, Any]]] = None
+               ) -> Optional[Dict[str, Any]]:
+        """Most recent entry for (name, fingerprint) — degraded or not
+        (pass a pre-read ``entries`` snapshot to pin the view)."""
+        pool = self.entries() if entries is None else entries
+        for entry in reversed(pool):
+            if self._matches(entry, name, fingerprint):
+                return entry
+        return None
+
+    def last_known_good(self, name: str,
+                        fingerprint: Optional[str] = None,
+                        entries: Optional[List[Dict[str, Any]]] = None
+                        ) -> Optional[Dict[str, Any]]:
+        """Most recent NON-degraded entry for (name, fingerprint): the
+        wedged-run-masquerade guard — a ``degraded: true`` capture is
+        never a baseline."""
+        pool = self.entries() if entries is None else entries
+        for entry in reversed(pool):
+            if self._matches(entry, name, fingerprint) and (
+                    not entry.get("degraded")):
+                return entry
+        return None
+
+    def last_known_good_map(self, fingerprint: Optional[str] = None
+                            ) -> Dict[str, Dict[str, Any]]:
+        """{record name -> last-known-good entry} for a fingerprint."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for entry in self.entries():
+            if fingerprint is not None and (
+                    entry.get("fingerprint") != fingerprint):
+                continue
+            if not entry.get("degraded"):
+                out[entry.get("name")] = entry
+        return out
+
+
+#: Process-lifetime caches behind :func:`maybe_append_run_report`: one
+#: store handle per directory and one environment probe per mesh shape,
+#: so the per-request hook never pays makedirs/device-probe on the
+#: release hot path.
+_proc_stores: Dict[str, LedgerStore] = {}
+_env_cache: Dict[Any, Dict[str, Any]] = {}
+#: Delta cursor for per-request appends: audit-registry lengths and the
+#: event count already persisted by this process, so entry k carries
+#: ONLY what request k added — never a cumulative duplicate of entries
+#: 1..k-1 (O(N^2) ledger growth otherwise).
+_report_cursor: Dict[str, Any] = {"audit": None, "events": 0}
+
+
+def reset_run_report_cursor() -> None:
+    """Forget the per-request delta cursor and the cached environment
+    probe (``obs.reset()`` calls this: a fresh ledger/audit registry
+    restarts the deltas from zero, and a run boundary may change the
+    flag set the fingerprint records)."""
+    _report_cursor["audit"] = None
+    _report_cursor["events"] = 0
+    _env_cache.clear()
+
+
+def _mesh_env_key(mesh) -> Any:
+    if mesh is None:
+        return None
+    try:
+        return tuple(zip(mesh.axis_names, mesh.devices.shape))
+    except Exception:
+        return ("unknown_mesh",)
+
+
+def maybe_append_run_report(name: str,
+                            default_dir: Optional[str] = None,
+                            extra: Optional[Dict[str, Any]] = None,
+                            mesh=None) -> Optional[Dict[str, Any]]:
+    """Append this request's run-report DELTA as entry ``name`` — the
+    traced-engine-run hook. The entry keeps the run-report shape but
+    its ``privacy`` lists and ``events`` carry only records new since
+    this process's previous append (cumulative counters/span rollups
+    stay whole: they are fixed-size). A request that added nothing
+    appends nothing. ``mesh`` keys the entry's fingerprint on the mesh
+    shape actually used. No-op (returns None) when no ledger directory
+    resolves, and swallows every failure: the store must never take an
+    aggregation down."""
+    try:
+        directory = ledger_dir(default=default_dir)
+        if not directory:
+            return None
+        from pipelinedp_tpu import obs
+        mesh_key = _mesh_env_key(mesh)
+        env = _env_cache.get(mesh_key)
+        if env is None:
+            env = obs.environment_fingerprint(mesh=mesh)
+            _env_cache[mesh_key] = env
+        report = obs.build_run_report(mesh=mesh, env=env)
+        audit_since = dict(_report_cursor["audit"] or {})
+        report["privacy"] = obs.audit.build_privacy_section(
+            counters=report.get("counters", {}), since=audit_since)
+        events = report.get("events", [])
+        ev_start = min(int(_report_cursor["events"]), len(events))
+        report["events"] = events[ev_start:]
+        priv = report["privacy"]
+        if not (priv["accountants"] or priv["aggregations"] or
+                priv["expected_errors"] or report["events"]):
+            return None
+        if extra:
+            report.update(extra)
+        store = _proc_stores.get(directory)
+        if store is None:
+            store = LedgerStore(directory)
+            _proc_stores[directory] = store
+        entry = store.append(name, {"run_report": report, "env": env},
+                             env=env)
+        # Advance by exactly what this entry carried — concurrent
+        # producers appending mid-build land in the next entry.
+        _report_cursor["audit"] = {
+            "accountants": audit_since.get("accountants", 0) +
+            len(priv["accountants"]),
+            "aggregations": audit_since.get("aggregations", 0) +
+            len(priv["aggregations"]),
+            "expected_errors": audit_since.get("expected_errors", 0) +
+            len(priv["expected_errors"]),
+        }
+        _report_cursor["events"] = len(events)
+        return entry
+    except Exception:
+        return None

@@ -1157,6 +1157,11 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                 jnp.asarray(keep_table), jnp.float32(sel_threshold),
                 jnp.float32(sel_scale), jnp.float32(sel_min_count),
                 jnp.float32(sel_rows_per_uid), k_sel))
+        # The streamed selection seam: populated partitions in vs kept
+        # partitions out, onto the privacy audit record.
+        je._record_selection_audit(config.selection,
+                                   int((nseg > 0).sum()),
+                                   int(keep.sum()), "streamed")
     stats = {"n_batches": n_batches, "chunk_rows": chunk,
              "fx_bits": fx_bits, "max_batch_rows": max_rows,
              "mesh_devices": n_dev,

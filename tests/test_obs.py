@@ -265,6 +265,46 @@ class TestParity:
                     timings[mode]["stream_t_device"])
             assert busy > 0.0
 
+    def test_audit_on_off_outputs_bit_identical(self, monkeypatch):
+        """The audit knob (PIPELINEDP_TPU_AUDIT) changes ONLY the
+        record: DP outputs bit-identical with capture on vs off, and
+        only the 'on' run populates the privacy section + selection
+        counters (same acceptance shape as the trace on/off parity)."""
+        ds, parts = make_ds(seed=23)
+        params = count_params(parts)
+        results, reports = {}, {}
+        for mode in ("off", "on"):
+            obs.reset()
+            if mode == "off":
+                monkeypatch.setenv(obs.audit.ENV_VAR, "0")
+            else:
+                monkeypatch.delenv(obs.audit.ENV_VAR, raising=False)
+            results[mode], _ = run_streamed(ds, params, seed=29)
+            reports[mode] = obs.build_run_report()
+        assert set(results["off"]) == set(results["on"])
+        for k in results["off"]:
+            ta, tb = results["off"][k], results["on"][k]
+            assert ta._fields == tb._fields
+            for f in ta._fields:
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(ta, f)),
+                    np.asarray(getattr(tb, f)),
+                    err_msg=f"partition {k}.{f}")
+        priv_on = reports["on"]["privacy"]
+        assert priv_on["enabled"] is True
+        assert priv_on["accountants"], "no accountant audit captured"
+        mech = {m["metric"] for a in priv_on["accountants"]
+                for m in a["mechanisms"]}
+        assert "partition_selection" in mech
+        assert priv_on["partition_selection"]["partitions_pre"] > 0
+        assert priv_on["aggregations"][0]["method"] == "aggregate"
+        assert priv_on["expected_errors"], "no expected errors captured"
+        # Capture disabled: the section records only that it was off.
+        priv_off = reports["off"]["privacy"]
+        assert priv_off["enabled"] is False
+        assert priv_off["accountants"] == []
+        assert priv_off["partition_selection"]["partitions_pre"] == 0
+
 
 class TestChromeTrace:
     """Export round-trip: valid JSON, valid ph/ts/dur, thread lanes."""
@@ -328,13 +368,18 @@ class TestRunReport:
         obs.inc("retry.attempts", 2)
         obs.event("health.degraded", target="cpu_platform")
         report = obs.build_run_report(extra={"note": "t"})
-        assert report["schema_version"] == obs.SCHEMA_VERSION == 1
+        assert report["schema_version"] == obs.SCHEMA_VERSION == 2
         assert report["counters"]["retry.attempts"] == 2
         assert report["spans"]["phase"]["count"] == 1
         assert any(e["name"] == "health.degraded"
                    for e in report["events"])
         assert report["note"] == "t"
         assert report["dropped"] == {"spans": 0, "events": 0}
+        # v2: the structured privacy audit section is always present.
+        priv = report["privacy"]
+        assert priv["enabled"] is True
+        assert set(priv) >= {"accountants", "aggregations",
+                             "expected_errors", "partition_selection"}
 
     def test_environment_fingerprint(self, monkeypatch):
         monkeypatch.setenv("PIPELINEDP_TPU_STREAM_CHUNK", "4242")
@@ -344,8 +389,10 @@ class TestRunReport:
         assert fp["platform"]
         assert fp["flags"]["PIPELINEDP_TPU_STREAM_CHUNK"] == "4242"
         assert fp["degraded"] is False
-        # The repo is a git work tree: the SHA must resolve.
-        assert re.fullmatch(r"[0-9a-f]{40}", fp["git_sha"] or "")
+        # The repo is a git work tree: the SHA must resolve — with
+        # "-dirty" appended when the tree has uncommitted changes, so a
+        # fingerprint can never alias uncommitted code.
+        assert re.fullmatch(r"[0-9a-f]{40}(-dirty)?", fp["git_sha"] or "")
 
 
 class TestResilienceEvents:
