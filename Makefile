@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast bench smoke multichip lint dev clean faultcheck nosleep perfcheck nofoldin obscheck noperf nostager ledgercheck noartifacts
+.PHONY: test test-fast bench smoke multichip lint dev clean faultcheck nosleep perfcheck nofoldin obscheck noperf nostager ledgercheck noartifacts watchcheck
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -55,6 +55,15 @@ obscheck: noperf noartifacts
 ledgercheck: noartifacts
 	$(PYTHON) -m pytest tests/test_ledger.py tests/test_obs.py -q
 
+# Live-telemetry acceptance suite: heartbeat atomic-replace under a
+# concurrent reader, progress/pace fields, stall watchdog firing at
+# the exact FakeClock deadline on a wedged staged fetch (zero orphan
+# threads on drain), flight-record ring + thread-stack round-trip,
+# heartbeat on/off DP bit-parity, the --summarize ledger analytics
+# CLI, and the wedged-probe watchdog-cancel path.
+watchcheck: noperf nosleep
+	$(PYTHON) -m pytest tests/test_monitor.py tests/test_obs.py -q
+
 # Lint-style check: no ad-hoc run-report/JSON-artifact writes — every
 # json.dump( file write in library/bench code must live in
 # pipelinedp_tpu/obs/ (the exporters + the durable ledger store) or
@@ -77,12 +86,19 @@ noartifacts:
 # spans so it lands in the run ledger and the bench timing fields stay
 # derived views over spans (bench.py's helpers route through
 # obs.run_tracer; tests/test_obs.py enforces the same rule in-tree).
+# obs/ is the ONE package allowed the raw timer — EXCEPT obs/monitor.py:
+# the watchdog's entire deadline story rides the injectable resilience
+# clock, so raw perf_counter there would reintroduce wall-time waits
+# no FakeClock test could pin. (time.sleep in monitor.py is already
+# banned by `nosleep`, which never excluded obs/.)
 noperf:
 	@bad=$$(grep -rn "perf_counter *(" --include='*.py' pipelinedp_tpu bench.py \
 	  | grep -v "pipelinedp_tpu/obs/" || true); \
-	if [ -n "$$bad" ]; then \
-	  echo "$$bad"; \
+	badmon=$$(grep -n "perf_counter *(" pipelinedp_tpu/obs/monitor.py || true); \
+	if [ -n "$$bad" ] || [ -n "$$badmon" ]; then \
+	  echo "$$bad"; echo "$$badmon"; \
 	  echo "ERROR: raw perf_counter timing — use pipelinedp_tpu.obs spans"; \
+	  echo "(obs/monitor.py must use the injectable resilience clock)"; \
 	  exit 1; \
 	fi; \
 	echo "noperf: OK"

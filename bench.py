@@ -19,9 +19,17 @@ dataset and is scaled to rows/sec; ``vs_baseline`` = fused rows/sec over
 local rows/sec on the same workload.
 
 Prints ONE JSON line on stdout (the flagship config), including the
-host/device timing split. Per-config JSON lines go to stderr, prefixed
-with nothing — each is itself valid JSON preceded by "##" comment lines
-for humans.
+host/device timing split — under ``--compare`` a one-line ``COMPARE:``
+verdict precedes it (the JSON headline stays the LAST stdout line).
+Per-config JSON lines go to stderr, prefixed with nothing — each is
+itself valid JSON preceded by "##" comment lines for humans.
+
+With ``PIPELINEDP_TPU_HEARTBEAT`` set, a monitor thread additionally
+streams an atomically-replaced heartbeat file (progress, rows/s,
+pace-vs-baseline) and watches for stalls: a wedged device probe is
+cancelled at the stall deadline (``PIPELINEDP_TPU_STALL_S``) instead of
+the full 300s probe timeout, and the degraded artifact embeds the
+flight-record path and stall diagnosis.
 
 Every record (and the final run report) also appends to the durable
 run-ledger store (``obs.store``; ``PIPELINEDP_TPU_LEDGER_DIR``, else a
@@ -967,6 +975,30 @@ def compare_to_baseline(records=None, run_report=None, threshold=0.10):
             "regressed": regressed}
 
 
+def compare_verdict_line(regressions):
+    """The one-line ``--compare`` verdict printed to STDOUT (before the
+    headline JSON, which stays the last stdout line): interactive runs
+    see the gate result without opening the artifact."""
+    if regressions["regressed"]:
+        return (f"COMPARE: REGRESSED — "
+                f"{', '.join(regressions['regressed'])} dropped "
+                f">{regressions['threshold']:.0%} vs last-known-good "
+                f"(fingerprint {regressions['fingerprint']})")
+    n_based = sum(1 for r in regressions["rates"]
+                  if r.get("baseline") is not None)
+    if n_based == 0:
+        # Nothing was actually gated — say so, instead of an "on pace"
+        # that reads as a passing verdict on a first run or a fresh
+        # fingerprint with no last-known-good.
+        return (f"COMPARE: no baseline — none of "
+                f"{len(regressions['rates'])} rate(s) had a "
+                f"last-known-good for fingerprint "
+                f"{regressions['fingerprint']} (first run?)")
+    return (f"COMPARE: on pace — {n_based} rate(s) within "
+            f"{regressions['threshold']:.0%} of last-known-good "
+            f"(fingerprint {regressions['fingerprint']})")
+
+
 def _ensure_device_or_degrade():
     """Probe the accelerator with bounded retry + exponential backoff
     (jax backend initialization can block indefinitely on a wedged TPU
@@ -1018,6 +1050,22 @@ def main():
     if args.stream_rows is None:
         args.stream_rows = 200_000 if args.smoke else 150_000_000
 
+    # Live telemetry (opt-in via PIPELINEDP_TPU_HEARTBEAT), armed
+    # BEFORE the device probe: the probe is the stack's most notorious
+    # staller (r4/r5 sat silently through a 300s timeout), so the
+    # bench's stall action cancels a wedged probe at the stall deadline
+    # — degradation with a flight record in seconds, not minutes.
+    from pipelinedp_tpu.obs import monitor as obs_monitor
+    from pipelinedp_tpu.resilience import health as health_mod
+    monitor = obs_monitor.maybe_start(
+        run_name=f"bench-{os.getpid()}",
+        on_stall=lambda info: health_mod.cancel_active_probe())
+    if monitor is not None:
+        log(f"## heartbeat: {monitor.heartbeat_path} (every "
+            f"{monitor.interval_s:g}s; stall deadline "
+            f"{monitor.stall_s:g}s; flight record on stall: "
+            f"{monitor.flight_path})")
+
     health_report = _ensure_device_or_degrade()
 
     # Persistent XLA compile cache (opt-in): re-runs skip the cold
@@ -1028,6 +1076,14 @@ def main():
         log(f"## persistent compile cache: {cache_dir}")
 
     import pipelinedp_tpu as pdp
+
+    if monitor is not None:
+        # The pace baseline keys on the environment fingerprint, which
+        # probes jax.devices() — only safe to compute AFTER the health
+        # probe settled the platform (a wedged runtime blocks there).
+        from pipelinedp_tpu.obs import store as obs_store
+        monitor.attach_baseline(obs_store.fingerprint_key(
+            env_fingerprint()))
 
     if args.smoke:
         n_rows, n_users, local_rows = 50_000, 5_000, 20_000
@@ -1165,6 +1221,19 @@ def main():
                 ("metric", "value", "unit", "vs_baseline",
                  "host_s", "device_s") if k in flagship}
     headline["degraded"] = bool(health_report.degraded)
+    if health_report.degraded:
+        # The artifact used to say only "degraded": true (plus an
+        # attempt count buried in stderr) — now it carries the probe
+        # diagnosis and, when the stall watchdog fired, the stall
+        # diagnosis + flight-record path, so a wedged capture explains
+        # itself without session notes.
+        diagnosis = {"probe_attempts": health_report.attempts,
+                     "detail": health_report.detail}
+        if monitor is not None and monitor.stalls:
+            last = monitor.stalls[-1]
+            diagnosis["stall"] = last["diagnosis"]
+            diagnosis["flight_record"] = last["flight_record"]
+        headline["degraded_diagnosis"] = diagnosis
     headline["env"] = env_fingerprint()
     # ONE ledger snapshot feeds every exporter, so the trace file, the
     # report and the stored ledger entry agree span-for-span; the
@@ -1191,7 +1260,10 @@ def main():
         else:
             log("## compare: no rate regressions vs last-known-good "
                 f"(fingerprint {regressions['fingerprint']})")
+        print(compare_verdict_line(regressions))
     print(json.dumps(headline))
+    if monitor is not None:
+        obs_monitor.stop()  # writes one final heartbeat beat, joins
     if args.strict and regressions and regressions["regressed"]:
         # Mark this run as gate-failed so its regressed numbers never
         # become the next run's baseline (the gate must stay red until

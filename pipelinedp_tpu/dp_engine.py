@@ -144,6 +144,12 @@ class DPEngine:
         self._check_aggregate_params(col, params, data_extractors)
         self._record_aggregation_audit("aggregate", params,
                                        public_partitions)
+        # Live telemetry (PIPELINEDP_TPU_HEARTBEAT): arm the heartbeat/
+        # stall-watchdog monitor for engine-driven runs too, not just
+        # the bench — single-batch aggregations stall the same way
+        # streamed ones do. No-op (and costless) when the knob is off.
+        from pipelinedp_tpu import obs
+        obs.monitor.maybe_start()
 
         with self._budget_accountant.scope(weight=params.budget_weight):
             self._report_generators.append(

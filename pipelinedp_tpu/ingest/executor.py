@@ -86,10 +86,18 @@ class StagingRing:
 
 class _CaptureThread(threading.Thread):
     """Worker thread that captures its body's exception for re-raising
-    on the dispatch thread (``IngestCancelled`` is a clean exit)."""
+    on the dispatch thread (``IngestCancelled`` is a clean exit).
+
+    Every instance carries a stable ``pdp-*`` name (``pdp-ingest-<x>``
+    unless the caller supplies a full ``pdp-`` name, e.g. the obs
+    monitor's ``pdp-monitor``): the Chrome-trace thread metadata and
+    the flight recorder's ``sys._current_frames()`` stack summaries
+    key on these names, and the orphan-drain tests enumerate them."""
 
     def __init__(self, body, name: str):
-        super().__init__(name=f"{THREAD_PREFIX}-{name}", daemon=True)
+        super().__init__(name=(name if name.startswith("pdp-")
+                               else f"{THREAD_PREFIX}-{name}"),
+                         daemon=True)
         self._body = body
         self.exc: Optional[BaseException] = None
 

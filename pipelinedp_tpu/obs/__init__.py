@@ -41,7 +41,16 @@ This package is the one place that knowledge accumulates:
   the compile cache): fsync'd per-entry appends keyed by an
   environment-fingerprint hash, torn-line-tolerant reads, and
   ``last_known_good`` queries that never hand back a degraded run —
-  the substrate ``bench.py --compare`` gates regressions on.
+  the substrate ``bench.py --compare`` gates regressions on. Also the
+  ledger-analytics CLI (``python -m pipelinedp_tpu.obs.store
+  --summarize``): per-(fingerprint, phase) cost tables with trends.
+* :mod:`~pipelinedp_tpu.obs.monitor` — the LIVE half
+  (``PIPELINEDP_TPU_HEARTBEAT``): a monitor thread streaming an
+  atomically-replaced heartbeat file (phase, progress vs plan, rows/s,
+  pace vs the store's baseline) and a stall watchdog
+  (``PIPELINEDP_TPU_STALL_S``) that dumps a flight record — active
+  spans, recent ring, per-``pdp-*``-thread stacks — when no span opens
+  or closes for the deadline, then runs a pluggable action.
 
 Threading/cycles: this package imports only the stdlib at module level
 (``resilience`` and the engine import it lazily or downstream), and the
@@ -55,20 +64,21 @@ from typing import Any, Dict, Optional
 
 from pipelinedp_tpu.obs import audit, store
 from pipelinedp_tpu.obs import report as _report
-from pipelinedp_tpu.obs.tracer import (ENV_VAR, MAX_EVENTS, MAX_SPANS,
-                                       NOOP_SPAN, NOOP_TRACER, NoopTracer,
-                                       RunLedger, Span, Tracer,
+from pipelinedp_tpu.obs.tracer import (ACTIVITY, ENV_VAR, MAX_EVENTS,
+                                       MAX_SPANS, NOOP_SPAN, NOOP_TRACER,
+                                       NoopTracer, RunLedger, Span, Tracer,
                                        trace_destination, trace_enabled)
 from pipelinedp_tpu.obs.report import SCHEMA_VERSION, environment_fingerprint
+from pipelinedp_tpu.obs import monitor  # noqa: E402 (needs store first)
 
 __all__ = [
     "ENV_VAR", "SCHEMA_VERSION", "MAX_SPANS", "MAX_EVENTS",
     "Span", "Tracer", "NoopTracer", "RunLedger",
-    "NOOP_SPAN", "NOOP_TRACER",
+    "NOOP_SPAN", "NOOP_TRACER", "ACTIVITY",
     "trace_enabled", "trace_destination",
     "ledger", "tracer", "run_tracer", "span", "inc", "event", "reset",
     "environment_fingerprint", "build_run_report", "write_chrome_trace",
-    "device_annotation", "audit", "store",
+    "device_annotation", "audit", "store", "monitor",
 ]
 
 #: The process-global run ledger.
@@ -78,6 +88,12 @@ _LEDGER = RunLedger()
 #: global and unread; sites that need per-run totals use run_tracer).
 _RECORDING = Tracer(ledger=_LEDGER)
 
+#: Measuring-only tracer handed out when the live monitor is armed but
+#: full tracing is off: real span handles (so the activity registry —
+#: and thus the heartbeat/watchdog — sees opens/closes) without any
+#: ledger growth.
+_MEASURING = Tracer()
+
 
 def ledger() -> RunLedger:
     return _LEDGER
@@ -85,8 +101,14 @@ def ledger() -> RunLedger:
 
 def tracer() -> Any:
     """Global tracer for ledger-only span sites: recording when
-    ``PIPELINEDP_TPU_TRACE`` is set, the shared no-op otherwise."""
-    return _RECORDING if trace_enabled() else NOOP_TRACER
+    ``PIPELINEDP_TPU_TRACE`` is set, measuring-only when the live
+    monitor is armed (its watchdog needs real span open/close signals),
+    the shared no-op otherwise."""
+    if trace_enabled():
+        return _RECORDING
+    if ACTIVITY.enabled:
+        return _MEASURING
+    return NOOP_TRACER
 
 
 def run_tracer(clock=None) -> Tracer:

@@ -21,6 +21,7 @@ from __future__ import annotations
 import json
 import os
 import subprocess
+import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 from pipelinedp_tpu.obs import audit as _audit
@@ -153,7 +154,23 @@ def build_run_report(snapshot: Dict[str, Any], mesh=None,
     return report
 
 
-def chrome_trace_events(snapshot: Dict[str, Any]) -> List[Dict[str, Any]]:
+def thread_name_map(snapshot: Dict[str, Any]) -> Dict[int, str]:
+    """tid → stable thread name, from BOTH the recorded spans and the
+    live ``pdp-*`` worker threads (a worker that staged batches but
+    never completed a span — e.g. one wedged mid-fetch — must still
+    label its Perfetto lane and its flight-record stack)."""
+    names: Dict[int, str] = {}
+    for s in snapshot.get("spans", []):
+        names.setdefault(s.tid, s.thread)
+    for t in threading.enumerate():
+        if t.name.startswith("pdp-") and t.ident is not None:
+            names.setdefault(t.ident, t.name)
+    return names
+
+
+def chrome_trace_events(snapshot: Dict[str, Any],
+                        threads: Optional[Dict[int, str]] = None
+                        ) -> List[Dict[str, Any]]:
     """Convert a ledger snapshot to Chrome trace-event dicts. Spans
     become ``ph: "X"`` complete events; ledger events become ``ph: "i"``
     instants. Timestamps rebase to the earliest record (µs)."""
@@ -163,9 +180,9 @@ def chrome_trace_events(snapshot: Dict[str, Any]) -> List[Dict[str, Any]]:
     t0 = min([s.ts for s in spans] +
              [e["ts"] for e in events if "ts" in e], default=0.0)
     out: List[Dict[str, Any]] = []
-    threads = {}
+    if threads is None:
+        threads = thread_name_map(snapshot)
     for s in spans:
-        threads.setdefault(s.tid, s.thread)
         out.append({"ph": "X", "name": s.name, "cat": s.cat,
                     "pid": pid, "tid": s.tid,
                     "ts": (s.ts - t0) * 1e6, "dur": s.dur * 1e6,
@@ -190,10 +207,17 @@ def _jsonable(v):
 
 
 def write_chrome_trace(path: str, snapshot: Dict[str, Any]) -> str:
-    """Write the Chrome-trace JSON for ``snapshot``; returns ``path``."""
-    payload = {"traceEvents": chrome_trace_events(snapshot),
+    """Write the Chrome-trace JSON for ``snapshot``; returns ``path``.
+    ``otherData.thread_names`` duplicates the tid→name metadata rows as
+    one flat map, so flight-record consumers (and humans grepping the
+    file) can label stacks without replaying the event stream."""
+    threads = thread_name_map(snapshot)
+    payload = {"traceEvents": chrome_trace_events(snapshot, threads),
                "displayTimeUnit": "ms",
-               "otherData": {"schema_version": SCHEMA_VERSION}}
+               "otherData": {"schema_version": SCHEMA_VERSION,
+                             "thread_names": {
+                                 str(tid): name for tid, name in
+                                 sorted(threads.items())}}}
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     with open(path, "w", encoding="utf-8") as f:

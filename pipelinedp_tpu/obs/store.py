@@ -252,6 +252,145 @@ def _mesh_env_key(mesh) -> Any:
         return ("unknown_mesh",)
 
 
+# --- ledger analytics (``python -m pipelinedp_tpu.obs.store``) ---
+
+
+def _trend(samples: List[float]) -> Optional[float]:
+    """Latest sample vs the mean of the PRIOR samples, as a signed
+    fractional delta (+0.2 = latest costs 20% more than history).
+    None until there are two samples."""
+    if len(samples) < 2:
+        return None
+    prior = samples[:-1]
+    mean = sum(prior) / len(prior)
+    if mean <= 0:
+        return None
+    return samples[-1] / mean - 1.0
+
+
+def summarize_entries(entries: List[Dict[str, Any]]
+                      ) -> Dict[str, Dict[str, Any]]:
+    """Aggregate accumulated ledger entries into per-(fingerprint,
+    phase) cost tables with trend deltas — the raw material the future
+    autotune planner consumes (ROADMAP: "fit from accumulated run
+    reports"). Two tables per fingerprint:
+
+    * ``phases`` — from every ``run_report`` entry's span summary:
+      per span name, how many reports carried it, summed/mean/latest
+      busy seconds, and ``trend`` (latest vs the mean of prior
+      reports — the regression direction at a glance);
+    * ``metrics`` — from every rate-carrying bench record
+      (``payload.record.value`` with a ``.../s`` unit): samples,
+      best/latest value, and the same trend delta (positive = faster).
+    """
+    out: Dict[str, Dict[str, Any]] = {}
+    for e in entries:
+        fp = e.get("fingerprint")
+        agg = out.setdefault(fp, {"runs": 0, "degraded_runs": 0,
+                                  "phases": {}, "metrics": {}})
+        payload = e.get("payload") or {}
+        rr = payload.get("run_report")
+        if isinstance(rr, dict) and rr.get("spans"):
+            agg["runs"] += 1
+            if e.get("degraded"):
+                agg["degraded_runs"] += 1
+            for name, sp in rr["spans"].items():
+                total = sp.get("total_s")
+                if not isinstance(total, (int, float)):
+                    continue
+                agg["phases"].setdefault(name, []).append(float(total))
+        rec = payload.get("record")
+        if isinstance(rec, dict):
+            value = rec.get("value")
+            unit = rec.get("unit") or ""
+            if isinstance(value, (int, float)) and unit.endswith("/s"):
+                agg["metrics"].setdefault(
+                    e.get("name"), []).append(float(value))
+    for agg in out.values():
+        agg["phases"] = {
+            name: {"reports": len(samples),
+                   "total_s": round(sum(samples), 6),
+                   "mean_s": round(sum(samples) / len(samples), 6),
+                   "latest_s": round(samples[-1], 6),
+                   "trend": (None if _trend(samples) is None
+                             else round(_trend(samples), 4))}
+            for name, samples in agg["phases"].items()}
+        agg["metrics"] = {
+            name: {"samples": len(samples),
+                   "best": round(max(samples), 3),
+                   "latest": round(samples[-1], 3),
+                   "trend": (None if _trend(samples) is None
+                             else round(_trend(samples), 4))}
+            for name, samples in agg["metrics"].items()}
+    return out
+
+
+def _fmt_trend(trend: Optional[float]) -> str:
+    return "n/a" if trend is None else f"{trend:+.0%}"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``python -m pipelinedp_tpu.obs.store --summarize [--dir D]
+    [--fingerprint FP] [--json]`` — print per-(fingerprint, phase) cost
+    tables with trend deltas from the accumulated run ledger."""
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="python -m pipelinedp_tpu.obs.store",
+        description="Ledger analytics over the durable run-ledger "
+                    "store (run reports + bench records).")
+    parser.add_argument("--summarize", action="store_true",
+                        help="aggregate run reports into per-"
+                        "(fingerprint, phase) cost tables with trends")
+    parser.add_argument("--dir", default=None,
+                        help="ledger directory (default: "
+                        "PIPELINEDP_TPU_LEDGER_DIR resolution, else "
+                        "./.pdp_ledger)")
+    parser.add_argument("--fingerprint", default=None,
+                        help="restrict to one environment fingerprint")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable output (the autotune "
+                        "planner's input shape)")
+    args = parser.parse_args(argv)
+    if not args.summarize:
+        parser.error("nothing to do: pass --summarize")
+    directory = args.dir or ledger_dir(
+        default=os.path.join(os.getcwd(), ".pdp_ledger"))
+    s = LedgerStore(directory)
+    entries = s.entries()
+    if args.fingerprint:
+        entries = [e for e in entries
+                   if e.get("fingerprint") == args.fingerprint]
+    summary = summarize_entries(entries)
+    if args.as_json:
+        print(json.dumps({"ledger": s.path, "entries": len(entries),
+                          "skipped_lines": s.skipped_lines,
+                          "fingerprints": summary}))
+        return 0
+    print(f"ledger: {s.path} ({len(entries)} entries, "
+          f"{s.skipped_lines} skipped lines)")
+    for fp, agg in summary.items():
+        print(f"\nfingerprint {fp} — {agg['runs']} run report(s), "
+              f"{agg['degraded_runs']} degraded")
+        if agg["phases"]:
+            print(f"  {'phase':<28} {'reports':>7} {'total_s':>10} "
+                  f"{'mean_s':>10} {'latest_s':>10} {'trend':>7}")
+            ordered = sorted(agg["phases"].items(),
+                             key=lambda kv: -kv[1]["total_s"])
+            for name, ph in ordered:
+                print(f"  {name:<28} {ph['reports']:>7} "
+                      f"{ph['total_s']:>10.3f} {ph['mean_s']:>10.3f} "
+                      f"{ph['latest_s']:>10.3f} "
+                      f"{_fmt_trend(ph['trend']):>7}")
+        if agg["metrics"]:
+            print(f"  {'metric':<44} {'samples':>7} {'best':>12} "
+                  f"{'latest':>12} {'trend':>7}")
+            for name, m in sorted(agg["metrics"].items()):
+                print(f"  {name:<44} {m['samples']:>7} {m['best']:>12.1f}"
+                      f" {m['latest']:>12.1f} "
+                      f"{_fmt_trend(m['trend']):>7}")
+    return 0
+
+
 def maybe_append_run_report(name: str,
                             default_dir: Optional[str] = None,
                             extra: Optional[Dict[str, Any]] = None,
@@ -308,3 +447,7 @@ def maybe_append_run_report(name: str,
         return entry
     except Exception:
         return None
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -32,10 +32,11 @@ allowed to touch the raw timer (``make noperf`` bans it elsewhere).
 
 from __future__ import annotations
 
+import collections
 import os
 import threading
 import time as _time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 ENV_VAR = "PIPELINEDP_TPU_TRACE"
 
@@ -45,6 +46,11 @@ ENV_VAR = "PIPELINEDP_TPU_TRACE"
 #: "covered everything".
 MAX_SPANS = 200_000
 MAX_EVENTS = 20_000
+
+#: Flight-recorder ring size: the live-activity registry keeps the
+#: last N COMPLETED spans so a stall dump can show what ran just
+#: before the silence (obs/monitor.py).
+FLIGHT_RING_SPANS = 256
 
 
 def trace_enabled() -> bool:
@@ -73,6 +79,75 @@ class _PerfClock:
 
     def monotonic(self) -> float:
         return _time.perf_counter()
+
+
+class _Activity:
+    """Live span activity for the stall watchdog and heartbeat
+    (``obs/monitor.py``): which spans are OPEN right now (and on which
+    thread), a bounded ring of the most recently COMPLETED spans, and a
+    change counter (``seq``) that bumps on every span open/close — the
+    signal the watchdog ages to detect a wedged run.
+
+    Disabled (the default) this costs one module-level bool check per
+    span enter/exit and nothing else; enabled, one small lock-guarded
+    dict write. The registry stamps times with ITS OWN clock — the
+    monitor installs its clock here on start — so stall deadlines and
+    active-span ages share one time base regardless of which clock each
+    individual tracer was built with (streaming's run tracer keeps its
+    default ``perf_counter`` clock even under a ``FakeClock`` test)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.enabled = False
+        self.clock = _PerfClock()
+        self.seq = 0
+        self.active: Dict[int, Dict[str, Any]] = {}
+        self.recent: collections.deque = collections.deque(
+            maxlen=FLIGHT_RING_SPANS)
+
+    def span_opened(self, handle: "_SpanHandle") -> None:
+        t = threading.current_thread()
+        with self.lock:
+            self.seq += 1
+            self.active[id(handle)] = {
+                "name": handle.name, "cat": handle.cat,
+                "thread": t.name, "tid": t.ident or 0,
+                "t0": self.clock.monotonic(),
+                "args": {k: v for k, v in handle.args.items()
+                         if isinstance(v, (str, int, float, bool))}}
+
+    def span_closed(self, handle: "_SpanHandle", dur: float) -> None:
+        with self.lock:
+            self.seq += 1
+            info = self.active.pop(id(handle), None)
+            if info is not None:
+                self.recent.append({**info, "dur": dur})
+
+    def snapshot(self) -> Tuple[int, List[Dict[str, Any]],
+                                List[Dict[str, Any]]]:
+        """``(seq, active spans oldest-first with age_s, recent ring)``
+        — one consistent view for a heartbeat/flight-record dump."""
+        with self.lock:
+            now = self.clock.monotonic()
+            active = sorted(
+                ({**info, "age_s": now - info["t0"]}
+                 for info in self.active.values()),
+                key=lambda i: i["t0"])
+            return self.seq, active, list(self.recent)
+
+    def reset(self, enabled: bool = False, clock=None) -> None:
+        """Install/clear activity tracking (the monitor's start/stop)."""
+        with self.lock:
+            self.enabled = enabled
+            if clock is not None:
+                self.clock = clock
+            self.seq = 0
+            self.active.clear()
+            self.recent.clear()
+
+
+#: The one process-global activity registry.
+ACTIVITY = _Activity()
 
 
 class Span:
@@ -142,6 +217,15 @@ class RunLedger:
                     "dropped_spans": self.dropped_spans,
                     "dropped_events": self.dropped_events}
 
+    def tail_snapshot(self, n_events: int = 64
+                      ) -> Tuple[Dict[str, int], List[Dict[str, Any]]]:
+        """Counters + the last ``n_events`` events, WITHOUT copying the
+        span list — the monitor polls this every heartbeat beat, and a
+        traced run can hold 200k spans."""
+        with self._lock:
+            return (dict(self.counters),
+                    [dict(e) for e in self.events[-n_events:]])
+
     def reset(self) -> None:
         with self._lock:
             self.spans = []
@@ -169,11 +253,15 @@ class _SpanHandle:
 
     def __enter__(self) -> "_SpanHandle":
         self._t0 = self._tracer._clock.monotonic()
+        if ACTIVITY.enabled:
+            ACTIVITY.span_opened(self)
         return self
 
     def __exit__(self, exc_type, exc, tb) -> bool:
         t1 = self._tracer._clock.monotonic()
         self.duration = t1 - self._t0
+        if ACTIVITY.enabled:
+            ACTIVITY.span_closed(self, self.duration)
         self._tracer._finish(self, self._t0, self.duration)
         return False
 

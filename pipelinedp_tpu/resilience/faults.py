@@ -24,9 +24,15 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import os
+import threading
 from typing import Dict, Optional, Tuple
 
 ENV_VAR = "PIPELINEDP_TPU_FAULTS"
+
+#: Poll beat / safety cap for the cooperative ``hold_fetch`` wait (the
+#: wait rides ``threading.Event`` beats, never ``time.sleep``).
+_HOLD_POLL_S = 0.02
+_HOLD_MAX_S = 60.0
 
 
 class FaultInjected(Exception):
@@ -54,6 +60,18 @@ class FaultPlan:
     fail_pass_b_chunks: Tuple[int, ...] = ()
     #: first N coordinator connections raise ``CoordinatorTimeout``.
     coordinator_timeouts: int = 0
+    #: batch indices whose pass-A result FETCH blocks (holds) until
+    #: :func:`release_holds` — a wedged device/link mid-stream, the
+    #: stall the obs watchdog exists to catch. The hold is cooperative
+    #: (event poll beats, no ``time.sleep``), fires once per index, and
+    #: fails loudly after ``_HOLD_MAX_S`` so a forgotten release can
+    #: never hang a suite.
+    hold_fetch_batches: Tuple[int, ...] = ()
+    #: injected device-probe wedges HOLD for the probe timeout on the
+    #: caller's injectable clock (cancellable by the stall watchdog)
+    #: instead of returning instantly — the real blocked window the
+    #: r05 capture sat through, reproducible in bounded time.
+    wedged_hold: bool = False
 
     def to_env(self) -> str:
         parts = []
@@ -67,6 +85,11 @@ class FaultPlan:
                          ":".join(str(c) for c in self.fail_pass_b_chunks))
         if self.coordinator_timeouts:
             parts.append(f"coordinator_timeouts={self.coordinator_timeouts}")
+        if self.hold_fetch_batches:
+            parts.append("hold_fetch_batches=" +
+                         ":".join(str(c) for c in self.hold_fetch_batches))
+        if self.wedged_hold:
+            parts.append("wedged_hold=1")
         return ",".join(parts)
 
 
@@ -77,8 +100,11 @@ def plan_from_env(spec: str) -> FaultPlan:
         if not item:
             continue
         k, _, v = item.partition("=")
-        if k in ("fail_chunks", "fail_pass_b_chunks"):
+        if k in ("fail_chunks", "fail_pass_b_chunks",
+                 "hold_fetch_batches"):
             kw[k] = tuple(int(c) for c in v.split(":") if c)
+        elif k == "wedged_hold":
+            kw[k] = bool(int(v))
         else:
             kw[k] = int(v)
     return FaultPlan(**kw)
@@ -86,18 +112,37 @@ def plan_from_env(spec: str) -> FaultPlan:
 
 _plan: Optional[FaultPlan] = None
 _counters: Dict[str, int] = {}
+#: Hold-fetch handshake: ``_hold_started`` is set the moment a fetch
+#: begins holding (tests wait on it before advancing the fake clock);
+#: ``_hold_release`` wakes every held fetch.
+_hold_started = threading.Event()
+_hold_release = threading.Event()
+
+
+def hold_started() -> threading.Event:
+    """The event set when an injected hold-fetch actually blocks."""
+    return _hold_started
+
+
+def release_holds() -> None:
+    """Release every held fetch (the test's un-wedge switch)."""
+    _hold_release.set()
 
 
 def install(plan: FaultPlan) -> None:
     global _plan
     _plan = plan
     _counters.clear()
+    _hold_started.clear()
+    _hold_release.clear()
 
 
 def clear() -> None:
     global _plan
     _plan = None
     _counters.clear()
+    # Wake any still-held fetch so a test teardown can always drain.
+    _hold_release.set()
 
 
 @contextlib.contextmanager
@@ -148,6 +193,29 @@ def check_chunk(index: int) -> None:
     if plan is not None and index in plan.fail_chunks:
         _record("chunk_failure", index=int(index))
         raise ChunkFailure(f"injected failure at streaming chunk {index}")
+
+
+def check_fetch_hold(index: int) -> None:
+    """Cooperatively HOLD the first fetch of batch ``index`` when the
+    active plan asks for it: the calling worker (the fold thread under
+    the overlapped executor) blocks inside its ``ingest.fetch`` span
+    until :func:`release_holds` — exactly what a wedged device looks
+    like to the rest of the pipeline, visible to the stall watchdog as
+    an aging active span with no open/close activity behind it."""
+    plan = active()
+    if plan is None or index not in plan.hold_fetch_batches:
+        return
+    if _consume(f"hold_fetch.{index}"):
+        return  # hold only the FIRST fetch of the batch
+    _record("hold_fetch", index=int(index))
+    _hold_started.set()
+    beats = int(_HOLD_MAX_S / _HOLD_POLL_S)
+    for _ in range(beats):
+        if _hold_release.wait(_HOLD_POLL_S):
+            return
+    raise RuntimeError(
+        f"injected hold at batch {index} was never released within "
+        f"{_HOLD_MAX_S:g}s — call faults.release_holds()")
 
 
 def check_pass_b_chunk(index: int) -> None:

@@ -17,16 +17,37 @@ import dataclasses
 import os
 import subprocess
 import sys
+import threading
 from typing import List, Optional
 
 from pipelinedp_tpu.resilience import faults
-from pipelinedp_tpu.resilience.clock import Clock
+from pipelinedp_tpu.resilience.clock import Clock, SystemClock
 from pipelinedp_tpu.resilience.retry import (RetriesExhausted, RetryPolicy,
                                              call_with_retry)
 
 #: Per-attempt probe timeout; the r05 wedge took the full 300s default.
 PROBE_TIMEOUT_ENV = "PIPELINEDP_TPU_PROBE_TIMEOUT"
 DEFAULT_PROBE_TIMEOUT_S = 300.0
+
+#: Poll beat while waiting on the probe subprocess: each beat checks
+#: the watchdog-cancel event, so a stalled probe dies at the stall
+#: deadline instead of the full timeout.
+_PROBE_POLL_S = 0.25
+
+#: Set by :func:`cancel_active_probe` (the obs monitor's stall action):
+#: the in-flight probe attempt is killed and reported as cancelled.
+#: Cleared at the start of every probe attempt.
+_PROBE_CANCEL = threading.Event()
+
+
+def cancel_active_probe() -> None:
+    """Abort the in-flight device probe attempt, if any. This is the
+    stall watchdog's hook (bench wires it as its ``on_stall`` action):
+    a probe that has emitted no span activity past the stall deadline
+    is almost certainly the r05 wedge — kill it NOW, emit the flight
+    record, and let the retry/degrade machinery take over, instead of
+    sitting silently through the remaining minutes of probe timeout."""
+    _PROBE_CANCEL.set()
 
 #: Set (alongside ``JAX_PLATFORMS=cpu``) when degradation steered this
 #: process to CPU. It keeps the fallback HONEST process-wide: every
@@ -60,15 +81,33 @@ def probe_timeout_s() -> float:
                                 DEFAULT_PROBE_TIMEOUT_S))
 
 
-def probe_devices(timeout_s: Optional[float] = None):
+def probe_devices(timeout_s: Optional[float] = None,
+                  clock: Optional[Clock] = None):
     """One device probe: run ``jax.devices()`` in a killable subprocess
     (a wedged runtime blocks *inside* backend init — an in-process call
-    could never time out). Returns ``(ok, detail)``."""
+    could never time out). The wait polls in short beats so the stall
+    watchdog's :func:`cancel_active_probe` can cut a wedged probe short
+    at the stall deadline instead of the full timeout. Returns
+    ``(ok, detail)``."""
     timeout_s = probe_timeout_s() if timeout_s is None else timeout_s
+    clock = clock or SystemClock()
+    _PROBE_CANCEL.clear()
     if faults.wedged("device.probe"):
-        # Simulated wedge: the real path would block for the full
-        # timeout; the injected one reports the identical failure
-        # without burning wall time.
+        plan = faults.active()
+        if plan is not None and plan.wedged_hold:
+            # The REAL blocked window, on the injectable clock: burn
+            # the probe timeout in cancellable beats so the watchdog
+            # path is exercised end to end (a FakeClock burns it in
+            # zero wall time; the bench e2e uses a small real timeout).
+            step = min(0.05, timeout_s) if timeout_s > 0 else 0.0
+            waited = 0.0
+            while waited < timeout_s and step > 0:
+                if _PROBE_CANCEL.is_set():
+                    return False, (
+                        "device probe cancelled by the stall watchdog "
+                        f"after {waited:.1f}s (injected wedge)")
+                clock.sleep(step)
+                waited += step
         return False, (f"device probe did not return within {timeout_s:g}s"
                        " (injected wedge)")
     probe_env = dict(os.environ)
@@ -78,15 +117,40 @@ def probe_devices(timeout_s: Optional[float] = None):
         # fallback it itself installed.
         probe_env.pop("JAX_PLATFORMS", None)
     try:
-        probe = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s, capture_output=True, text=True,
-            env=probe_env)
-        if probe.returncode == 0:
+        # stderr goes to a temp FILE, not a pipe: nobody drains a pipe
+        # during the poll loop, so a chatty child (verbose TPU/grpc
+        # init logging) would fill the OS buffer, block on write, and
+        # read as a wedge. A file has no such backpressure.
+        import tempfile
+        with tempfile.TemporaryFile() as errf:
+            proc = subprocess.Popen(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                stdout=subprocess.DEVNULL, stderr=errf, env=probe_env)
+            waited = 0.0
+            while True:
+                try:
+                    proc.wait(timeout=_PROBE_POLL_S)
+                    break
+                except subprocess.TimeoutExpired:
+                    waited += _PROBE_POLL_S
+                    cancelled = _PROBE_CANCEL.is_set()
+                    if cancelled or waited >= timeout_s:
+                        proc.kill()
+                        proc.wait()
+                        if cancelled:
+                            return False, (
+                                "device probe cancelled by the stall "
+                                f"watchdog after {waited:.1f}s (wedged "
+                                "runtime?)")
+                        return False, (f"device probe did not return "
+                                       f"within {timeout_s:g}s")
+            errf.seek(0)
+            err = errf.read().decode("utf-8", errors="replace")
+        if proc.returncode == 0:
             return True, "ok"
-        return False, (probe.stderr or "")[-300:]
-    except subprocess.TimeoutExpired:
-        return False, f"device probe did not return within {timeout_s:g}s"
+        return False, err[-300:]
+    except OSError as e:
+        return False, f"{type(e).__name__}: {e}"
 
 
 class _ProbeFailed(Exception):
@@ -113,14 +177,20 @@ def ensure_device_or_degrade(policy: Optional[RetryPolicy] = None,
     attempts = [0]
     backoffs: List[float] = []
 
+    from pipelinedp_tpu import obs
+
     def attempt():
         attempts[0] += 1
-        ok, detail = probe_devices(timeout_s)
+        # The span makes the probe VISIBLE to the live monitor: its
+        # open registers activity (re-arming the stall watchdog for
+        # this attempt), and a probe that then blocks ages as an
+        # active span the watchdog can diagnose — and cancel.
+        with obs.tracer().span("health.device_probe", cat="health",
+                               attempt=attempts[0]):
+            ok, detail = probe_devices(timeout_s, clock=clock)
         if not ok:
             raise _ProbeFailed(detail)
         return detail
-
-    from pipelinedp_tpu import obs
 
     try:
         detail = call_with_retry(

@@ -666,6 +666,11 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
     # timing fields below are derived views over them), full spans
     # reach the ledger when PIPELINEDP_TPU_TRACE is set.
     tr = obs.run_tracer()
+    # Live telemetry: under PIPELINEDP_TPU_HEARTBEAT a monitor thread
+    # streams heartbeats (phase, batches/sweeps done vs planned,
+    # rows/s, pace-vs-baseline) and watches for stalls; off, this is a
+    # no-op and the spans below cost exactly what they did before.
+    obs.monitor.maybe_start()
 
     use_executor = (ingest.executor_enabled() if executor is None
                     else bool(executor))
@@ -812,6 +817,13 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
     # must not let a resumed partial run masquerade as a full one.
     obs.inc("ingest.rows_ingested",
             int(batch_rows[start_batch:].sum()))
+    # The heartbeat's denominator: how many non-empty batches this run
+    # WILL stage (a resume skips the folded prefix), so "done vs
+    # planned" is computable mid-flight, not only post-hoc.
+    obs.inc("progress.batches_planned",
+            int((batch_rows[start_batch:] > 0).sum()))
+    if config.percentiles:
+        obs.inc("progress.sweeps_planned", plan.n_sweeps)
     obs.inc("ingest.executor_overlapped" if use_executor
             else "ingest.executor_serial")
 
@@ -968,6 +980,16 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                                                        row_sharding)
                     values_d = zeros_dev
                 obs.inc("ingest.batches_staged")
+                if not track_reship:
+                    # Heartbeat progress: rows/batches actually staged
+                    # toward the pass-A plan (ingest.rows_ingested is
+                    # the up-front plan, not progress). Pass-B RESHIP
+                    # sweeps re-run this generator and must not count
+                    # again — done would overtake planned and the
+                    # pace verdict's rows/s would inflate; reships are
+                    # tracked by the sweep counters instead.
+                    obs.inc("progress.batches_staged")
+                    obs.inc("progress.rows_staged", int(ccounts.sum()))
             yield b, planes, values_d, nv, n_pid_planes
 
     def fold_host(host, vec):
@@ -1030,6 +1052,10 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
         nonlocal mid_acc
         pb, packed, vec, mid = item
         with tr.span("ingest.fetch", cat="ingest", batch=pb):
+            # Injectable WEDGE point: tests hold this fetch (the span
+            # stays open, no activity follows) and assert the stall
+            # watchdog diagnoses the blocked worker at its deadline.
+            faults.check_fetch_hold(pb)
             host = np.asarray(packed)  # [C+1, P_pad] int32, 1 transfer
             if ring is not None:
                 ring.retire()
@@ -1097,7 +1123,7 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                 with ingest.BackgroundStager(
                         lambda cancelled: batches(start_batch,
                                                   cancelled),
-                        depth=1) as stager:
+                        depth=1, name="stager-a") as stager:
                     for item in stager.items(
                             poll=folder.raise_if_failed):
                         folder.submit(launch(item))
@@ -1234,7 +1260,7 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                         lambda cancelled: batches(
                             cache_upto, cancelled, ring=ring_b,
                             track_reship=True),
-                        depth=1) as stager_b:
+                        depth=1, name="stager-b") as stager_b:
                     for item in stager_b.items():
                         consume(item, ring_b)
             else:

@@ -266,13 +266,25 @@ class TestCheckpointResumeBitParity:
 
 class TestBenchDegradation:
     """The BENCH_r05 failure mode, end to end: a wedged device probe
-    must yield rc=0 and parseable ``"degraded": true`` JSON, not rc=3."""
+    must yield rc=0 and parseable ``"degraded": true`` JSON, not rc=3 —
+    and with the heartbeat monitor on, the wedge (held for real via
+    ``wedged_hold``) is cancelled at the stall deadline, the degraded
+    artifact embeds the flight-record path + stall diagnosis, and the
+    heartbeat file survives the run."""
 
-    def test_wedged_probe_bench_exits_zero_with_degraded_json(self):
+    def test_wedged_probe_bench_exits_zero_with_degraded_json(
+            self, tmp_path):
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        ledger_dir = str(tmp_path / "ledger")
         env = dict(os.environ)
-        env["PIPELINEDP_TPU_FAULTS"] = "wedged_init=99"
+        env["PIPELINEDP_TPU_FAULTS"] = "wedged_init=99,wedged_hold=1"
         env["PIPELINEDP_TPU_PROBE_BACKOFF"] = "0.01"  # real clock: tiny
+        env["PIPELINEDP_TPU_PROBE_TIMEOUT"] = "30"  # the watchdog cuts it
+        env["PIPELINEDP_TPU_PROBE_ATTEMPTS"] = "2"
+        env["PIPELINEDP_TPU_HEARTBEAT"] = "1"
+        env["PIPELINEDP_TPU_HEARTBEAT_S"] = "0.05"
+        env["PIPELINEDP_TPU_STALL_S"] = "0.3"
+        env["PIPELINEDP_TPU_LEDGER_DIR"] = ledger_dir
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("PIPELINEDP_TPU_DEGRADED", None)  # fresh process state
         env.pop("PYTHONPATH", None)
@@ -286,6 +298,18 @@ class TestBenchDegradation:
         assert headline["degraded"] is True
         assert headline["value"] > 0
         assert "DEVICE UNREACHABLE" in proc.stderr
+        # The watchdog, not the 30s probe timeout, ended each attempt.
+        diagnosis = headline["degraded_diagnosis"]
+        assert diagnosis["probe_attempts"] == 2
+        assert "cancelled by the stall watchdog" in diagnosis["detail"]
+        assert "flight_record" in diagnosis
+        flight = json.load(open(diagnosis["flight_record"],
+                                encoding="utf-8"))
+        assert flight["stall"]["deadline_s"] == 0.3
+        # The live heartbeat streamed next to the durable ledger.
+        hb = json.load(open(os.path.join(ledger_dir, "heartbeat.json"),
+                            encoding="utf-8"))
+        assert hb["phase"]
 
     def test_probe_helper_degrades_without_subprocess(self, monkeypatch):
         """The bench probe helper itself (fast, tier-1): wedged probe →

@@ -469,7 +469,9 @@ class TestNoRawPerfCounter:
     """Lint twin of ``make noperf``: raw ``time.perf_counter()`` phase
     timing is banned outside ``pipelinedp_tpu/obs/`` — timing must flow
     through obs spans so every measured phase lands in the run ledger
-    (bench.py routes through ``obs.run_tracer``)."""
+    (bench.py routes through ``obs.run_tracer``). ``obs/monitor.py`` is
+    the one obs module NOT exempt: the stall watchdog's deadlines must
+    ride the injectable resilience clock, never the raw timer."""
 
     def test_no_perf_counter_outside_obs(self):
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -485,7 +487,8 @@ class TestNoRawPerfCounter:
                       for f in fs if f.endswith(".py")])
             for path in files:
                 rel = os.path.relpath(path, repo).replace(os.sep, "/")
-                if rel.startswith("pipelinedp_tpu/obs/"):
+                if (rel.startswith("pipelinedp_tpu/obs/") and
+                        rel != "pipelinedp_tpu/obs/monitor.py"):
                     continue
                 with open(path, encoding="utf-8") as f:
                     for ln, line in enumerate(f, 1):
