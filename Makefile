@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast bench smoke multichip lint dev clean faultcheck nosleep perfcheck nofoldin obscheck noperf nostager ledgercheck noartifacts watchcheck
+.PHONY: test test-fast bench smoke multichip lint dev clean faultcheck nosleep perfcheck nofoldin obscheck noperf nostager ledgercheck noartifacts watchcheck costcheck nocost
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -63,6 +63,35 @@ ledgercheck: noartifacts
 # CLI, and the wedged-probe watchdog-cancel path.
 watchcheck: noperf nosleep
 	$(PYTHON) -m pytest tests/test_monitor.py tests/test_obs.py -q
+
+# Device-cost observatory acceptance suite: roofline verdict math,
+# instrumented_jit capture-once semantics (the compile-count
+# assertion), analysis tolerance across jax versions, HBM watermark
+# sampling, store schema tolerance v1->v2->v3 (last_known_good /
+# --summarize / bench --compare on a mixed-schema ledger), --csv
+# output, Chrome-trace counter tracks, the e2e device_costs report
+# shape, and the costs on/off DP bit-parity (PARITY row 31, in
+# tests/test_obs.py) — plus the no-direct-analysis-call lint.
+costcheck: nocost
+	$(PYTHON) -m pytest tests/test_costs.py tests/test_obs.py -q
+
+# Lint-style check: no direct compiled-program analysis or live-array
+# sampling outside pipelinedp_tpu/obs/ — cost_analysis( /
+# memory_analysis( / live_arrays( calls must flow through the
+# device-cost observatory (obs/costs.py) so every measurement lands in
+# the schema-versioned run report keyed by the env fingerprint.
+# (tests/test_costs.py enforces the same rule in-tree, AST-precise.)
+nocost:
+	@bad=$$(grep -rnE "cost_analysis *\(|memory_analysis *\(|live_arrays *\(" \
+	  --include='*.py' pipelinedp_tpu bench.py \
+	  | grep -v "pipelinedp_tpu/obs/" || true); \
+	if [ -n "$$bad" ]; then \
+	  echo "$$bad"; \
+	  echo "ERROR: direct device-analysis call — route through"; \
+	  echo "pipelinedp_tpu.obs.costs (instrumented_jit / sample_live_bytes)"; \
+	  exit 1; \
+	fi; \
+	echo "nocost: OK"
 
 # Lint-style check: no ad-hoc run-report/JSON-artifact writes — every
 # json.dump( file write in library/bench code must live in

@@ -53,6 +53,7 @@ from pipelinedp_tpu.aggregate_params import (AggregateParams, MechanismType,
                                              PartitionSelectionStrategy)
 from pipelinedp_tpu.analysis import data_structures
 from pipelinedp_tpu.analysis import metrics as am
+from pipelinedp_tpu.obs.costs import instrumented_jit
 from pipelinedp_tpu.jax_engine import (_pad_pow2, _pad_rows, encode,
                                        pad_and_put)
 from pipelinedp_tpu.ops import partition_selection as ps_ops
@@ -211,7 +212,7 @@ def _laplace_gauss_table(quantiles: Tuple[float, ...],
 # ---------------------------------------------------------------------------
 
 
-@jax.jit
+@instrumented_jit(phase="sweep")
 def _preagg_kernel(pid, pk, values, valid):
     """Returns dense per-row arrays where ``marker`` rows carry one
     (pid, pk) user-contribution record: (pk, count, sum, n_partitions of
@@ -585,8 +586,8 @@ def _sweep_chunk_body(metric_names, strategy, noise_kind, P, public,
     return out, sel_stats
 
 
-_sweep_chunk_kernel = functools.partial(
-    jax.jit,
+_sweep_chunk_kernel = instrumented_jit(
+    phase="sweep",
     static_argnames=("metric_names", "strategy", "noise_kind", "P",
                      "public", "chunk", "per_partition"))(_sweep_chunk_body)
 
@@ -608,8 +609,8 @@ def _split_pp(out, metric_names):
     return pp
 
 
-@functools.partial(
-    jax.jit,
+@instrumented_jit(
+    phase="sweep",
     static_argnames=("metric_names", "strategy", "noise_kind", "P",
                      "public", "chunk", "mesh", "per_partition"))
 def _sweep_chunk_sharded(metric_names, strategy, noise_kind, P, public,
@@ -721,7 +722,7 @@ def _bin_stats(v, mask, P):
     return jnp.stack([cnt, tot, mx], axis=-1)[:_HIST_BINS]
 
 
-@functools.partial(jax.jit, static_argnames=("P",))
+@instrumented_jit(phase="sweep", static_argnames=("P",))
 def _histogram_kernel(P, pid, pk, valid):
     """All four tuning histograms in one program (host graph twin:
     ``histograms.compute_dataset_histograms``). Returns [4, BINS, 3]."""

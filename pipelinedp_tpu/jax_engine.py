@@ -46,7 +46,6 @@ because the noise is keyed by GLOBAL (partition, node).
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -59,6 +58,7 @@ from pipelinedp_tpu.aggregate_params import (AggregateParams, NoiseKind,
                                              NormKind,
                                              PartitionSelectionStrategy)
 from pipelinedp_tpu.combiners import _create_named_tuple_instance
+from pipelinedp_tpu.obs.costs import instrumented_jit
 from pipelinedp_tpu.ops import partition_selection as ps_ops
 from pipelinedp_tpu.ops import quantile_tree as quantile_tree_ops
 from pipelinedp_tpu.ops import segment as seg_ops
@@ -636,8 +636,9 @@ def encode(rows, data_extractors, vector_size: Optional[int],
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("config", "num_partitions",
-                                             "fx_bits"))
+@instrumented_jit(phase="engine", static_argnames=("config",
+                                                   "num_partitions",
+                                                   "fx_bits"))
 def fused_aggregate_kernel(config: FusedConfig, num_partitions: int, pid,
                            pk, values, valid, noise_scales, keep_table,
                            sel_threshold, sel_scale, sel_min_count,
@@ -1872,7 +1873,8 @@ def request_budgets(config: FusedConfig, params: AggregateParams,
 _COMPACT_FETCH_CAP = 8192
 
 
-@functools.partial(jax.jit, static_argnames=("num_partitions", "cap"))
+@instrumented_jit(phase="fetch", static_argnames=("num_partitions",
+                                                  "cap"))
 def _compact_fetch_kernel(keep_pk, cols, num_partitions, cap):
     """Device-side output compaction: stable-sorts kept partitions first
     (ascending pk index), gathers the first ``cap`` of every column and

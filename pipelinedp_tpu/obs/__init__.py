@@ -62,7 +62,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
-from pipelinedp_tpu.obs import audit, store
+from pipelinedp_tpu.obs import audit, costs, store
 from pipelinedp_tpu.obs import report as _report
 from pipelinedp_tpu.obs.tracer import (ACTIVITY, ENV_VAR, MAX_EVENTS,
                                        MAX_SPANS, NOOP_SPAN, NOOP_TRACER,
@@ -77,8 +77,9 @@ __all__ = [
     "NOOP_SPAN", "NOOP_TRACER", "ACTIVITY",
     "trace_enabled", "trace_destination",
     "ledger", "tracer", "run_tracer", "span", "inc", "event", "reset",
+    "gauge", "gauge_max", "sample",
     "environment_fingerprint", "build_run_report", "write_chrome_trace",
-    "device_annotation", "audit", "store", "monitor",
+    "device_annotation", "audit", "costs", "store", "monitor",
 ]
 
 #: The process-global run ledger.
@@ -134,11 +135,28 @@ def event(name: str, **attrs) -> None:
     _LEDGER.event(name, **attrs)
 
 
+def gauge(name: str, value: int) -> None:
+    """Set a ledger counter to an instantaneous value (live HBM)."""
+    _LEDGER.gauge(name, value)
+
+
+def gauge_max(name: str, value: int) -> None:
+    """Raise a ledger counter to ``value`` if larger (watermarks)."""
+    _LEDGER.gauge_max(name, value)
+
+
+def sample(name: str, value: float) -> None:
+    """Append one (ts, value) sample to a ledger time series — the
+    Chrome-trace export renders these as counter tracks."""
+    _LEDGER.sample(name, value)
+
+
 def reset() -> None:
-    """Start a fresh ledger AND audit registry (tests; bench run
-    boundaries)."""
+    """Start a fresh ledger AND audit registry AND device-cost table
+    (tests; bench run boundaries)."""
     _LEDGER.reset()
     audit.reset()
+    costs.reset()
     store.reset_run_report_cursor()
 
 
@@ -164,15 +182,33 @@ def write_chrome_trace(path: Optional[str] = None,
         snapshot if snapshot is not None else _LEDGER.snapshot())
 
 
+#: ``jax.profiler.TraceAnnotation`` resolved ONCE per process (False =
+#: not yet resolved, None = jax doesn't expose it). The resolution is
+#: deferred to the first annotated dispatch rather than obs import —
+#: this package must stay importable without touching jax (platform
+#: selection may not have settled) — but never repeats: the old
+#: per-call ``from jax.profiler import ...`` paid the import-machinery
+#: lookup on every kernel dispatch of a traced run.
+_TRACE_ANNOTATION: Any = False
+
+
 def device_annotation(name: str):
     """``jax.profiler.TraceAnnotation`` around a kernel dispatch so
     device profiles line up with host spans — active only under
     ``PIPELINEDP_TPU_TRACE`` (and only when jax exposes the API);
     otherwise the shared no-op context."""
+    global _TRACE_ANNOTATION
     if not trace_enabled():
         return NOOP_SPAN
+    if _TRACE_ANNOTATION is False:
+        try:
+            from jax.profiler import TraceAnnotation
+            _TRACE_ANNOTATION = TraceAnnotation
+        except Exception:
+            _TRACE_ANNOTATION = None
+    if _TRACE_ANNOTATION is None:
+        return NOOP_SPAN
     try:
-        from jax.profiler import TraceAnnotation
-        return TraceAnnotation(name)
+        return _TRACE_ANNOTATION(name)
     except Exception:
         return NOOP_SPAN

@@ -55,6 +55,7 @@ import threading
 import traceback
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from pipelinedp_tpu.obs import costs as _costs
 from pipelinedp_tpu.obs import store as _store
 from pipelinedp_tpu.obs import tracer as _tracer
 
@@ -268,6 +269,11 @@ class Monitor:
             self._last_change = now
             self._stall_open = False
         stalled_for = now - self._last_change
+        # Device-memory watermark sampling rides the beat when the cost
+        # observatory is on (``PIPELINEDP_TPU_COSTS``): live-array
+        # bytes land in the hbm.* gauges BEFORE the counter snapshot
+        # below, so this very heartbeat already carries them.
+        _costs.sample_live_bytes()
         counters, recent_events = obs.ledger().tail_snapshot(
             FLIGHT_RING_EVENTS)
         stalled = stalled_for >= self.stall_s
@@ -338,6 +344,9 @@ class Monitor:
             "counters": counters,
             "stalled": stalled,
         }
+        hbm = _costs.hbm_snapshot()
+        if hbm is not None:
+            hb["hbm"] = hbm
         if stalled:
             hb["stall"] = {"stalled_for_s": round(stalled_for, 3),
                            "deadline_s": self.stall_s,

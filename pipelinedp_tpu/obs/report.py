@@ -25,13 +25,18 @@ import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 from pipelinedp_tpu.obs import audit as _audit
+from pipelinedp_tpu.obs import costs as _costs
 
 #: Version of the run-report layout. Bump on any breaking change to the
 #: top-level keys; readers refuse (or warn on) unknown majors.
 #: v2 (run-ledger PR): adds the structured ``privacy`` audit section;
 #: v1 reports differ only by its absence, so readers treat v1 as
 #: "privacy unknown", never as an error.
-SCHEMA_VERSION = 2
+#: v3 (device-cost PR): adds the ``device_costs`` section (per-program
+#: compile wall/cache verdict, flops/bytes, memory stats, per-phase
+#: roofline verdicts — ``obs.costs``); absent in v1/v2 reports, which
+#: readers treat as "device costs not captured".
+SCHEMA_VERSION = 3
 
 _git_probe_cache: Optional[Tuple[str, bool]] = None
 
@@ -147,8 +152,14 @@ def build_run_report(snapshot: Dict[str, Any], mesh=None,
         "privacy": _audit.build_privacy_section(
             counters=snapshot.get("counters", {})),
         "dropped": {"spans": snapshot.get("dropped_spans", 0),
-                    "events": snapshot.get("dropped_events", 0)},
+                    "events": snapshot.get("dropped_events", 0),
+                    "samples": snapshot.get("dropped_samples", 0)},
     }
+    # v3: the device-cost observatory — included whenever programs were
+    # captured (absent = not captured, the v1/v2-compatible reading).
+    device_costs = _costs.TABLE.snapshot()
+    if device_costs["programs"]:
+        report["device_costs"] = device_costs
     if extra:
         report.update(extra)
     return report
@@ -168,17 +179,52 @@ def thread_name_map(snapshot: Dict[str, Any]) -> Dict[int, str]:
     return names
 
 
+#: Series rendered as RATE counter tracks: the stored samples are a
+#: cumulative counter, so the track value is the per-interval delta
+#: over elapsed time (rows/s), not the raw running total.
+_RATE_TRACKS = {"progress.rows_staged": "rows/s"}
+
+
+def _counter_track_events(series: Dict[str, Any], t0: float,
+                          pid: int) -> List[Dict[str, Any]]:
+    """``ph: "C"`` counter events from the sampled ledger series —
+    Perfetto draws them as a value timeline under the span lanes.
+    Cumulative progress counters differentiate into rates; everything
+    else (live-HBM bytes) plots raw."""
+    out: List[Dict[str, Any]] = []
+    for name, samples in sorted(series.items()):
+        rate_name = _RATE_TRACKS.get(name)
+        prev: Optional[Tuple[float, float]] = None
+        for ts, value in samples:
+            if rate_name is not None:
+                if prev is None or ts <= prev[0]:
+                    prev = (ts, value)
+                    continue
+                track, v = rate_name, (value - prev[1]) / (ts - prev[0])
+                prev = (ts, value)
+            else:
+                track, v = name, value
+            out.append({"ph": "C", "name": track, "pid": pid, "tid": 0,
+                        "ts": (ts - t0) * 1e6,
+                        "args": {"value": round(v, 1)}})
+    return out
+
+
 def chrome_trace_events(snapshot: Dict[str, Any],
                         threads: Optional[Dict[int, str]] = None
                         ) -> List[Dict[str, Any]]:
     """Convert a ledger snapshot to Chrome trace-event dicts. Spans
     become ``ph: "X"`` complete events; ledger events become ``ph: "i"``
-    instants. Timestamps rebase to the earliest record (µs)."""
+    instants; sampled series become ``ph: "C"`` counter tracks.
+    Timestamps rebase to the earliest record (µs)."""
     spans = snapshot.get("spans", [])
     events = snapshot.get("events", [])
+    series = snapshot.get("series", {})
     pid = os.getpid()
     t0 = min([s.ts for s in spans] +
-             [e["ts"] for e in events if "ts" in e], default=0.0)
+             [e["ts"] for e in events if "ts" in e] +
+             [ts for samples in series.values()
+              for ts, _ in samples[:1]], default=0.0)
     out: List[Dict[str, Any]] = []
     if threads is None:
         threads = thread_name_map(snapshot)
@@ -193,6 +239,7 @@ def chrome_trace_events(snapshot: Dict[str, Any],
         out.append({"ph": "i", "name": e["name"], "cat": "event",
                     "pid": pid, "tid": 0, "s": "p",
                     "ts": (e.get("ts", t0) - t0) * 1e6, "args": args})
+    out.extend(_counter_track_events(series, t0, pid))
     # Thread-name metadata rows make the Perfetto lanes self-labeling.
     for tid, name in sorted(threads.items()):
         out.append({"ph": "M", "name": "thread_name", "pid": pid,

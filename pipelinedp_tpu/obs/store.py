@@ -281,13 +281,20 @@ def summarize_entries(entries: List[Dict[str, Any]]
       reports — the regression direction at a glance);
     * ``metrics`` — from every rate-carrying bench record
       (``payload.record.value`` with a ``.../s`` unit): samples,
-      best/latest value, and the same trend delta (positive = faster).
+      best/latest value, and the same trend delta (positive = faster);
+    * ``programs`` — from every schema-v3 report's ``device_costs``
+      section: per (program, abstract-shape signature), compile-wall
+      samples with trend, the latest flops/bytes/intensity/roofline
+      verdict and cache verdict — the cost/roofline columns the
+      planner fits against (v1/v2 entries simply contribute no rows
+      here).
     """
     out: Dict[str, Dict[str, Any]] = {}
     for e in entries:
         fp = e.get("fingerprint")
         agg = out.setdefault(fp, {"runs": 0, "degraded_runs": 0,
-                                  "phases": {}, "metrics": {}})
+                                  "phases": {}, "metrics": {},
+                                  "programs": {}})
         payload = e.get("payload") or {}
         rr = payload.get("run_report")
         if isinstance(rr, dict) and rr.get("spans"):
@@ -299,6 +306,17 @@ def summarize_entries(entries: List[Dict[str, Any]]
                 if not isinstance(total, (int, float)):
                     continue
                 agg["phases"].setdefault(name, []).append(float(total))
+        if isinstance(rr, dict):
+            dc = rr.get("device_costs")
+            for prog in ((dc or {}).get("programs") or {}).values():
+                if not isinstance(prog, dict) or "program" not in prog:
+                    continue
+                row = agg["programs"].setdefault(_program_row_key(prog), {
+                    "compile_samples": [], "latest": None})
+                if isinstance(prog.get("compile_s"), (int, float)):
+                    row["compile_samples"].append(
+                        float(prog["compile_s"]))
+                row["latest"] = prog
         rec = payload.get("record")
         if isinstance(rec, dict):
             value = rec.get("value")
@@ -322,18 +340,110 @@ def summarize_entries(entries: List[Dict[str, Any]]
                    "trend": (None if _trend(samples) is None
                              else round(_trend(samples), 4))}
             for name, samples in agg["metrics"].items()}
+        agg["programs"] = {
+            name: _program_columns(row)
+            for name, row in agg["programs"].items()}
     return out
+
+
+def _program_row_key(prog: Dict[str, Any]) -> str:
+    """Stable per-(program, abstract-shape signature) aggregation key.
+    The report's own table keys are process-hash-seeded (never match
+    across runs), and the bare function name would conflate every
+    shape signature of one kernel into a single compile-trend series —
+    so rows re-key off the signature CONTENT."""
+    sig = prog.get("signature")
+    if not sig:
+        return str(prog["program"])
+    digest = hashlib.sha1(str(sig).encode("utf-8")).hexdigest()[:8]
+    return f"{prog['program']}@{digest}"
+
+
+def _program_columns(row: Dict[str, Any]) -> Dict[str, Any]:
+    """One program's cost/roofline columns from its accumulated
+    ``device_costs`` entries (the latest entry carries the analysis;
+    compile wall keeps the full sample list for the trend)."""
+    samples = row["compile_samples"]
+    latest = row["latest"] or {}
+    return {
+        "samples": len(samples),
+        "compile_s_mean": (round(sum(samples) / len(samples), 6)
+                           if samples else None),
+        "compile_s_latest": (round(samples[-1], 6) if samples
+                             else None),
+        "compile_trend": (None if _trend(samples) is None
+                          else round(_trend(samples), 4)),
+        "compile_cache": latest.get("compile_cache"),
+        "phase": latest.get("phase"),
+        "flops": latest.get("flops"),
+        "bytes_accessed": latest.get("bytes_accessed"),
+        "intensity": latest.get("intensity"),
+        "verdict": latest.get("verdict"),
+        "hbm_peak_bytes": (latest.get("memory") or {}).get("peak_bytes"),
+    }
 
 
 def _fmt_trend(trend: Optional[float]) -> str:
     return "n/a" if trend is None else f"{trend:+.0%}"
 
 
+#: The flat CSV schema ``--csv`` emits: one row per (fingerprint, kind,
+#: name) where kind is phase / metric / program; columns that don't
+#: apply to a kind stay empty. One parse-free table for spreadsheets
+#: and planner fitting.
+CSV_COLUMNS = ("fingerprint", "kind", "name", "samples", "total_s",
+               "mean_s", "latest_s", "best", "latest", "trend",
+               "compile_s_mean", "compile_s_latest", "compile_cache",
+               "phase", "flops", "bytes_accessed", "intensity",
+               "verdict", "hbm_peak_bytes")
+
+
+def _csv_rows(summary: Dict[str, Dict[str, Any]]
+              ) -> List[Dict[str, Any]]:
+    rows: List[Dict[str, Any]] = []
+    for fp, agg in summary.items():
+        for name, ph in sorted(agg["phases"].items()):
+            rows.append({"fingerprint": fp, "kind": "phase",
+                         "name": name, "samples": ph["reports"],
+                         "total_s": ph["total_s"],
+                         "mean_s": ph["mean_s"],
+                         "latest_s": ph["latest_s"],
+                         "trend": ph["trend"]})
+        for name, m in sorted(agg["metrics"].items()):
+            rows.append({"fingerprint": fp, "kind": "metric",
+                         "name": name, "samples": m["samples"],
+                         "best": m["best"], "latest": m["latest"],
+                         "trend": m["trend"]})
+        for name, pr in sorted(agg["programs"].items()):
+            rows.append({"fingerprint": fp, "kind": "program",
+                         "name": name, "samples": pr["samples"],
+                         "trend": pr["compile_trend"],
+                         **{k: pr[k] for k in
+                            ("compile_s_mean", "compile_s_latest",
+                             "compile_cache", "phase", "flops",
+                             "bytes_accessed", "intensity", "verdict",
+                             "hbm_peak_bytes")}})
+    return rows
+
+
+def write_csv(summary: Dict[str, Dict[str, Any]], out) -> None:
+    """Write the flat ``--csv`` table for a summary to a text stream."""
+    import csv
+    writer = csv.DictWriter(out, fieldnames=CSV_COLUMNS,
+                            restval="", extrasaction="ignore")
+    writer.writeheader()
+    for row in _csv_rows(summary):
+        writer.writerow({k: ("" if v is None else v)
+                         for k, v in row.items()})
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     """``python -m pipelinedp_tpu.obs.store --summarize [--dir D]
-    [--fingerprint FP] [--json]`` — print per-(fingerprint, phase) cost
-    tables with trend deltas from the accumulated run ledger."""
+    [--fingerprint FP] [--json | --csv]`` — print per-(fingerprint,
+    phase/metric/program) cost tables with trend deltas and roofline
+    columns from the accumulated run ledger."""
     import argparse
+    import sys
     parser = argparse.ArgumentParser(
         prog="python -m pipelinedp_tpu.obs.store",
         description="Ledger analytics over the durable run-ledger "
@@ -350,9 +460,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="machine-readable output (the autotune "
                         "planner's input shape)")
+    parser.add_argument("--csv", action="store_true", dest="as_csv",
+                        help="flat CSV table (phases, metrics, program "
+                        "cost/roofline columns) for spreadsheets")
     args = parser.parse_args(argv)
     if not args.summarize:
         parser.error("nothing to do: pass --summarize")
+    if args.as_json and args.as_csv:
+        parser.error("--json and --csv are mutually exclusive")
     directory = args.dir or ledger_dir(
         default=os.path.join(os.getcwd(), ".pdp_ledger"))
     s = LedgerStore(directory)
@@ -365,6 +480,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(json.dumps({"ledger": s.path, "entries": len(entries),
                           "skipped_lines": s.skipped_lines,
                           "fingerprints": summary}))
+        return 0
+    if args.as_csv:
+        write_csv(summary, sys.stdout)
         return 0
     print(f"ledger: {s.path} ({len(entries)} entries, "
           f"{s.skipped_lines} skipped lines)")
@@ -388,6 +506,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print(f"  {name:<44} {m['samples']:>7} {m['best']:>12.1f}"
                       f" {m['latest']:>12.1f} "
                       f"{_fmt_trend(m['trend']):>7}")
+        if agg["programs"]:
+            print(f"  {'program':<28} {'phase':<8} {'compile_s':>10} "
+                  f"{'cache':>8} {'gflops':>9} {'GB':>8} "
+                  f"{'flop/B':>7} {'verdict':<15}")
+            for name, pr in sorted(agg["programs"].items()):
+                gflops = ("n/a" if pr["flops"] is None
+                          else f"{pr['flops'] / 1e9:.3f}")
+                gbytes = ("n/a" if pr["bytes_accessed"] is None
+                          else f"{pr['bytes_accessed'] / 1e9:.3f}")
+                inten = ("n/a" if pr["intensity"] is None
+                         else f"{pr['intensity']:.2f}")
+                print(f"  {name:<28} {(pr['phase'] or '?'):<8} "
+                      f"{(pr['compile_s_latest'] or 0):>10.3f} "
+                      f"{(pr['compile_cache'] or 'n/a'):>8} "
+                      f"{gflops:>9} {gbytes:>8} {inten:>7} "
+                      f"{(pr['verdict'] or 'unknown'):<15}")
     return 0
 
 

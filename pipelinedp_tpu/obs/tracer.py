@@ -46,6 +46,15 @@ ENV_VAR = "PIPELINEDP_TPU_TRACE"
 #: "covered everything".
 MAX_SPANS = 200_000
 MAX_EVENTS = 20_000
+#: Per-track retention for sampled time-series (the Chrome-trace
+#: counter tracks): one sample is (ts, value); 8192 beats covers >11h
+#: of 5s heartbeats before drops start counting.
+MAX_SAMPLES = 8_192
+
+#: Counters whose increments also append a (ts, cumulative) sample to
+#: the series ledger when tracing is on — the Chrome-trace export
+#: differentiates ``progress.rows_staged`` into a rows/s counter track.
+SAMPLED_COUNTERS = ("progress.rows_staged",)
 
 #: Flight-recorder ring size: the live-activity registry keeps the
 #: last N COMPLETED spans so a stall dump can show what ran just
@@ -184,8 +193,10 @@ class RunLedger:
         self.spans: List[Span] = []
         self.counters: Dict[str, int] = {}
         self.events: List[Dict[str, Any]] = []
+        self.series: Dict[str, List[Tuple[float, float]]] = {}
         self.dropped_spans = 0
         self.dropped_events = 0
+        self.dropped_samples = 0
 
     def add_span(self, span: Span) -> None:
         with self._lock:
@@ -196,7 +207,37 @@ class RunLedger:
 
     def inc(self, name: str, n: int = 1) -> None:
         with self._lock:
-            self.counters[name] = self.counters.get(name, 0) + int(n)
+            total = self.counters.get(name, 0) + int(n)
+            self.counters[name] = total
+            # Progress counters double as a time series under tracing:
+            # the Chrome export turns the cumulative samples into a
+            # rows/s counter track (``ph: "C"``).
+            if name in SAMPLED_COUNTERS and trace_enabled():
+                self._sample_locked(name, float(total))
+
+    def gauge(self, name: str, value: int) -> None:
+        """Set a counter to an instantaneous value (live HBM bytes)."""
+        with self._lock:
+            self.counters[name] = int(value)
+
+    def gauge_max(self, name: str, value: int) -> None:
+        """Raise a counter to ``value`` if larger (watermarks)."""
+        with self._lock:
+            self.counters[name] = max(self.counters.get(name, 0),
+                                      int(value))
+
+    def sample(self, name: str, value: float) -> None:
+        """Append one (ts, value) sample to the named series (bounded;
+        drops counted). Feeds the Chrome-trace counter tracks."""
+        with self._lock:
+            self._sample_locked(name, float(value))
+
+    def _sample_locked(self, name: str, value: float) -> None:
+        track = self.series.setdefault(name, [])
+        if len(track) < MAX_SAMPLES:
+            track.append((self._clock.monotonic(), value))
+        else:
+            self.dropped_samples += 1
 
     def event(self, name: str, **attrs) -> None:
         with self._lock:
@@ -214,8 +255,11 @@ class RunLedger:
             return {"spans": list(self.spans),
                     "counters": dict(self.counters),
                     "events": [dict(e) for e in self.events],
+                    "series": {k: list(v)
+                               for k, v in self.series.items()},
                     "dropped_spans": self.dropped_spans,
-                    "dropped_events": self.dropped_events}
+                    "dropped_events": self.dropped_events,
+                    "dropped_samples": self.dropped_samples}
 
     def tail_snapshot(self, n_events: int = 64
                       ) -> Tuple[Dict[str, int], List[Dict[str, Any]]]:
@@ -231,8 +275,10 @@ class RunLedger:
             self.spans = []
             self.counters = {}
             self.events = []
+            self.series = {}
             self.dropped_spans = 0
             self.dropped_events = 0
+            self.dropped_samples = 0
 
 
 class _SpanHandle:

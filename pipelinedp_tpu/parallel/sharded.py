@@ -45,6 +45,7 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as PSpec
 
 from pipelinedp_tpu import jax_engine
+from pipelinedp_tpu.obs.costs import instrumented_jit
 
 try:  # jax>=0.6 exposes shard_map at the top level
     from jax import shard_map  # type: ignore
@@ -85,9 +86,8 @@ def make_mesh(n_devices: Optional[int] = None, axis_name: str = "data"
     return Mesh(np.asarray(devices), (axis_name,))
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("config", "num_partitions", "mesh",
-                                    "fx_bits"))
+@instrumented_jit(phase="engine", static_argnames=(
+    "config", "num_partitions", "mesh", "fx_bits"))
 def _sharded_kernel(config, num_partitions, mesh, pid, pk, values, valid,
                     noise_scales, keep_table, sel_threshold, sel_scale,
                     sel_min_count, sel_rows_per_uid, key, fx_bits=7):

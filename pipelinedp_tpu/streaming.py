@@ -66,7 +66,6 @@ other backends could in principle still differ in the last f32 bit.
 from __future__ import annotations
 
 import dataclasses
-import functools
 import os
 from typing import Dict, Optional, Tuple
 
@@ -77,6 +76,7 @@ import jax.numpy as jnp
 
 from pipelinedp_tpu import jax_engine as je
 from pipelinedp_tpu import obs
+from pipelinedp_tpu.obs.costs import instrumented_jit
 from pipelinedp_tpu.ops.segment import fmix32
 
 #: Rows per device batch (and the engine's streaming trigger: pipelines
@@ -205,8 +205,8 @@ def _mid_histogram(config, num_partitions, qrows):
         num_segments=num_partitions * n_mid)
 
 
-@functools.partial(jax.jit, static_argnames=("config", "num_partitions",
-                                             "fx_bits", "n_pid_planes"))
+@instrumented_jit(phase="pass_a", static_argnames=(
+    "config", "num_partitions", "fx_bits", "n_pid_planes"))
 def _partials_kernel(config, num_partitions, planes, values, n_valid, key,
                      fx_bits, n_pid_planes):
     """One chunk's bounding + per-pk reduction, packed for the fetch:
@@ -227,9 +227,8 @@ def _partials_kernel(config, num_partitions, planes, values, n_valid, key,
     return packed, vec, mid
 
 
-@functools.partial(jax.jit, static_argnames=("config", "num_partitions",
-                                             "fx_bits", "n_pid_planes",
-                                             "n_block"))
+@instrumented_jit(phase="pass_b", static_argnames=(
+    "config", "num_partitions", "fx_bits", "n_pid_planes", "n_block"))
 def _pct_sub_kernel(config, num_partitions, planes, values, n_valid, key,
                     fx_bits, n_pid_planes, sub_start, p_offset, n_block):
     """Pass B: recompute the chunk's bounded rows (same key -> identical
@@ -247,9 +246,8 @@ def _pct_sub_kernel(config, num_partitions, planes, values, n_valid, key,
                               p_offset=p_offset)
 
 
-@functools.partial(jax.jit, static_argnames=("config", "num_partitions",
-                                             "fx_bits", "n_pid_planes",
-                                             "n_block"))
+@instrumented_jit(phase="pass_b", static_argnames=(
+    "config", "num_partitions", "fx_bits", "n_pid_planes", "n_block"))
 def _pct_multi_sub_kernel(config, num_partitions, planes, values, n_valid,
                           key, fx_bits, n_pid_planes, sub_starts,
                           p_offsets, n_block):
@@ -365,9 +363,8 @@ def plan_pass_b_sweeps(P_pad, Q, span, cap) -> PassBPlan:
     return PassBPlan(qc, pb, t_full, tiles, tuple(sweeps))
 
 
-@functools.partial(jax.jit, static_argnames=("config", "num_partitions",
-                                             "mesh", "fx_bits",
-                                             "n_pid_planes"))
+@instrumented_jit(phase="pass_a", static_argnames=(
+    "config", "num_partitions", "mesh", "fx_bits", "n_pid_planes"))
 def _sharded_partials_kernel(config, num_partitions, mesh, planes, values,
                              n_valid_shard, key, fx_bits, n_pid_planes):
     """Mesh twin of ``_partials_kernel``: each device bounds + reduces
@@ -417,9 +414,9 @@ def _sharded_partials_kernel(config, num_partitions, mesh, planes, values,
     return packed, vec, mid
 
 
-@functools.partial(jax.jit, static_argnames=("config", "num_partitions",
-                                             "mesh", "fx_bits",
-                                             "n_pid_planes", "n_block"))
+@instrumented_jit(phase="pass_b", static_argnames=(
+    "config", "num_partitions", "mesh", "fx_bits", "n_pid_planes",
+    "n_block"))
 def _sharded_pct_sub_kernel(config, num_partitions, mesh, planes, values,
                             n_valid_shard, key, fx_bits, n_pid_planes,
                             sub_start, p_offset, n_block):
@@ -458,9 +455,9 @@ def _sharded_pct_sub_kernel(config, num_partitions, mesh, planes, values,
     return mapped(planes, values, n_valid_shard, key, sub_start, p_offset)
 
 
-@functools.partial(jax.jit, static_argnames=("config", "num_partitions",
-                                             "mesh", "fx_bits",
-                                             "n_pid_planes", "n_block"))
+@instrumented_jit(phase="pass_b", static_argnames=(
+    "config", "num_partitions", "mesh", "fx_bits", "n_pid_planes",
+    "n_block"))
 def _sharded_pct_multi_sub_kernel(config, num_partitions, mesh, planes,
                                   values, n_valid_shard, key, fx_bits,
                                   n_pid_planes, sub_starts, p_offsets,
@@ -496,7 +493,7 @@ def _sharded_pct_multi_sub_kernel(config, num_partitions, mesh, planes,
                   p_offsets)
 
 
-@functools.partial(jax.jit, static_argnames=("config", "P"))
+@instrumented_jit(phase="walk", static_argnames=("config", "P"))
 def _walk_top_kernel(config, P, mid, key, scale):
     """Walk the levels the mid histogram serves (node width >= bucket_w)
     — the streaming twin of ``jax_engine._percentile_values``' top-
@@ -527,7 +524,7 @@ def _walk_top_kernel(config, P, mid, key, scale):
     return lo, hi, target, leaf_lo, done
 
 
-@functools.partial(jax.jit, static_argnames=("config", "P"))
+@instrumented_jit(phase="walk", static_argnames=("config", "P"))
 def _walk_bottom_kernel(config, P, sub, sub_start, lo, hi, target,
                         leaf_lo, done, key, scale, p_offset):
     """Finish the walk from the accumulated [P, Qc, span] subtree leaf
@@ -556,7 +553,8 @@ def _walk_bottom_kernel(config, P, sub, sub_start, lo, hi, target,
     return lo + (hi - lo) * target
 
 
-@functools.partial(jax.jit, static_argnames=("config", "num_partitions"))
+@instrumented_jit(phase="select", static_argnames=("config",
+                                                   "num_partitions"))
 def _select_kernel(config, num_partitions, part_nseg, keep_table,
                    sel_threshold, sel_scale, sel_min_count,
                    sel_rows_per_uid, k_sel):

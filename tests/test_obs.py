@@ -11,7 +11,10 @@ Coverage contract (``make obscheck``):
   leaves zero spans in the ledger, and no attributes are added to hot
   objects;
 * bench-field parity: with tracing on vs off the DP outputs are
-  bit-identical and every timing field keeps its name;
+  bit-identical and every timing field keeps its name — and the same
+  bit-parity for audit capture and device-cost capture
+  (``PIPELINEDP_TPU_COSTS``, PARITY row 31, incl. the no-second-compile
+  counter assertion);
 * Chrome-trace export round-trips through ``json.loads`` with valid
   ``ph``/``ts``/``dur`` fields;
 * the run report carries its schema version and environment
@@ -305,6 +308,61 @@ class TestParity:
         assert priv_off["accountants"] == []
         assert priv_off["partition_selection"]["partitions_pre"] == 0
 
+    def test_costs_on_off_outputs_bit_identical(self, monkeypatch):
+        """PARITY row 31: the device-cost knob (PIPELINEDP_TPU_COSTS)
+        changes ONLY the record — DP outputs bit-identical with capture
+        on vs off, only the 'on' run's report carries the
+        ``device_costs`` section, and a repeat run at the same jitted
+        signatures captures zero new programs (cost capture never pays
+        a second XLA compile — the compile-count assertion)."""
+        # A chunk size unique to this test: kernel abstract shapes must
+        # be fresh so the 'on' run actually captures.
+        monkeypatch.setenv("PIPELINEDP_TPU_STREAM_CHUNK", "991")
+        ds, parts = make_ds(seed=41)
+        params = count_params(parts)
+        results, reports = {}, {}
+        for mode in ("off", "on"):
+            obs.reset()
+            if mode == "on":
+                monkeypatch.setenv(obs.costs.ENV_VAR, "1")
+            else:
+                monkeypatch.delenv(obs.costs.ENV_VAR, raising=False)
+            results[mode], _ = run_streamed(ds, params, seed=37)
+            reports[mode] = obs.build_run_report()
+        assert set(results["off"]) == set(results["on"])
+        for k in results["off"]:
+            ta, tb = results["off"][k], results["on"][k]
+            assert ta._fields == tb._fields
+            for f in ta._fields:
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(ta, f)),
+                    np.asarray(getattr(tb, f)),
+                    err_msg=f"partition {k}.{f}")
+        assert "device_costs" not in reports["off"]
+        dc = reports["on"]["device_costs"]
+        assert len(dc["programs"]) >= 1
+        for entry in dc["programs"].values():
+            assert entry["compile_s"] > 0.0
+            assert entry["compile_cache"] in ("hit", "miss",
+                                              "disabled", "unknown")
+        assert any(ph["verdict"] != "unknown" or ph["analyzed"] == 0
+                   for ph in dc["phases"].values())
+        n1 = obs.ledger().snapshot()["counters"][
+            "cost.programs_captured"]
+        assert n1 >= 1
+        # Second identical run, flag still on: dispatch reuses the
+        # captured executables — zero new compiles.
+        again, _ = run_streamed(ds, params, seed=37)
+        n2 = obs.ledger().snapshot()["counters"][
+            "cost.programs_captured"]
+        assert n2 == n1, "repeat run recompiled a captured program"
+        for k in results["on"]:
+            ta, tb = results["on"][k], again[k]
+            for f in ta._fields:
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(ta, f)),
+                    np.asarray(getattr(tb, f)))
+
 
 class TestChromeTrace:
     """Export round-trip: valid JSON, valid ph/ts/dur, thread lanes."""
@@ -368,13 +426,17 @@ class TestRunReport:
         obs.inc("retry.attempts", 2)
         obs.event("health.degraded", target="cpu_platform")
         report = obs.build_run_report(extra={"note": "t"})
-        assert report["schema_version"] == obs.SCHEMA_VERSION == 2
+        assert report["schema_version"] == obs.SCHEMA_VERSION == 3
         assert report["counters"]["retry.attempts"] == 2
         assert report["spans"]["phase"]["count"] == 1
         assert any(e["name"] == "health.degraded"
                    for e in report["events"])
         assert report["note"] == "t"
-        assert report["dropped"] == {"spans": 0, "events": 0}
+        assert report["dropped"] == {"spans": 0, "events": 0,
+                                     "samples": 0}
+        # v3: the device_costs section appears only when programs were
+        # captured — absent here (the v1/v2-compatible reading).
+        assert "device_costs" not in report
         # v2: the structured privacy audit section is always present.
         priv = report["privacy"]
         assert priv["enabled"] is True
