@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast bench smoke multichip lint dev clean faultcheck nosleep perfcheck nofoldin obscheck noperf nostager ledgercheck noartifacts watchcheck costcheck nocost
+.PHONY: test test-fast bench smoke multichip lint dev clean faultcheck nosleep perfcheck nofoldin obscheck noperf nostager ledgercheck noartifacts watchcheck costcheck nocost plancheck noknobs
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -75,6 +75,42 @@ watchcheck: noperf nosleep
 costcheck: nocost
 	$(PYTHON) -m pytest tests/test_costs.py tests/test_obs.py -q
 
+# Execution-planner acceptance suite: cold-start byte-identity to the
+# hardcoded defaults, env > seam > plan > default precedence, dp-unsafe
+# knobs never applied from a plan, stale-fingerprint plan rejection
+# (plan.stale), cost-model fit/predict/serialize + the static roofline
+# fallback, the pass-B q_chunk pin, planner on/off DP bit-parity
+# (PARITY row 32), the store's --since-run-id window, bench plan
+# provenance + the --compare plan-mismatch refusal, and the in-process
+# autotune→plan-file→plain-run acceptance flow — plus the
+# no-direct-knob-read lint.
+plancheck: noknobs
+	$(PYTHON) -m pytest tests/test_plan.py -q
+
+# Lint-style check: no direct reads of the registered knob constants
+# (_SUBHIST_BYTE_CAP / _SELECT_UNITS_CAP / _TREE_ROWS_CAP / _Q_CHUNK)
+# outside pipelinedp_tpu/plan/ — every consumer must resolve through
+# the knob registry (plan.knobs: env > seam > plan file > default) so
+# an autotuned plan can actually steer the value and every resolution
+# lands in the run report's plan section. The defining modules keep
+# the names as module-level assignments (the blessed test seams);
+# docstring/comment mentions (backquoted or #-prefixed) are ignored.
+# (tests/test_plan.py enforces the same rule in-tree, AST-precise.)
+noknobs:
+	@bad=$$(grep -rnE "_SUBHIST_BYTE_CAP|_SELECT_UNITS_CAP|_TREE_ROWS_CAP|_Q_CHUNK" \
+	  --include='*.py' pipelinedp_tpu bench.py \
+	  | grep -v "pipelinedp_tpu/plan/" \
+	  | grep -v '``' | grep -vE ':[0-9]+: *#' \
+	  | grep -vE '^pipelinedp_tpu/(jax_engine|streaming)\.py:[0-9]+:(_SUBHIST_BYTE_CAP|_SELECT_UNITS_CAP|_TREE_ROWS_CAP|_Q_CHUNK) *=' \
+	  || true); \
+	if [ -n "$$bad" ]; then \
+	  echo "$$bad"; \
+	  echo "ERROR: direct knob-constant access — resolve through"; \
+	  echo "pipelinedp_tpu.plan (knobs.value / resolve / seam_override)"; \
+	  exit 1; \
+	fi; \
+	echo "noknobs: OK"
+
 # Lint-style check: no direct compiled-program analysis or live-array
 # sampling outside pipelinedp_tpu/obs/ — cost_analysis( /
 # memory_analysis( / live_arrays( calls must flow through the
@@ -95,13 +131,15 @@ nocost:
 
 # Lint-style check: no ad-hoc run-report/JSON-artifact writes — every
 # json.dump( file write in library/bench code must live in
-# pipelinedp_tpu/obs/ (the exporters + the durable ledger store) or
+# pipelinedp_tpu/obs/ (the exporters + the durable ledger store),
+# pipelinedp_tpu/plan/ (the atomically-replaced plan file) or
 # bench.py (the one artifact emitter), so run knowledge lands in the
-# schema-versioned report/store instead of scattered one-off files.
-# (tests/test_ledger.py enforces the same rule in-tree, AST-precise.)
+# schema-versioned report/store/plan instead of scattered one-off
+# files. (tests/test_ledger.py enforces the same rule, AST-precise.)
 noartifacts:
 	@bad=$$(grep -rn "json\.dump *(" --include='*.py' pipelinedp_tpu \
-	  | grep -v "pipelinedp_tpu/obs/" || true); \
+	  | grep -v "pipelinedp_tpu/obs/" \
+	  | grep -v "pipelinedp_tpu/plan/" || true); \
 	if [ -n "$$bad" ]; then \
 	  echo "$$bad"; \
 	  echo "ERROR: ad-hoc JSON artifact write — route run reports/"; \

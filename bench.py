@@ -174,6 +174,7 @@ class _BenchLedger:
 
 _BENCH_LEDGER = None
 _RUN_RECORDS = []
+_PLAN_PROV = None
 
 
 def _bench_ledger():
@@ -187,20 +188,45 @@ def reset_run_state():
     """Fresh bench 'run' within one process (tests simulating two
     driver invocations): clears the cached tracer / fingerprint /
     ledger connection / record list and the obs process ledger."""
-    global _TRACER, _ENV_FP, _BENCH_LEDGER, _RUN_RECORDS
+    global _TRACER, _ENV_FP, _BENCH_LEDGER, _RUN_RECORDS, _PLAN_PROV
     _TRACER = None
     _ENV_FP = None
     _BENCH_LEDGER = None
     _RUN_RECORDS = []
+    _PLAN_PROV = None
     from pipelinedp_tpu import obs
     obs.reset()
 
 
+def plan_provenance():
+    """{plan_source, plan_hash} stamped on every bench record:
+    ``autotuned`` when a plan file steered any knob, ``env-override``
+    when an env var or test seam did, ``default`` otherwise — the
+    fields ``--compare`` uses to refuse gating an autotuned run
+    against a default-knob baseline (and vice versa).
+
+    Snapshotted ONCE per bench run, at the first call (main() takes it
+    right after the plan dir resolves, before any record runs): later
+    records run under bench-internal measurement scaffolding — the
+    streamed record's chunk env, the capped probe records' seam
+    injections — and labeling those as ``env-override`` would misstate
+    the regime every plain run was launched under."""
+    global _PLAN_PROV
+    if _PLAN_PROV is None:
+        from pipelinedp_tpu import plan as plan_mod
+        try:
+            _PLAN_PROV = plan_mod.source_summary()
+        except Exception:
+            _PLAN_PROV = {"plan_source": "default", "plan_hash": None}
+    return dict(_PLAN_PROV)
+
+
 def emit(rec):
-    """Log one record (with the env fingerprint merged) as JSON, and
-    append it to the durable run-ledger store keyed by the environment
-    fingerprint."""
+    """Log one record (with the env fingerprint and the plan
+    provenance merged) as JSON, and append it to the durable
+    run-ledger store keyed by the environment fingerprint."""
     rec["env"] = env_fingerprint()
+    rec.update(plan_provenance())
     log(json.dumps(rec))
     _RUN_RECORDS.append(rec)
     _bench_ledger().append(rec["metric"], {"record": rec})
@@ -602,16 +628,16 @@ def bench_streamed_percentile(n_rows):
 
         # The multi-tile sweep path under an injected cap: budget for
         # 5/8 of one [P_pad, 1, span] block, so the planner must tile
-        # AND pack (sweeps strictly below tiles on this shape).
+        # AND pack (sweeps strictly below tiles on this shape). The
+        # injection goes through the knob registry's seam-override
+        # idiom — a mutated seam outranks any plan file, so this
+        # record measures the injected cap even on an autotuned host.
+        from pipelinedp_tpu import plan as plan_mod
         _, _, _, span = streaming_mod._tree_consts()
         P_pad = je._pad_pow2(parts)
         cap = max(4, (5 * P_pad) // 8) * span * 4
-        saved_cap = je._SUBHIST_BYTE_CAP
-        je._SUBHIST_BYTE_CAP = cap
-        try:
+        with plan_mod.seam_override("subhist_byte_cap", cap):
             out2, dt2, t2 = run("capped")
-        finally:
-            je._SUBHIST_BYTE_CAP = saved_cap
         fields = ("percentile_50", "percentile_90", "percentile_99")
         parity = all(getattr(out2[p], f) == getattr(out[p], f)
                      for p in range(parts) for f in fields)
@@ -645,6 +671,177 @@ def bench_streamed_percentile(n_rows):
                 os.environ.pop(streaming_mod._CHUNK_ENV, None)
             else:
                 os.environ[streaming_mod._CHUNK_ENV] = prev
+
+
+def run_autotune(args):
+    """``bench.py --autotune``: the bounded knob sweep that closes the
+    measure→decide loop. Runs the streamed-percentile workload once per
+    candidate knob vector (the default vector + one-factor deviations
+    of every dp-safe knob — ``plan.autotune_candidates``), appends each
+    trial to the run ledger as an ``autotune.trial`` entry, fits the
+    stdlib cost model from the run-windowed entries (``--since-run-id``
+    semantics: one windowed read after the sweep, never a full-ledger
+    re-read per trial), and atomically writes the plan file a
+    subsequent plain run resolves (``plan.applied`` events with
+    ``source: "plan"``). Prints ONE JSON headline on stdout."""
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu import plan as plan_mod
+    from pipelinedp_tpu import streaming as streaming_mod
+    from pipelinedp_tpu.backends import JaxBackend
+    from pipelinedp_tpu.obs import store as obs_store
+    from pipelinedp_tpu.plan import model as plan_model
+
+    n_rows = args.rows or 120_000
+    parts = 60 if getattr(args, "smoke", False) else 3_000
+    rng = np.random.default_rng(17)
+    ds = pdp.ArrayDataset(
+        privacy_ids=rng.integers(0, 1 << 20, n_rows).astype(np.int32),
+        partition_keys=(rng.zipf(1.3, n_rows) % parts).astype(np.int32),
+        values=rng.uniform(0.0, 10.0, n_rows).astype(np.float32))
+    params = pdp.AggregateParams(
+        metrics=[pdp.Metrics.PERCENTILE(50), pdp.Metrics.PERCENTILE(90),
+                 pdp.Metrics.PERCENTILE(99), pdp.Metrics.VARIANCE],
+        noise_kind=pdp.NoiseKind.LAPLACE,
+        max_partitions_contributed=4, max_contributions_per_partition=2,
+        min_value=0.0, max_value=10.0)
+
+    led = _bench_ledger()
+    # Pre-sweep end offset of the ledger file: the post-sweep fit reads
+    # only the bytes appended after this point (read_from), so fitting
+    # stays O(sweep) on a long-lived service ledger instead of
+    # re-parsing the whole history every autotune.
+    sweep_offset = 0
+    if led._store is not None:
+        try:
+            sweep_offset = os.path.getsize(led._store.path)
+        except OSError:
+            sweep_offset = 0
+    # The sweep measures the TRIAL vectors: a plan file left by a prior
+    # autotune must not steer them. A seam pinned AT the registry
+    # default is indistinguishable from "no override" (the precedence
+    # falls through to the plan), so the default-vector trial and every
+    # single-knob deviation would silently execute the old plan while
+    # the ledger labels them with the trial's knobs. Disable plan
+    # loading for the sweep's duration; the write at the end needs the
+    # real directory back, so the restore sits in the same finally as
+    # the chunk env.
+    from pipelinedp_tpu.plan import planner as planner_mod
+    prev_plan_dir = os.environ.get(planner_mod.ENV_DIR)
+    os.environ[planner_mod.ENV_DIR] = "0"
+    plan_mod.reset()
+    prev = os.environ.get(streaming_mod._CHUNK_ENV)
+    did_set = False
+    if n_rows <= streaming_mod.stream_chunk_rows():
+        # The sweep must exercise the streamed path (that is where the
+        # knobs live): force a chunk below the dataset, exactly like
+        # the streamed-percentile bench record.
+        os.environ[streaming_mod._CHUNK_ENV] = str(max(n_rows // 6,
+                                                       1000))
+        did_set = True
+    shape = {"rows": n_rows, "partitions": parts, "quantiles": 3}
+    log(f"## autotune: {n_rows} rows x {parts} partitions, "
+        f"{len(plan_mod.autotune_candidates())} candidate vectors")
+    trials = []
+
+    def one_run(vec):
+        ds.invalidate_cache()
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                        total_delta=1e-6)
+        engine = pdp.DPEngine(acc, JaxBackend(
+            rng_seed=0,
+            ingest_executor=bool(vec["ingest_executor"]),
+            stream_cache=int(vec["stream_cache_bytes"])))
+        result = engine.aggregate(ds, params, pdp.DataExtractors(),
+                                  public_partitions=list(range(parts)))
+        acc.compute_budgets()
+        with plan_mod.seam_override("subhist_byte_cap",
+                                    vec["subhist_byte_cap"]), \
+                plan_mod.seam_override("q_chunk", vec["q_chunk"]):
+            with tracer().span("autotune.trial", cat="autotune") as sp:
+                dict(result)
+        return sp.duration, result.timings or {}
+
+    try:
+        candidates = plan_mod.autotune_candidates()
+        # Untimed warm-up of EACH candidate immediately before its
+        # timed run: different vectors select different XLA programs
+        # (a shrunken cap forces the multi-tile kernels, a q_chunk pin
+        # a different tile grid), so one default-vector warm-up would
+        # leave every deviation paying cold compile inside its timed
+        # window and bias the measured argmin toward the default.
+        for i, vec in enumerate(candidates):
+            one_run(vec)
+            dt, timings = one_run(vec)
+            trial = {
+                "index": i,
+                "knobs": {k: (int(v) if isinstance(v, bool) else v)
+                          for k, v in vec.items()},
+                "shape": shape,
+                "device_kind": env_fingerprint().get("device_kind"),
+                "total_s": round(dt, 4),
+                "rows_per_s": round(n_rows / dt),
+                "phases": {
+                    "pass_a": timings.get("stream_t_total"),
+                    "pass_b": timings.get("stream_pass_b_sweep_s"),
+                },
+                "pass_b_sweeps": timings.get("stream_pass_b_sweeps"),
+            }
+            trials.append(trial)
+            led.append("autotune.trial", {"trial": trial,
+                                          "env": env_fingerprint()})
+            log(f"## autotune trial {i}: {trial['knobs']} -> "
+                f"{trial['total_s']}s ({trial['rows_per_s']} rows/s)")
+    finally:
+        if did_set:
+            if prev is None:
+                os.environ.pop(streaming_mod._CHUNK_ENV, None)
+            else:
+                os.environ[streaming_mod._CHUNK_ENV] = prev
+        if prev_plan_dir is None:
+            os.environ.pop(planner_mod.ENV_DIR, None)
+        else:
+            os.environ[planner_mod.ENV_DIR] = prev_plan_dir
+        plan_mod.reset()
+
+    # ONE windowed ledger read after the sweep: only the bytes past
+    # the pre-sweep offset, then THIS run's entries only — a
+    # concurrent sweep sharing the ledger appends its own trials
+    # interleaved with ours, and a trial measured under another
+    # process's env must never win a bucket in this process's plan.
+    fresh = (led._store.read_from(sweep_offset)[0]
+             if led._store is not None else [])
+    entries = [e for e in obs_store.entries_since_run_id(fresh,
+                                                         led.run_id)
+               if e.get("run_id") == led.run_id]
+    model = plan_model.fit(entries, fingerprint=led.fingerprint)
+    best = plan_model.choose_best_trial(entries,
+                                        fingerprint=led.fingerprint)
+    headline = {"metric": "autotune", "trials": len(trials),
+                "rows": n_rows, "partitions": parts,
+                "degraded": bool(os.environ.get(
+                    "PIPELINEDP_TPU_DEGRADED")),
+                "env": env_fingerprint()}
+    if best is None:
+        # Every trial degraded or failed: refuse to write a plan from
+        # poisoned measurements — the next run keeps the defaults.
+        headline["plan_file"] = None
+        log("## autotune: no eligible (non-degraded) trials — no plan "
+            "written, defaults stay in force")
+    else:
+        plan = plan_mod.build_plan(
+            best, model,
+            device_kind=env_fingerprint().get("device_kind"),
+            trials=len(trials))
+        path = plan_mod.write_plan(plan)
+        headline["plan_file"] = path
+        headline["plan_hash"] = plan_mod.plan_hash(plan)
+        headline["best"] = {b: row["knobs"]
+                            for b, row in best.items()}
+        log(f"## autotune: plan {headline['plan_hash']} written to "
+            f"{path} from {len(trials)} trial(s)")
+    record_run_report()
+    print(json.dumps(headline))
+    return 0
 
 
 def roofline_probe(ds):
@@ -911,6 +1108,8 @@ def compare_to_baseline(records=None, run_report=None, threshold=0.10):
     records = _RUN_RECORDS if records is None else records
     rates, spans, regressed = [], [], []
     skipped_degraded = 0
+    plan_mismatches = 0
+    cur_plan = plan_provenance()
     # One comparison per metric, at its BEST value this run — the same
     # best-sample rule the headline applies (the flagship re-sample
     # emits the metric twice; a slow-window sample must not fail a gate
@@ -950,6 +1149,39 @@ def compare_to_baseline(records=None, run_report=None, threshold=0.10):
                  "baseline": base_val,
                  "ratio": round(value / base_val, 3),
                  "baseline_ts": base.get("ts")}
+        # Plan-provenance gate: a run under a different knob REGIME
+        # than its baseline — a plan-hash change, or an env/seam
+        # override vs a default baseline (both hash None, so the
+        # source label is the only tell: the env fingerprint's stable
+        # fields exclude the PIPELINEDP_TPU_* flags) — measures two
+        # different knob vectors, and a rate delta there is a plan
+        # difference, not a regression. Refuse to gate instead of
+        # crying wolf; the mismatch is recorded and the verdict line
+        # says so. Absent fields on old records read as "no plan"
+        # (pre-planner), so default-vs-default keeps gating exactly as
+        # before.
+        base_rec = (base.get("payload") or {}).get("record") or {}
+        base_plan = {"plan_source": base_rec.get("plan_source",
+                                                 "default"),
+                     "plan_hash": base_rec.get("plan_hash")}
+        cur_hash = rec.get("plan_hash", cur_plan["plan_hash"])
+        cur_source = rec.get("plan_source", cur_plan["plan_source"])
+        if (base_plan["plan_hash"] != cur_hash
+                or base_plan["plan_source"] != cur_source):
+            plan_mismatches += 1
+            entry["plan_mismatch"] = True
+            entry["baseline_plan"] = base_plan
+            obs.inc("bench.compare_plan_mismatch")
+            obs.event("bench.compare_plan_mismatch",
+                      metric=rec["metric"],
+                      baseline_source=base_plan["plan_source"],
+                      current_source=cur_source)
+            log(f"## compare: plan mismatch on {rec['metric']} "
+                f"(baseline {base_plan['plan_source']}/"
+                f"{base_plan['plan_hash']}, this run "
+                f"{cur_source}/{cur_hash}) — not gated")
+            rates.append(entry)
+            continue
         if value < (1.0 - threshold) * base_val:
             entry["regressed"] = True
             regressed.append(rec["metric"])
@@ -972,6 +1204,8 @@ def compare_to_baseline(records=None, run_report=None, threshold=0.10):
     return {"fingerprint": led.fingerprint, "threshold": threshold,
             "rates": rates, "spans": spans,
             "skipped_degraded_baselines": skipped_degraded,
+            "plan_mismatches": plan_mismatches,
+            "plan": cur_plan,
             "regressed": regressed}
 
 
@@ -984,8 +1218,17 @@ def compare_verdict_line(regressions):
                 f"{', '.join(regressions['regressed'])} dropped "
                 f">{regressions['threshold']:.0%} vs last-known-good "
                 f"(fingerprint {regressions['fingerprint']})")
+    if regressions.get("plan_mismatches"):
+        plan = regressions.get("plan") or {}
+        return (f"COMPARE: plan mismatch — "
+                f"{regressions['plan_mismatches']} rate(s) not gated: "
+                f"this run ran {plan.get('plan_source', 'default')} "
+                f"knobs (plan {plan.get('plan_hash')}) against a "
+                "baseline from a different knob plan; re-baseline "
+                "with matching plans before gating")
     n_based = sum(1 for r in regressions["rates"]
-                  if r.get("baseline") is not None)
+                  if r.get("baseline") is not None and
+                  not r.get("plan_mismatch"))
     if n_based == 0:
         # Nothing was actually gated — say so, instead of an "on pace"
         # that reads as a passing verdict on a first run or a fresh
@@ -1038,6 +1281,12 @@ def main():
         help="streaming-ingest benchmark row count (default: 150M full "
         "runs / 200k smoke; 0 disables)")
     parser.add_argument(
+        "--autotune", action="store_true",
+        help="run the bounded execution-planner knob sweep on the "
+        "streamed-percentile workload, append every trial to the run "
+        "ledger, fit the cost model and write the plan file a "
+        "subsequent plain run loads (pipelinedp_tpu/plan)")
+    parser.add_argument(
         "--compare", action="store_true",
         help="diff this run's rates and span totals against the run "
         "ledger's last-known-good for the same environment fingerprint "
@@ -1074,6 +1323,24 @@ def main():
     cache_dir = maybe_enable_compile_cache()
     if cache_dir:
         log(f"## persistent compile cache: {cache_dir}")
+
+    # The execution planner's plan file: like the run ledger, the
+    # bench falls back to a cwd-local directory when neither
+    # PIPELINEDP_TPU_PLAN_DIR nor the compile cache names one — so
+    # `bench.py --autotune` followed by a plain `bench.py` in the same
+    # directory closes the loop without any env setup.
+    from pipelinedp_tpu import plan as plan_mod
+    plan_mod.set_default_dir(os.path.join(os.getcwd(), ".pdp_plan"))
+    # Snapshot the plan provenance NOW — before any record injects its
+    # measurement scaffolding (chunk env, cap seams) — so every record
+    # and the headline carry the regime the run was launched under.
+    plan_provenance()
+
+    if args.autotune:
+        rc = run_autotune(args)
+        if monitor is not None:
+            obs_monitor.stop()
+        sys.exit(rc)
 
     import pipelinedp_tpu as pdp
 
@@ -1221,6 +1488,10 @@ def main():
                 ("metric", "value", "unit", "vs_baseline",
                  "host_s", "device_s") if k in flagship}
     headline["degraded"] = bool(health_report.degraded)
+    # Plan provenance on the artifact of record: which knob plan
+    # produced this rate (autotuned / env-override / default + the
+    # plan-file hash) — the TPU re-capture's "which plan" evidence.
+    headline.update(plan_provenance())
     if health_report.degraded:
         # The artifact used to say only "degraded": true (plus an
         # attempt count buried in stderr) — now it carries the probe

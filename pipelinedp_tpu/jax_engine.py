@@ -1162,7 +1162,10 @@ def _node_noise(noise_kind: NoiseKind, key, node_ids, pk_index=None):
 # HBM cap for the per-quantile subtree histogram (int32 [P, Q, span]);
 # above it the walk chunks the partition axis into blocks and walks
 # block-by-block (bit-identical to the unchunked walk — node noise is a
-# pure function of (partition, node id)).
+# pure function of (partition, node id)). Registered as the
+# ``subhist_byte_cap`` knob; reads flow through ``plan.knobs`` (env >
+# this seam when test-mutated > plan file > this default) and the
+# module name survives as the test seam (``make noknobs``).
 _SUBHIST_BYTE_CAP = 600 << 20
 
 # The single-batch walk unrolls its partition blocks INSIDE one XLA
@@ -1258,7 +1261,14 @@ def _percentile_values(config: FusedConfig, P, qrows, scale, key):
         # the choice happens; cached executions re-use the program and
         # the recorded choice with it.
         from pipelinedp_tpu import obs
-        if P * Q * span * 4 <= _SUBHIST_BYTE_CAP:
+        from pipelinedp_tpu import plan as plan_mod
+        # The execution planner's resolution of the subhist byte cap
+        # (env > test seam > plan file > default; the module constant
+        # survives as the seam). Host code at jit-trace time, so the
+        # choice is recorded once per compiled shape like the walk
+        # path itself.
+        subhist_cap = int(plan_mod.knob_value("subhist_byte_cap"))
+        if P * Q * span * 4 <= subhist_cap:
             obs.inc("walk.path_subhist")
             obs.event("walk.path", path="subhist", scope="compile",
                       P=int(P), Q=int(Q), span=int(span))
@@ -1274,8 +1284,8 @@ def _percentile_values(config: FusedConfig, P, qrows, scale, key):
                 level_offset += b**(level + 1)
         else:
             blk = 0
-            if Q * span * 4 <= _SUBHIST_BYTE_CAP:
-                blk = min(P, 1 << ((_SUBHIST_BYTE_CAP //
+            if Q * span * 4 <= subhist_cap:
+                blk = min(P, 1 << ((subhist_cap //
                                     (Q * span * 4)).bit_length() - 1))
             if blk and -(-P // blk) <= _MAX_WALK_BLOCKS:
                 obs.inc("walk.path_partition_block_chunked")
@@ -2110,7 +2120,8 @@ class LazyFusedResult:
                 for k in ("pass_b_sweeps", "pass_b_tiles",
                           "pass_b_tiles_per_sweep",
                           "pass_b_cached_batches",
-                          "pass_b_reshipped_bytes"):
+                          "pass_b_reshipped_bytes",
+                          "pass_b_sweep_s"):
                     self.timings[f"stream_{k}"] = stream_stats[k]
             with tr.span("engine.release", cat="engine"):
                 part64 = {k: v[:P] for k, v in part64.items()}
@@ -2148,6 +2159,18 @@ class LazyFusedResult:
             _maybe_append_run_ledger(mesh=self._mesh)
             return out
 
+        # The execution planner's resolution for THIS single-batch
+        # request (streamed requests resolve inside
+        # stream_partials_and_select): the plan.applied events and the
+        # run report's plan section exist for every request, and the
+        # walk's mid-request cap read (knob_value at jit-trace time)
+        # buckets at this request's shape instead of a stale previous
+        # request's.
+        from pipelinedp_tpu import plan as _plan_mod
+        _plan_mod.resolve(
+            shape={"rows": int(encoded.n_rows), "partitions": int(P),
+                   "quantiles": len(config.percentiles or ())},
+            mesh=self._mesh)
         with tr.span("engine.device", cat="engine", path="single_batch"):
             keep_pk, raw, fx_bits = _run_fused_kernel(
                 config, encoded, scales, keep_table, thr, s_scale,

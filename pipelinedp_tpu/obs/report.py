@@ -36,7 +36,12 @@ from pipelinedp_tpu.obs import costs as _costs
 #: compile wall/cache verdict, flops/bytes, memory stats, per-phase
 #: roofline verdicts — ``obs.costs``); absent in v1/v2 reports, which
 #: readers treat as "device costs not captured".
-SCHEMA_VERSION = 3
+#: v4 (execution-planner PR): adds the ``plan`` section (the resolved
+#: knob vector with per-knob source env/seam/plan/default, the plan
+#: file hash, predicted vs observed seconds — ``pipelinedp_tpu.plan``);
+#: absent in v1–v3 reports AND in v4 runs that resolved no knobs,
+#: which readers treat as "default knobs, no plan in force".
+SCHEMA_VERSION = 4
 
 _git_probe_cache: Optional[Tuple[str, bool]] = None
 
@@ -160,6 +165,17 @@ def build_run_report(snapshot: Dict[str, Any], mesh=None,
     device_costs = _costs.TABLE.snapshot()
     if device_costs["programs"]:
         report["device_costs"] = device_costs
+    # v4: the execution planner's resolved knob vector — included
+    # whenever a request resolved knobs this run (absent = default
+    # knobs / no plan, the v1–v3-compatible reading). Lazy import:
+    # ``plan`` imports obs, so a module-level import here would cycle.
+    try:
+        from pipelinedp_tpu import plan as _plan
+        plan_section = _plan.snapshot()
+    except Exception:
+        plan_section = None
+    if plan_section:
+        report["plan"] = plan_section
     if extra:
         report.update(extra)
     return report

@@ -43,7 +43,7 @@ import json
 import os
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from pipelinedp_tpu.obs.report import SCHEMA_VERSION
 
@@ -150,14 +150,36 @@ class LedgerStore:
         """All parseable entries in append order. Skips (and counts)
         torn/corrupt lines instead of failing the read — a crashed
         writer must not take the whole history down."""
+        return self.read_from(0)[0]
+
+    def read_from(self, offset: int = 0
+                  ) -> Tuple[List[Dict[str, Any]], int]:
+        """(entries, end_offset) for the ledger bytes past ``offset``
+        — the incremental read behind run-windowed fitting: a caller
+        that remembers ``end_offset`` re-reads only what was appended
+        since, so consuming a growing service ledger stays linear
+        instead of quadratic."""
         out: List[Dict[str, Any]] = []
         skipped = 0
         try:
             with open(self.path, "rb") as f:
+                f.seek(int(offset))
                 data = f.read()
+                end = f.tell()
         except OSError:
             self.skipped_lines = 0
-            return out
+            return out, int(offset)
+        if data and not data.endswith(b"\n"):
+            # An unterminated tail is an entry still being written (or
+            # a crashed writer's torn line the next append repairs with
+            # a leading newline): do NOT consume it — advancing the
+            # cursor past a half-written line would split one entry
+            # across two reads and drop it forever. Leave it for the
+            # next read; the writer's completion (or repair) makes it
+            # parseable-or-skippable then.
+            cut = data.rfind(b"\n") + 1
+            end = int(offset) + cut
+            data = data[:cut]
         for raw in data.split(b"\n"):
             if not raw.strip():
                 continue
@@ -174,7 +196,7 @@ class LedgerStore:
             entry.setdefault("degraded", False)
             out.append(entry)
         self.skipped_lines = skipped
-        return out
+        return out, end
 
     @staticmethod
     def _matches(entry: Dict[str, Any], name: str,
@@ -250,6 +272,21 @@ def _mesh_env_key(mesh) -> Any:
         return tuple(zip(mesh.axis_names, mesh.devices.shape))
     except Exception:
         return ("unknown_mesh",)
+
+
+def entries_since_run_id(entries: List[Dict[str, Any]],
+                         run_id: str) -> List[Dict[str, Any]]:
+    """The suffix of ``entries`` starting at the FIRST entry tagged
+    with ``run_id`` — the ``--since-run-id`` window. The autotune
+    fitter uses it (and :meth:`LedgerStore.read_from`) to fit from
+    post-sweep entries instead of the whole history: a long-lived
+    service ledger grows linearly, and fitting must not go quadratic.
+    An unknown run id windows to nothing (an honest empty answer
+    beats silently fitting the full ledger)."""
+    for i, e in enumerate(entries):
+        if e.get("run_id") == run_id:
+            return entries[i:]
+    return []
 
 
 # --- ledger analytics (``python -m pipelinedp_tpu.obs.store``) ---
@@ -457,6 +494,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "./.pdp_ledger)")
     parser.add_argument("--fingerprint", default=None,
                         help="restrict to one environment fingerprint")
+    parser.add_argument("--since-run-id", default=None,
+                        dest="since_run_id",
+                        help="window to entries at/after the first "
+                        "one tagged with this run id (the autotune "
+                        "fitter's post-sweep window)")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="machine-readable output (the autotune "
                         "planner's input shape)")
@@ -472,6 +514,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         default=os.path.join(os.getcwd(), ".pdp_ledger"))
     s = LedgerStore(directory)
     entries = s.entries()
+    if args.since_run_id:
+        entries = entries_since_run_id(entries, args.since_run_id)
     if args.fingerprint:
         entries = [e for e in entries
                    if e.get("fingerprint") == args.fingerprint]

@@ -1,0 +1,214 @@
+"""The knob registry: every planner-visible tunable, in ONE place.
+
+The stack grew a forest of hand-set execution knobs — HBM byte caps,
+stream batch sizing, cache budgets, the ingest-executor switch — each
+living as a module constant or an env var at its point of use. This
+module is the single registry over them: one :class:`KnobSpec` per
+knob recording its unit, hardcoded default, env-override name, module
+seam (the test-injectable constant) and whether a plan file may change
+it, plus the one resolution function every consumer goes through.
+
+Resolution precedence (most explicit wins)::
+
+    explicit env override  >  test-seam mutation  >  plan file  >  default
+
+* **env** — the knob's ``PIPELINEDP_TPU_*`` variable is set (any
+  value, including the default: setting it is the explicit act).
+* **seam** — the module constant (``je._SUBHIST_BYTE_CAP``,
+  ``streaming._SELECT_UNITS_CAP``, ...) differs from the registered
+  default. Tests and bench inject caps by mutating these (via
+  :func:`seam_override`); a mutated seam must outrank any plan file or
+  existing suites would silently run planned values.
+* **plan** — the loaded plan file carries the knob AND the knob is
+  ``dp_safe``: a plan may only select among execution paths that are
+  bit-parity-tested (PARITY row 32). ``stream_chunk_rows`` is NOT
+  dp-safe — batch membership decides which rows a unit's bounding
+  subsample sees, so replanning it would change DP outputs — and the
+  int32 guard caps are refusal thresholds, not performance choices;
+  plan values for non-dp-safe knobs are ignored with a
+  ``plan.skipped_dp_unsafe`` event.
+* **default** — today's hardcoded value, byte-for-byte: cold start
+  (empty ledger, no plan file, no env) resolves to exactly the
+  pre-planner behavior.
+
+Direct reads of the registered constants outside this package are
+banned (``make noknobs`` + the AST twin in ``tests/test_plan.py``);
+consumers call :func:`value` / ``plan.resolve()`` instead, and the
+module-level names survive purely as test seams.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import importlib
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class KnobSpec:
+    """One registered execution knob."""
+    name: str
+    unit: str                       #: human unit ("bytes", "rows", ...)
+    default: Any                    #: today's hardcoded default
+    env_var: Optional[str]          #: explicit-override env name
+    seam: Optional[Tuple[str, str]]  #: (module, attr) test seam
+    dp_safe: bool                   #: may a plan file change it?
+    kind: type                      #: int or bool
+    doc: str
+
+    def parse(self, raw: Any) -> Any:
+        if self.kind is bool:
+            if isinstance(raw, str):
+                return raw.lower() not in ("0", "false", "off")
+            return bool(raw)
+        return int(raw)
+
+
+_I32_MAX = int(np.iinfo(np.int32).max)
+
+#: (knob, value) pairs whose plan.skipped_dp_unsafe event already
+#: fired — cleared by planner.reset() at run boundaries.
+_dp_unsafe_seen: set = set()
+
+#: The registry. Units and defaults are the documentation of record
+#: (mirrored in README "Execution planner"); ``seam`` names the
+#: module constant kept alive as the test seam.
+REGISTRY: Tuple[KnobSpec, ...] = (
+    KnobSpec(
+        "subhist_byte_cap", "bytes", 600 << 20,
+        "PIPELINEDP_TPU_SUBHIST_CAP",
+        ("pipelinedp_tpu.jax_engine", "_SUBHIST_BYTE_CAP"), True, int,
+        "HBM budget for the walk's [P, Q, span] subtree histogram AND "
+        "the pass-B sweep planner's tile-packing budget: above it the "
+        "walk partition-block-chunks and pass B tiles the (quantile x "
+        "partition) grid. Any tiling is bit-identical to the unchunked "
+        "walk (node noise is a pure function of the global (partition, "
+        "node id))."),
+    KnobSpec(
+        "stream_chunk_rows", "rows per device batch", 1 << 26,
+        "PIPELINEDP_TPU_STREAM_CHUNK", None, False, int,
+        "Rows per streamed device batch (and the engine's streaming "
+        "trigger). NOT dp-safe: batch membership decides which rows a "
+        "privacy unit's bounding subsample sees, so a plan never "
+        "changes it — env override and default only."),
+    KnobSpec(
+        "stream_cache_bytes", "bytes", 4 << 30,
+        "PIPELINEDP_TPU_STREAM_CACHE", None, True, int,
+        "Per-device HBM budget for the pass-B prefix cache (0 "
+        "disables). device_cache / hybrid / reship are bit-identical "
+        "(PARITY row 3), so the plan may trade HBM for link traffic."),
+    KnobSpec(
+        "ingest_executor", "bool", True,
+        "PIPELINEDP_TPU_INGEST_EXECUTOR", None, True, bool,
+        "Overlapped staging/compute/fold executor for streamed runs; "
+        "off = the serial bit-parity reference path (identical "
+        "outputs, PARITY row 11)."),
+    KnobSpec(
+        "q_chunk", "quantiles per pass-B tile (0 = planner search)", 0,
+        "PIPELINEDP_TPU_Q_CHUNK",
+        ("pipelinedp_tpu.streaming", "_Q_CHUNK"), True, int,
+        "Pins the sweep planner's quantiles-per-tile choice; 0 lets "
+        "plan_pass_b_sweeps search the (q_chunk, p_blk) grid. Every "
+        "tiling is bit-identical (PARITY row 3); an infeasible pin "
+        "falls back to the search."),
+    KnobSpec(
+        "select_units_cap", "privacy units per partition", _I32_MAX,
+        None, ("pipelinedp_tpu.streaming", "_SELECT_UNITS_CAP"),
+        False, int,
+        "int32 guard cap: privacy units per partition at streamed "
+        "selection time. A refusal threshold, not a performance "
+        "choice — never planned; the seam exists so boundary tests "
+        "can pin the exact cliff."),
+    KnobSpec(
+        "tree_rows_cap", "kept rows per partition", _I32_MAX,
+        None, ("pipelinedp_tpu.streaming", "_TREE_ROWS_CAP"),
+        False, int,
+        "int32 guard cap: kept rows per partition in the streamed "
+        "percentile tree histograms. A refusal threshold — never "
+        "planned; seam for boundary tests."),
+)
+
+BY_NAME: Dict[str, KnobSpec] = {spec.name: spec for spec in REGISTRY}
+
+
+def _seam_value(spec: KnobSpec) -> Any:
+    mod = importlib.import_module(spec.seam[0])
+    return getattr(mod, spec.seam[1])
+
+
+def resolve_value(spec: KnobSpec,
+                  plan_knobs: Optional[Dict[str, Any]] = None
+                  ) -> Tuple[Any, str]:
+    """(value, source) for one knob under the registry precedence.
+    ``plan_knobs`` is the knob dict of an already-validated plan file
+    (None: no plan in force). Source is one of ``env`` / ``seam`` /
+    ``plan`` / ``default``."""
+    if spec.env_var is not None:
+        raw = os.environ.get(spec.env_var)
+        if raw is not None and raw != "":
+            return spec.parse(raw), "env"
+    if spec.seam is not None:
+        current = _seam_value(spec)
+        if current != spec.default:
+            return current, "seam"
+    if plan_knobs is not None and spec.name in plan_knobs:
+        if spec.dp_safe:
+            return spec.parse(plan_knobs[spec.name]), "plan"
+        # Once per (knob, offending value) observation — resolution
+        # runs on every knob read, and re-emitting per read would
+        # flood the bounded obs event ring (same dedup contract as
+        # plan.stale).
+        skip_key = (spec.name, repr(plan_knobs[spec.name]))
+        if skip_key not in _dp_unsafe_seen:
+            _dp_unsafe_seen.add(skip_key)
+            from pipelinedp_tpu import obs
+            obs.event("plan.skipped_dp_unsafe", knob=spec.name,
+                      plan_value=plan_knobs[spec.name])
+    return spec.default, "default"
+
+
+def value(name: str, plan_knobs: Optional[Dict[str, Any]] = None) -> Any:
+    """The resolved value of one knob (see :func:`resolve_value`).
+    With ``plan_knobs`` omitted the current plan file (if any) is
+    consulted through the planner's cached load, bucketed at the last
+    resolved request shape — so a mid-request read (the walk's cap at
+    jit-trace time) sees the same vector the request resolved."""
+    spec = BY_NAME[name]
+    if plan_knobs is None:
+        from pipelinedp_tpu.plan import planner
+        plan_knobs = planner.current_plan_knobs(
+            planner.last_resolved_shape())
+    return resolve_value(spec, plan_knobs)[0]
+
+
+def defaults() -> Dict[str, Any]:
+    """{name: hardcoded default} — the cold-start resolution vector."""
+    return {spec.name: spec.default for spec in REGISTRY}
+
+
+def resolve_all(plan_knobs: Optional[Dict[str, Any]] = None
+                ) -> Dict[str, Tuple[Any, str]]:
+    """{name: (value, source)} for every registered knob."""
+    return {spec.name: resolve_value(spec, plan_knobs)
+            for spec in REGISTRY}
+
+
+@contextlib.contextmanager
+def seam_override(name: str, value: Any):
+    """Temporarily set a knob's module seam (the blessed injection
+    idiom for tests and bench probe records — a mutated seam outranks
+    any plan file, so injected-cap records measure the injected cap)."""
+    spec = BY_NAME[name]
+    if spec.seam is None:
+        raise ValueError(f"knob {name!r} has no module seam")
+    mod = importlib.import_module(spec.seam[0])
+    saved = getattr(mod, spec.seam[1])
+    setattr(mod, spec.seam[1], spec.parse(value))
+    try:
+        yield
+    finally:
+        setattr(mod, spec.seam[1], saved)

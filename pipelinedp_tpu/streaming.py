@@ -81,22 +81,28 @@ from pipelinedp_tpu.ops.segment import fmix32
 
 #: Rows per device batch (and the engine's streaming trigger: pipelines
 #: with more rows than one chunk stream). Overridable for tests and for
-#: hosts with small HBM.
+#: hosts with small HBM. Registered as the ``stream_chunk_rows`` knob
+#: (NOT dp-safe: batch membership decides a unit's bounding subsample,
+#: so a plan file never changes it — env override and default only).
 _CHUNK_ENV = "PIPELINEDP_TPU_STREAM_CHUNK"
 
 
 def stream_chunk_rows() -> int:
-    return int(os.environ.get(_CHUNK_ENV, 1 << 26))
+    from pipelinedp_tpu import plan as plan_mod
+    return int(plan_mod.knob_value("stream_chunk_rows"))
 
 
 #: HBM budget for keeping shipped batches device-resident so percentile
 #: pass B re-reads them from HBM instead of re-shipping every byte over
-#: the host link. 0 disables the cache.
+#: the host link. 0 disables the cache. Registered as the
+#: ``stream_cache_bytes`` knob (dp-safe: all three pass-B sources are
+#: bit-identical, so a plan may trade HBM for link traffic).
 _CACHE_ENV = "PIPELINEDP_TPU_STREAM_CACHE"
 
 
 def stream_cache_bytes() -> int:
-    return int(os.environ.get(_CACHE_ENV, 4 << 30))
+    from pipelinedp_tpu import plan as plan_mod
+    return int(plan_mod.knob_value("stream_cache_bytes"))
 
 
 #: Extreme-scale guard caps (int32 accumulator capacity), module-level
@@ -105,9 +111,18 @@ def stream_cache_bytes() -> int:
 #: 524,417-row boundary is pinned — without materializing 2^31-row
 #: datasets. ``_SELECT_UNITS_CAP``: privacy units per partition at
 #: selection time; ``_TREE_ROWS_CAP``: kept rows per partition in the
-#: streamed percentile tree histograms.
+#: streamed percentile tree histograms. Registered knobs
+#: (``select_units_cap`` / ``tree_rows_cap``); refusal thresholds, not
+#: performance choices — a plan file never changes them. Reads flow
+#: through ``plan.knobs`` (``make noknobs``); the names stay as seams.
 _SELECT_UNITS_CAP = int(np.iinfo(np.int32).max)
 _TREE_ROWS_CAP = int(np.iinfo(np.int32).max)
+
+#: Pass-B quantiles-per-tile pin (the ``q_chunk`` knob's seam): 0 lets
+#: :func:`plan_pass_b_sweeps` search the (q_chunk, p_blk) grid; a
+#: positive value pins the quantile-group width (every tiling is
+#: bit-identical — PARITY row 3 — so the pin is purely a perf choice).
+_Q_CHUNK = 0
 
 
 def chunk_target_rows(config, n_dev: int) -> int:
@@ -298,7 +313,7 @@ class PassBPlan:
         return len(self.tiles) > 1
 
 
-def plan_pass_b_sweeps(P_pad, Q, span, cap) -> PassBPlan:
+def plan_pass_b_sweeps(P_pad, Q, span, cap, q_chunk=0) -> PassBPlan:
     """Sizes pass B's stream sweeps BEFORE anything streams. The device
     budget is ``cap`` bytes of int32 [.., span] subtree block; the unit
     of account is one [1, 1, span] block. The planner searches the
@@ -307,18 +322,21 @@ def plan_pass_b_sweeps(P_pad, Q, span, cap) -> PassBPlan:
     tie-breaking toward fewer tiles (fewer scatters + walk launches),
     then larger partition blocks (the historical per-tile shapes, so
     the non-packable regimes keep their exact old round structure).
-    Past the cap, capacity becomes extra sweeps (a time cost), never a
-    refusal; only a cap below a single [1, 1, span] block (necessarily
+    A positive ``q_chunk`` (the execution planner's knob) pins the
+    quantile-group width instead of searching it — every tiling is
+    bit-identical, so the pin is a pure performance choice; an
+    infeasible pin falls back to the full search. Past the cap,
+    capacity becomes extra sweeps (a time cost), never a refusal; only
+    a cap below a single [1, 1, span] block (necessarily
     test-shrunken) raises."""
     unit = span * 4
     if unit > cap:
         raise NotImplementedError(
             f"streamed percentiles need one [1, 1, {span}] "
-            f"subtree block ({unit} bytes) within "
-            "_SUBHIST_BYTE_CAP — the cap is below a single "
-            "partition's block")
+            f"subtree block ({unit} bytes) within the subhist byte "
+            "cap — the cap is below a single partition's block")
     budget = cap // unit  # [1, 1, span] blocks per sweep
-    if P_pad * Q <= budget:
+    if P_pad * Q <= budget and not (0 < q_chunk < Q):
         tile = ((0, Q, 0),)
         return PassBPlan(Q, P_pad, 1, tile, (tile,))
     # Candidate partition blocks: the full axis (which may be a
@@ -331,7 +349,9 @@ def plan_pass_b_sweeps(P_pad, Q, span, cap) -> PassBPlan:
                             if P_pad % (1 << k) == 0},
                  reverse=True)
     best = None
-    for qc in range(1, Q + 1):
+    qcs = ([min(int(q_chunk), Q)] if q_chunk and q_chunk > 0
+           else range(1, Q + 1))
+    for qc in qcs:
         for pb in pbs:
             if qc * pb > budget:
                 continue
@@ -345,6 +365,13 @@ def plan_pass_b_sweeps(P_pad, Q, span, cap) -> PassBPlan:
             key = (sweeps, n_tiles, -pb, -qc)
             if best is None or key < best[0]:
                 best = (key, qc, pb, t_full)
+    if best is None and q_chunk:
+        # The pinned quantile-group width fits no partition block under
+        # this cap — fall back to the full search rather than refuse (a
+        # plan must never make a previously-feasible shape infeasible).
+        obs.event("plan.q_chunk_infeasible", q_chunk=int(q_chunk),
+                  Q=int(Q), P_pad=int(P_pad), cap=int(cap))
+        return plan_pass_b_sweeps(P_pad, Q, span, cap)
     _, qc, pb, t_full = best
     tiles = tuple((q0, min(qc, Q - q0), p0)
                   for q0 in range(0, Q, qc)
@@ -670,8 +697,22 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
     # no-op and the spans below cost exactly what they did before.
     obs.monitor.maybe_start()
 
-    use_executor = (ingest.executor_enabled() if executor is None
-                    else bool(executor))
+    # The execution planner: resolve the full knob vector for THIS
+    # request shape (env > seam > plan file > default — plan/knobs.py)
+    # and record it (one plan.applied event per knob; the run report's
+    # schema-v4 "plan" section). Cold start — no plan file, no env —
+    # resolves byte-identically to the former hardcoded defaults, and
+    # a plan can only move dp-safe knobs (every one selects among
+    # bit-parity-tested paths: PARITY row 32).
+    from pipelinedp_tpu import plan as plan_mod
+    knob_plan = plan_mod.resolve(
+        shape={"rows": int(encoded.n_rows),
+               "partitions": len(encoded.pk_vocab),
+               "quantiles": len(config.percentiles or ())},
+        mesh=mesh)
+
+    use_executor = (bool(knob_plan.values["ingest_executor"])
+                    if executor is None else bool(executor))
     if mesh is not None and mesh.is_multi_process:
         # Multi-PROCESS meshes run the serial path: every process must
         # enqueue the same device work in the same order, and the
@@ -717,14 +758,16 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
         # the unchunked descent. Only a cap below a single [1, 1, span]
         # block (necessarily test-shrunken) is refused.
         _, _, _, span = _tree_consts()
+        subhist_cap = int(knob_plan.values["subhist_byte_cap"])
         try:
             plan = plan_pass_b_sweeps(P_pad, len(config.percentiles),
-                                      span, je._SUBHIST_BYTE_CAP)
+                                      span, subhist_cap,
+                                      q_chunk=int(
+                                          knob_plan.values["q_chunk"]))
         except NotImplementedError:
             obs.inc("walk.path_streamed_refusal")
             obs.event("walk.fallback", path="streamed_refusal",
-                      span_bytes=span * 4,
-                      cap=int(je._SUBHIST_BYTE_CAP))
+                      span_bytes=span * 4, cap=subhist_cap)
             raise
         if plan.chunked:
             # The guard-cliff path fired: extra pass-B sweeps instead
@@ -840,8 +883,8 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
     # hybrid source). A RESUMED run never caches: the skipped batch
     # prefix is absent, so a partial cache would silently drop those
     # rows from pass B.
-    cache_cap = (stream_cache_bytes() if cache_bytes is None
-                 else int(cache_bytes))
+    cache_cap = (int(knob_plan.values["stream_cache_bytes"])
+                 if cache_bytes is None else int(cache_bytes))
     cache: Optional[list] = ([] if config.percentiles and
                              start_batch == 0 and cache_cap > 0
                              else None)
@@ -1167,7 +1210,8 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
         keep = np.ones(P_pad, bool)
     else:
         nseg = acc["privacy_id_count_raw"]
-        if nseg.max(initial=0) >= _SELECT_UNITS_CAP:
+        if nseg.max(initial=0) >= int(
+                knob_plan.values["select_units_cap"]):
             raise NotImplementedError(
                 "more than 2^31 privacy units in one partition")
         # Selection never touches the percentile walk (that runs in
@@ -1204,7 +1248,8 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
         # The histograms accumulate across chunks in device int32:
         # a partition with >= 2^31 kept rows would wrap a bucket, so
         # guard on the exact host-side per-partition counts.
-        if int(acc["count"].max(initial=0)) >= _TREE_ROWS_CAP:
+        if int(acc["count"].max(initial=0)) >= int(
+                knob_plan.values["tree_rows_cap"]):
             raise NotImplementedError(
                 "streamed percentiles: a partition holds >= 2^31 kept "
                 "rows — beyond the int32 tree-histogram capacity")
@@ -1360,6 +1405,9 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                 obs.inc("stream.pass_b_tiles", len(sweep))
         stats["pass_b_rounds"] = plan.n_sweeps
         stats["pass_b_sweeps"] = plan.n_sweeps
+        # Pass-B wall seconds (sweep spans): the cost-model feature the
+        # autotune trials record alongside the pass-A breakdown.
+        stats["pass_b_sweep_s"] = tr.total("ingest.pass_b_sweep")
         stats["pass_b_tiles"] = plan.n_tiles
         stats["pass_b_tiles_per_sweep"] = plan.tiles_per_sweep
         stats["pass_b_cached_batches"] = len(prefix)
@@ -1375,6 +1423,16 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
     # through the re-ship rounds) — the same window the former
     # accumulator covered.
     stats["stage_s"] = tr.total("ingest.stage")
+    # Close the planner's predicted-vs-observed loop: the run report's
+    # "plan" section shows these next to the model's predictions —
+    # SAME phase keys as predicted.seconds, so readers zip them
+    # without an out-of-band mapping.
+    plan_mod.note_observed("pass_a", t_loop)
+    if config.percentiles:
+        plan_mod.note_observed("pass_b",
+                               tr.total("ingest.pass_b_sweep"))
+        plan_mod.note_observed("walk", tr.total("walk.top") +
+                               tr.total("walk.bottom"))
     if ckpt_store is not None:
         # The run released its outputs: the checkpoint must not survive
         # (resuming a FINISHED run into a fresh aggregation would skip

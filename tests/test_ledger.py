@@ -65,7 +65,7 @@ class TestStoreCore:
         fp = obs_store.fingerprint_key(ENV_A)
         entry = s.append("m1", {"record": {"value": 100}}, env=ENV_A)
         assert entry["fingerprint"] == fp
-        assert entry["schema_version"] == obs.SCHEMA_VERSION == 3
+        assert entry["schema_version"] == obs.SCHEMA_VERSION == 4
         got = s.entries()
         assert len(got) == 1
         assert got[0]["payload"]["record"]["value"] == 100
@@ -93,27 +93,33 @@ class TestStoreCore:
                                 "payload": {}}) + "\n")
         s.append("new", {"record": {"value": 9}}, env=ENV_A)
         entries = s.entries()
-        assert [e["schema_version"] for e in entries] == [1, 1, 3]
+        assert [e["schema_version"] for e in entries] == [1, 1, 4]
         assert all(e["degraded"] is False for e in entries)
         lkg = s.last_known_good("old", fp)
         assert lkg is not None and (
             lkg["payload"]["record"]["value"] == 7)
 
     def test_truncated_trailing_line_recovery(self, tmp_path):
-        """A crash mid-write leaves a torn tail: reads skip (and count)
-        it, and the next append starts a fresh parseable line."""
+        """A crash mid-write leaves a torn tail: reads leave it
+        UNCONSUMED (it may be an entry still being written — consuming
+        it would split the entry across two incremental reads and drop
+        it), the cursor stops before it, and the next append repairs
+        it into a counted skip."""
         s = obs_store.LedgerStore(str(tmp_path / "s"))
         for v in (1, 2):
             s.append("m", {"record": {"value": v}}, env=ENV_A)
+        clean_end = os.path.getsize(s.path)
         with open(s.path, "ab") as f:
             f.write(b'{"schema_version": 2, "name": "m", "payl')
-        assert len(s.entries()) == 2
-        assert s.skipped_lines == 1
+        got, end = s.read_from(0)
+        assert len(got) == 2
+        assert s.skipped_lines == 0  # tail not consumed, not "corrupt"
+        assert end == clean_end      # cursor stops BEFORE the tail
         s.append("m", {"record": {"value": 3}}, env=ENV_A)
-        entries = s.entries()
-        assert [e["payload"]["record"]["value"] for e in entries] == [
-            1, 2, 3]
-        assert s.skipped_lines == 1  # the torn line stays skipped
+        entries, end2 = s.read_from(end)
+        assert [e["payload"]["record"]["value"] for e in entries] == [3]
+        assert s.skipped_lines == 1  # repaired torn line now skips
+        assert end2 == os.path.getsize(s.path)
 
     def test_concurrent_appends_lose_nothing(self, tmp_path):
         """>= 3 threads appending concurrently: every record lands,
@@ -272,7 +278,7 @@ class TestAuditSection:
                    if e["name"] == "engine.aggregate"]
         assert entries, "traced engine run did not append to the store"
         report = entries[-1]["payload"]["run_report"]
-        assert report["schema_version"] == 3
+        assert report["schema_version"] == 4
         mechs = report["privacy"]["accountants"][0]["mechanisms"]
         assert all("eps" in m and "delta" in m and
                    "noise_standard_deviation" in m for m in mechs)
@@ -332,7 +338,7 @@ class TestBenchCompareAcceptance:
         # Run 1: records + run report land in the store.
         bench.reset_run_state()
         rec1, rep1 = bench_one_run(bench)
-        assert rep1["schema_version"] == 3
+        assert rep1["schema_version"] == 4
         mechs = rep1["privacy"]["accountants"][0]["mechanisms"]
         assert mechs and all(
             "eps" in m and "delta" in m and
@@ -371,7 +377,11 @@ class TestBenchCompareAcceptance:
                                       "unit": "rows/s"}}, env=env,
                      degraded=True)
         bench.reset_run_state()  # re-snapshot baselines incl. the above
-        current = [{"metric": "m", "value": 500, "unit": "rows/s"}]
+        # Synthetic records carry an explicit plan_source: the ambient
+        # chunk-env override the fixture sets would otherwise read as a
+        # knob-regime change and refuse the gate (tested in test_plan).
+        current = [{"metric": "m", "value": 500, "unit": "rows/s",
+                    "plan_source": "default"}]
         regressions = bench.compare_to_baseline(records=current)
         # The degraded 10-rows/s capture neither became the baseline
         # (masking the regression) nor poisoned the ratio.
@@ -384,7 +394,8 @@ class TestBenchCompareAcceptance:
         assert events and events[0]["metric"] == "m"
         # Within tolerance: no regression flagged.
         ok = bench.compare_to_baseline(
-            records=[{"metric": "m", "value": 950, "unit": "rows/s"}])
+            records=[{"metric": "m", "value": 950, "unit": "rows/s",
+                      "plan_source": "default"}])
         assert ok["regressed"] == []
 
     def test_baseline_is_best_sample_of_last_run(self, monkeypatch):
@@ -401,7 +412,8 @@ class TestBenchCompareAcceptance:
                          run_id="runA")
         bench.reset_run_state()
         reg = bench.compare_to_baseline(
-            records=[{"metric": "m", "value": 500, "unit": "rows/s"}])
+            records=[{"metric": "m", "value": 500, "unit": "rows/s",
+                      "plan_source": "default"}])
         rate = reg["rates"][0]
         assert rate["baseline"] == 1000
         assert reg["regressed"] == ["m"]
@@ -424,7 +436,8 @@ class TestBenchCompareAcceptance:
                      run_id="bad")
         bench.reset_run_state()
         reg = bench.compare_to_baseline(
-            records=[{"metric": "m", "value": 500, "unit": "rows/s"}])
+            records=[{"metric": "m", "value": 500, "unit": "rows/s",
+                      "plan_source": "default"}])
         rate = reg["rates"][0]
         assert rate["baseline"] == 1000
         assert reg["regressed"] == ["m"]
@@ -461,8 +474,10 @@ class TestBenchCompareAcceptance:
 
 class TestNoAdHocArtifactWrites:
     """AST-precise twin of ``make noartifacts``: ``json.dump(`` file
-    writes are banned outside ``pipelinedp_tpu/obs/`` — run artifacts
-    must flow through the schema-versioned report/store (bench.py, the
+    writes are banned outside ``pipelinedp_tpu/obs/`` and
+    ``pipelinedp_tpu/plan/`` (the planner's atomically-replaced plan
+    file is the second blessed durable artifact) — run artifacts must
+    flow through the schema-versioned report/store/plan (bench.py, the
     one artifact emitter, is outside the scanned tree)."""
 
     def test_json_dump_only_under_obs(self):
@@ -474,7 +489,8 @@ class TestNoAdHocArtifactWrites:
                     continue
                 path = os.path.join(dirpath, fname)
                 rel = os.path.relpath(path, REPO).replace(os.sep, "/")
-                if rel.startswith("pipelinedp_tpu/obs/"):
+                if rel.startswith(("pipelinedp_tpu/obs/",
+                                   "pipelinedp_tpu/plan/")):
                     continue
                 with open(path, encoding="utf-8") as f:
                     tree = ast.parse(f.read(), filename=rel)

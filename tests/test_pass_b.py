@@ -164,8 +164,8 @@ def _force_per_tile(monkeypatch):
     the multi-tile packing must reproduce exactly."""
     orig = streaming.plan_pass_b_sweeps
 
-    def per_tile(P_pad, Q, span, cap):
-        p = orig(P_pad, Q, span, cap)
+    def per_tile(P_pad, Q, span, cap, q_chunk=0):
+        p = orig(P_pad, Q, span, cap, q_chunk)
         return streaming.PassBPlan(p.q_chunk, p.p_blk, 1, p.tiles,
                                    tuple((t,) for t in p.tiles))
 
