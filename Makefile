@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast bench smoke multichip lint dev clean faultcheck nosleep perfcheck nofoldin obscheck noperf nostager ledgercheck noartifacts watchcheck costcheck nocost plancheck noknobs
+.PHONY: test test-fast bench smoke multichip lint dev clean faultcheck nosleep perfcheck nofoldin obscheck noperf nostager ledgercheck noartifacts watchcheck costcheck nocost plancheck noknobs kernelcheck nopallas
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -34,9 +34,41 @@ faultcheck: nosleep
 # bit-parity, partition-block chunking, guard-cliff boundaries) and
 # the pass-B sweep suite (planner invariants, multi-tile-vs-per-tile
 # bit-parity, hybrid prefix cache, pass-B fault drain).
-perfcheck: nosleep nofoldin nostager
+perfcheck: nosleep nofoldin nostager nopallas
 	$(PYTHON) -m pytest tests/test_ingest.py tests/test_faults.py \
 	  tests/test_walk.py tests/test_pass_b.py -q
+
+# Pallas-kernel acceptance suite: kernel-level bit-parity vs the XLA
+# scatter paths (including the lane-plan boundary widths in interpret
+# mode), the four-way pass-B parity (multi-tile XLA = per-tile =
+# unchunked = Pallas, single device + 8-device mesh — in
+# tests/test_pass_b.py), out-of-envelope + pallas-unavailable
+# fallbacks with their kernel.fallback events, kernel_backend knob
+# precedence (env > seam > plan > default), the interpret-mode CPU
+# roofline peak row, and the in-tree nopallas AST twin.
+kernelcheck: nopallas
+	$(PYTHON) -m pytest tests/test_kernels.py tests/test_pass_b.py -q
+
+# Lint-style check: pallas imports and pallas_call sites are confined
+# to pipelinedp_tpu/ops/kernels/ — every other module must dispatch
+# through the kernels package (kernel_backend knob -> select_backend),
+# so the fallback events, the envelope checks and the interpret-mode
+# story stay in ONE place. Docstring/comment mentions (backquoted or
+# #-prefixed) are ignored. (tests/test_kernels.py enforces the same
+# rule in-tree, AST-precise.)
+nopallas:
+	@bad=$$(grep -rnE "(from|import)[^#\"']*pallas|pallas_call *\(|[^a-zA-Z_.]pl\.|^pl\." \
+	  --include='*.py' pipelinedp_tpu bench.py \
+	  | grep -v "pipelinedp_tpu/ops/kernels/" \
+	  | grep -v '``' | grep -vE ':[0-9]+: *#' || true); \
+	if [ -n "$$bad" ]; then \
+	  echo "$$bad"; \
+	  echo "ERROR: pallas usage outside pipelinedp_tpu/ops/kernels/ —"; \
+	  echo "dispatch through pipelinedp_tpu.ops.kernels (the"; \
+	  echo "kernel_backend knob + select_backend fallback seam)"; \
+	  exit 1; \
+	fi; \
+	echo "nopallas: OK"
 
 # Observability acceptance suite: tracer thread-safety under a live
 # overlapped-ingest run, no-op-mode zero emission, bench-field parity

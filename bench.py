@@ -221,12 +221,26 @@ def plan_provenance():
     return dict(_PLAN_PROV)
 
 
+def kernel_backend_in_force():
+    """The resolved ``kernel_backend`` knob (env > seam > plan >
+    default), stamped on every bench record so ``--compare`` can
+    refuse to gate an ``xla`` rate against a ``pallas`` baseline (two
+    different device programs — a delta there is a backend
+    difference, not a regression)."""
+    try:
+        from pipelinedp_tpu import plan as plan_mod
+        return str(plan_mod.knob_value("kernel_backend"))
+    except Exception:
+        return "xla"
+
+
 def emit(rec):
-    """Log one record (with the env fingerprint and the plan
-    provenance merged) as JSON, and append it to the durable
-    run-ledger store keyed by the environment fingerprint."""
+    """Log one record (with the env fingerprint, the plan provenance
+    and the kernel backend merged) as JSON, and append it to the
+    durable run-ledger store keyed by the environment fingerprint."""
     rec["env"] = env_fingerprint()
     rec.update(plan_provenance())
+    rec.setdefault("kernel_backend", kernel_backend_in_force())
     log(json.dumps(rec))
     _RUN_RECORDS.append(rec)
     _bench_ledger().append(rec["metric"], {"record": rec})
@@ -673,6 +687,175 @@ def bench_streamed_percentile(n_rows):
                 os.environ[streaming_mod._CHUNK_ENV] = prev
 
 
+def bench_kernel_backend_compare(n_rows, smoke=False):
+    """One-process A/B of the ``kernel_backend`` knob: the
+    streamed-percentile workload (the pass-B multi-tile histogram
+    binner, under a shrunken cap so the packed path actually runs) and
+    the single-batch fused-aggregate workload (the lane-packed
+    segment sum) each run warm under ``xla`` and ``pallas`` on the
+    SAME data, with DP outputs cross-checked bit-for-bit. The record
+    embeds both backends' rates and the per-phase ``device_costs``
+    roofline verdicts (the cost observatory is force-enabled for the
+    record's duration), so one artifact answers "did the hand-tiled
+    kernels win here, and were they still bandwidth-bound". On the
+    CPU proxy the Pallas interpret path is expected to LOSE — that is
+    exactly the measurement the autotune sweep uses to self-select
+    ``xla`` off-TPU."""
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu import plan as plan_mod
+    from pipelinedp_tpu import jax_engine as je
+    from pipelinedp_tpu import streaming as streaming_mod
+    from pipelinedp_tpu.obs import costs as obs_costs
+    from pipelinedp_tpu.backends import JaxBackend
+
+    rng = np.random.default_rng(19)
+    parts = 60 if smoke else 600
+    ds_q = pdp.ArrayDataset(
+        privacy_ids=rng.integers(0, 1 << 16, n_rows).astype(np.int32),
+        partition_keys=(rng.zipf(1.3, n_rows) % parts).astype(np.int32),
+        values=rng.uniform(0.0, 10.0, n_rows).astype(np.float32))
+    params_q = pdp.AggregateParams(
+        metrics=[pdp.Metrics.PERCENTILE(50), pdp.Metrics.PERCENTILE(90),
+                 pdp.Metrics.PERCENTILE(99)],
+        noise_kind=pdp.NoiseKind.LAPLACE,
+        max_partitions_contributed=4, max_contributions_per_partition=2,
+        min_value=0.0, max_value=10.0)
+    ds_f = pdp.ArrayDataset(
+        privacy_ids=rng.integers(0, 1 << 16, n_rows).astype(np.int32),
+        partition_keys=(rng.zipf(1.3, n_rows) % parts).astype(np.int32),
+        values=rng.uniform(0.0, 10.0, n_rows).astype(np.float32))
+    params_f = pdp.AggregateParams(
+        metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM, pdp.Metrics.MEAN,
+                 pdp.Metrics.VARIANCE],
+        noise_kind=pdp.NoiseKind.LAPLACE,
+        max_partitions_contributed=4, max_contributions_per_partition=2,
+        min_value=0.0, max_value=10.0)
+
+    def run_streamed(ds):
+        ds.invalidate_cache()
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                        total_delta=1e-6)
+        engine = pdp.DPEngine(acc, JaxBackend(rng_seed=0))
+        result = engine.aggregate(ds, params_q, pdp.DataExtractors(),
+                                  public_partitions=list(range(parts)))
+        acc.compute_budgets()
+        with tracer().span("bench.kb_streamed", cat="bench") as sp:
+            out = dict(result)
+        return out, sp.duration
+
+    def run_fused(ds):
+        # Single-batch on purpose: this leg measures the engine's
+        # fused_aggregate_kernel (the lane-packed segment sum's
+        # "engine" phase), not the streamed path — lift the record's
+        # chunk pin for its duration.
+        chunk = os.environ.pop(streaming_mod._CHUNK_ENV, None)
+        try:
+            ds.invalidate_cache()
+            n, dt, _ = run_once(JaxBackend(rng_seed=0), ds, params_f)
+        finally:
+            if chunk is not None:
+                os.environ[streaming_mod._CHUNK_ENV] = chunk
+        return n, dt
+
+    _, _, _, span = streaming_mod._tree_consts()
+    P_pad = je._pad_pow2(parts)
+    # Budget for 5/8 of one [P_pad, 1, span] block: the sweep planner
+    # must tile AND pack, so the multi-tile kernels (the Pallas
+    # binner's dispatch point) run under BOTH backends.
+    cap = max(4, (5 * P_pad) // 8) * span * 4
+    prev_chunk = os.environ.get(streaming_mod._CHUNK_ENV)
+    prev_costs = os.environ.get(obs_costs.ENV_VAR)
+    os.environ[streaming_mod._CHUNK_ENV] = str(max(n_rows // 6, 1000))
+    os.environ[obs_costs.ENV_VAR] = "1"
+    sides = {}
+    outputs = {}
+    # The per-backend phase aggregates need a clean table per side,
+    # but the table is PROCESS-global: every program entry captured by
+    # the earlier bench configs (the PR 8 device_costs artifact) must
+    # survive this record, so save everything and restore at the end.
+    captured_programs = dict(obs_costs.TABLE.snapshot()["programs"])
+    from pipelinedp_tpu.plan import knobs as plan_knobs
+    spec = plan_knobs.BY_NAME["kernel_backend"]
+    prev_backend = os.environ.get(spec.env_var)
+    try:
+        for backend in ("xla", "pallas"):
+            # Pin each leg via the ENV override — the top of the
+            # precedence chain. A seam set to the registry default
+            # ("xla") is indistinguishable from "no override" and
+            # would fall through to a plan file that may select
+            # pallas, running BOTH legs on the same backend (the same
+            # trap run_autotune's sweep isolation guards against).
+            os.environ[spec.env_var] = backend
+            with plan_mod.seam_override("subhist_byte_cap", cap):
+                obs_costs.TABLE.reset()
+                run_streamed(ds_q)          # warm (compile + capture)
+                out_q, dt_q = run_streamed(ds_q)
+                run_fused(ds_f)             # warm
+                _, dt_f = run_fused(ds_f)
+                snap = obs_costs.TABLE.snapshot()
+                captured_programs.update(snap["programs"])
+                phases = snap["phases"]
+                sides[backend] = {
+                    "streamed_percentile_rows_per_s": round(
+                        n_rows / dt_q),
+                    "streamed_s": round(dt_q, 3),
+                    "fused_aggregate_rows_per_s": round(n_rows / dt_f),
+                    "fused_s": round(dt_f, 3),
+                    "device_costs": {
+                        ph: {"verdict": agg.get("verdict"),
+                             "intensity": agg.get("intensity")}
+                        for ph, agg in sorted(phases.items())
+                        if ph in ("engine", "pass_a", "pass_b")},
+                }
+                outputs[backend] = out_q
+    finally:
+        for var, prev in ((streaming_mod._CHUNK_ENV, prev_chunk),
+                          (obs_costs.ENV_VAR, prev_costs),
+                          (spec.env_var, prev_backend)):
+            if prev is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = prev
+        # Restore the run-wide cost table (earlier configs' programs +
+        # both backends' captures from this record) for the final run
+        # report — the A/B resets must not erase run knowledge.
+        obs_costs.TABLE.reset()
+        for key, entry in captured_programs.items():
+            obs_costs.TABLE.record(key, entry)
+    fields = ("percentile_50", "percentile_90", "percentile_99")
+    parity = all(
+        getattr(outputs["pallas"][p], f) == getattr(outputs["xla"][p], f)
+        for p in range(parts) for f in fields)
+    if not parity:
+        log("## KERNEL BACKEND PARITY MISMATCH (pallas vs xla)")
+    rec = {
+        "metric": "kernel_backend_compare",
+        "rows": n_rows,
+        "partitions": parts,
+        "subhist_cap_bytes": cap,
+        "backends": sides,
+        "pallas_vs_xla_streamed": round(
+            sides["pallas"]["streamed_percentile_rows_per_s"] /
+            max(sides["xla"]["streamed_percentile_rows_per_s"], 1), 3),
+        "pallas_vs_xla_fused": round(
+            sides["pallas"]["fused_aggregate_rows_per_s"] /
+            max(sides["xla"]["fused_aggregate_rows_per_s"], 1), 3),
+        "parity": "ok" if parity else "MISMATCH",
+        # This record ran BOTH backends; the stamp must not claim one.
+        "kernel_backend": "both",
+    }
+    log(f"## kernel_backend compare [{n_rows} rows x {parts} parts]: "
+        f"streamed xla "
+        f"{sides['xla']['streamed_percentile_rows_per_s']} vs pallas "
+        f"{sides['pallas']['streamed_percentile_rows_per_s']} rows/s "
+        f"({rec['pallas_vs_xla_streamed']}x); fused xla "
+        f"{sides['xla']['fused_aggregate_rows_per_s']} vs pallas "
+        f"{sides['pallas']['fused_aggregate_rows_per_s']} rows/s "
+        f"({rec['pallas_vs_xla_fused']}x); parity {rec['parity']}")
+    emit(rec)
+    return rec
+
+
 def run_autotune(args):
     """``bench.py --autotune``: the bounded knob sweep that closes the
     measure→decide loop. Runs the streamed-percentile workload once per
@@ -756,7 +939,10 @@ def run_autotune(args):
         acc.compute_budgets()
         with plan_mod.seam_override("subhist_byte_cap",
                                     vec["subhist_byte_cap"]), \
-                plan_mod.seam_override("q_chunk", vec["q_chunk"]):
+                plan_mod.seam_override("q_chunk", vec["q_chunk"]), \
+                plan_mod.seam_override("kernel_backend",
+                                       vec.get("kernel_backend",
+                                               "xla")):
             with tracer().span("autotune.trial", cat="autotune") as sp:
                 dict(result)
         return sp.duration, result.timings or {}
@@ -1109,7 +1295,9 @@ def compare_to_baseline(records=None, run_report=None, threshold=0.10):
     rates, spans, regressed = [], [], []
     skipped_degraded = 0
     plan_mismatches = 0
+    backend_mismatches = 0
     cur_plan = plan_provenance()
+    cur_backend = kernel_backend_in_force()
     # One comparison per metric, at its BEST value this run — the same
     # best-sample rule the headline applies (the flagship re-sample
     # emits the metric twice; a slow-window sample must not fail a gate
@@ -1182,6 +1370,27 @@ def compare_to_baseline(records=None, run_report=None, threshold=0.10):
                 f"{cur_source}/{cur_hash}) — not gated")
             rates.append(entry)
             continue
+        # Kernel-backend gate (the plan_hash refusal's twin): an xla
+        # rate gated against a pallas baseline (or vice versa)
+        # compares two different device programs. Absent fields on
+        # old records read as "xla" (the pre-knob behavior), so
+        # xla-vs-old keeps gating exactly as before.
+        base_backend = base_rec.get("kernel_backend", "xla")
+        rec_backend = rec.get("kernel_backend", cur_backend)
+        if base_backend != rec_backend:
+            backend_mismatches += 1
+            entry["kernel_backend_mismatch"] = True
+            entry["baseline_kernel_backend"] = base_backend
+            obs.inc("bench.compare_kernel_backend_mismatch")
+            obs.event("bench.compare_kernel_backend_mismatch",
+                      metric=rec["metric"],
+                      baseline_backend=base_backend,
+                      current_backend=rec_backend)
+            log(f"## compare: kernel-backend mismatch on "
+                f"{rec['metric']} (baseline {base_backend}, this run "
+                f"{rec_backend}) — not gated")
+            rates.append(entry)
+            continue
         if value < (1.0 - threshold) * base_val:
             entry["regressed"] = True
             regressed.append(rec["metric"])
@@ -1205,6 +1414,8 @@ def compare_to_baseline(records=None, run_report=None, threshold=0.10):
             "rates": rates, "spans": spans,
             "skipped_degraded_baselines": skipped_degraded,
             "plan_mismatches": plan_mismatches,
+            "kernel_backend_mismatches": backend_mismatches,
+            "kernel_backend": cur_backend,
             "plan": cur_plan,
             "regressed": regressed}
 
@@ -1226,9 +1437,17 @@ def compare_verdict_line(regressions):
                 f"knobs (plan {plan.get('plan_hash')}) against a "
                 "baseline from a different knob plan; re-baseline "
                 "with matching plans before gating")
+    if regressions.get("kernel_backend_mismatches"):
+        return (f"COMPARE: kernel-backend mismatch — "
+                f"{regressions['kernel_backend_mismatches']} rate(s) "
+                f"not gated: this run ran kernel_backend="
+                f"{regressions.get('kernel_backend')} against a "
+                "baseline from the other backend; re-baseline with "
+                "matching backends before gating")
     n_based = sum(1 for r in regressions["rates"]
                   if r.get("baseline") is not None and
-                  not r.get("plan_mismatch"))
+                  not r.get("plan_mismatch") and
+                  not r.get("kernel_backend_mismatch"))
     if n_based == 0:
         # Nothing was actually gated — say so, instead of an "on pace"
         # that reads as a passing verdict on a first run or a fresh
@@ -1440,6 +1659,12 @@ def main():
         # round-count collapse too).
         bench_streamed_percentile(60_000 if args.smoke else 2_000_000)
 
+        # The kernel-backend A/B: both hot-path workloads, warm, same
+        # data, xla vs pallas, with per-phase roofline verdicts and a
+        # bit-parity cross-check in one record.
+        bench_kernel_backend_compare(30_000 if args.smoke else 500_000,
+                                     smoke=args.smoke)
+
         # Config 5: the analysis epsilon-sweep.
         bench_analysis_sweep(a_rows, max(1000, a_rows // 25),
                              1_000 if not args.smoke else 100, a_configs)
@@ -1486,7 +1711,8 @@ def main():
     from pipelinedp_tpu import obs
     headline = {k: flagship[k] for k in
                 ("metric", "value", "unit", "vs_baseline",
-                 "host_s", "device_s") if k in flagship}
+                 "host_s", "device_s", "kernel_backend")
+                if k in flagship}
     headline["degraded"] = bool(health_report.degraded)
     # Plan provenance on the artifact of record: which knob plan
     # produced this rate (autotuned / env-override / default + the

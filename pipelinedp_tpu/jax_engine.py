@@ -638,11 +638,13 @@ def encode(rows, data_extractors, vector_size: Optional[int],
 
 @instrumented_jit(phase="engine", static_argnames=("config",
                                                    "num_partitions",
-                                                   "fx_bits"))
+                                                   "fx_bits",
+                                                   "kernel_backend"))
 def fused_aggregate_kernel(config: FusedConfig, num_partitions: int, pid,
                            pk, values, valid, noise_scales, keep_table,
                            sel_threshold, sel_scale, sel_min_count,
-                           sel_rows_per_uid, key, fx_bits: int = 7):
+                           sel_rows_per_uid, key, fx_bits: int = 7,
+                           kernel_backend: str = "xla"):
     """One compiled program for the whole aggregation. See module docstring.
 
     Runtime inputs:
@@ -656,7 +658,8 @@ def fused_aggregate_kernel(config: FusedConfig, num_partitions: int, pid,
     """
     k_bound, k_sel, k_noise = jax.random.split(key, 3)
     part, part_nseg, qrows = _partials(config, num_partitions, pid, pk,
-                                       values, valid, k_bound, fx_bits)
+                                       values, valid, k_bound, fx_bits,
+                                       kernel_backend=kernel_backend)
     return _selection_and_metrics(config, num_partitions, part, part_nseg,
                                   noise_scales, keep_table, sel_threshold,
                                   sel_scale, sel_min_count,
@@ -665,7 +668,8 @@ def fused_aggregate_kernel(config: FusedConfig, num_partitions: int, pid,
 
 
 def _partials(config: FusedConfig, num_partitions: int, pid, pk, values,
-              valid, key, fx_bits: int = 7):
+              valid, key, fx_bits: int = 7,
+              kernel_backend: str = "xla"):
     """Contribution bounding + per-pk accumulator partials. Shardable by
     privacy id: every pid's rows must live in one shard, pks may be
     spread — partials then combine across shards by plain addition
@@ -703,7 +707,8 @@ def _partials(config: FusedConfig, num_partitions: int, pid, pk, values,
         qrows = (_qrows(config, pk_safe, values, row_keep)
                  if config.percentiles else None)
         part, _ = _reduce_per_pk(config, pk_safe, masked, row_keep, masked,
-                                 P, fx_bits=fx_bits)
+                                 P, fx_bits=fx_bits,
+                                 kernel_backend=kernel_backend)
         # Without pids every row counts as its own privacy unit
         # (reference dp_engine.py:341-348 works off row counts).
         part_nseg = part["count"]
@@ -788,11 +793,13 @@ def _partials(config: FusedConfig, num_partitions: int, pid, pk, values,
                      config.max_sum_per_partition), 0.0)
         part, part_nseg = _reduce_per_pk(config, pk_safe, masked, keep_row,
                                          contrib, P, seg_marker=seg_marker,
-                                         fx_bits=fx_bits)
+                                         fx_bits=fx_bits,
+                                         kernel_backend=kernel_backend)
     else:
         part, part_nseg = _reduce_per_pk(config, pk_safe, masked, keep_row,
                                          None, P, seg_marker=seg_marker,
-                                         fx_bits=fx_bits)
+                                         fx_bits=fx_bits,
+                                         kernel_backend=kernel_backend)
 
     qrows = (_qrows(config, spk, svalues, keep_row)
              if config.percentiles else None)
@@ -873,7 +880,7 @@ def _fixedpoint_layout(config: FusedConfig) -> List[_FxSpec]:
 
 def _reduce_per_pk(config: FusedConfig, pk_safe, masked, keep_row,
                    per_partition_sum_contrib, P, seg_marker=None,
-                   fx_bits: int = 7):
+                   fx_bits: int = 7, kernel_backend: str = "xla"):
     """The fused shuffle 3: per-pk accumulator columns straight from row
     space, returned as (columns dict, privacy-id-count column).
 
@@ -959,8 +966,17 @@ def _reduce_per_pk(config: FusedConfig, pk_safe, masked, keep_row,
                 for c in int_cols]
     else:
         # One multi-feature scatter: the addressing pass is shared.
-        stacked = jax.ops.segment_sum(jnp.stack(int_cols, axis=1),
-                                      pk_safe, num_segments=P)
+        # The ``kernel_backend`` knob swaps in the Pallas lane-packed
+        # segment sum here (bit-identical int32 totals — PARITY row
+        # 33); off-envelope shapes or a Pallas-less host fall back to
+        # the XLA scatter with a ``kernel.fallback`` event.
+        from pipelinedp_tpu.ops import kernels as hot_kernels
+        stack = jnp.stack(int_cols, axis=1)
+        stacked = hot_kernels.try_segment_sum_lanes(
+            stack, pk_safe, P, kernel_backend)
+        if stacked is None:
+            stacked = jax.ops.segment_sum(stack, pk_safe,
+                                          num_segments=P)
         ints = [stacked[:, i] for i in range(len(int_cols))]
     part = {"count": ints[0]}
     col = 1
@@ -1521,7 +1537,7 @@ def _subtree_counts(qpk, leaf, kept, sub_start, P, span, p_offset=None):
 
 
 def _subtree_counts_multi(qpk, leaf, kept, sub_starts, p_offsets, Pb,
-                          span):
+                          span, kernel_backend: str = "xla"):
     """Several tiles' subtree-leaf counts from ONE pass over the rows:
     ``sub_starts`` is [T, Pb, Qc] (each tile's walk-start leaves),
     ``p_offsets`` [T] (each tile's first global partition), output
@@ -1529,7 +1545,18 @@ def _subtree_counts_multi(qpk, leaf, kept, sub_starts, p_offsets, Pb,
     one batch recompute (bounding + leaf mapping) serves every tile the
     sweep planner packed into the round — per tile it is EXACTLY
     ``_subtree_counts`` on the same rows, so the packed result is
-    bit-identical to the per-tile loop by construction."""
+    bit-identical to the per-tile loop by construction.
+
+    ``kernel_backend`` (the dp-safe knob, resolved by the caller OUTSIDE
+    jit so a backend switch re-traces) selects the Pallas multi-tile
+    binner — bit-identical integers (PARITY row 33) — with automatic
+    XLA fallback (``kernel.fallback``) off-envelope or sans Pallas."""
+    from pipelinedp_tpu.ops import kernels as hot_kernels
+    binned = hot_kernels.try_hist_bin_multi(
+        qpk, leaf, kept, sub_starts, p_offsets, Pb, span,
+        kernel_backend)
+    if binned is not None:
+        return binned
     return jnp.stack([
         _subtree_counts(qpk, leaf, kept, sub_starts[t], Pb, span,
                         p_offset=p_offsets[t])
@@ -2304,7 +2331,26 @@ def _run_fused_kernel(config: FusedConfig, encoded: EncodedData, scales,
         fx_bits, _ = _fx_plan(max(encoded.n_rows, 1))
     else:
         fx_bits = 12
+    # The kernel-backend knob resolves HERE, outside jit, and rides in
+    # as a static argument: jit caches by signature, so an env/seam/
+    # plan switch between calls re-traces instead of silently reusing
+    # the other backend's program (and the cost observatory's table
+    # keys the two signatures apart for before/after verdicts).
+    from pipelinedp_tpu import plan as plan_mod
+    kernel_backend = str(plan_mod.knob_value("kernel_backend"))
     from pipelinedp_tpu import obs
+    if kernel_backend == "pallas" and config.percentiles:
+        # The single-batch quantile walk builds its subtree counts
+        # through the compacted/block-chunked ``_subtree_counts``
+        # paths, which have no Pallas twin (only streamed pass B's
+        # multi-tile binner does) — say so, out loud: a requested
+        # backend silently not running is the one thing the knob must
+        # never do. The fused per-pk reduction in this same program
+        # still dispatches Pallas.
+        obs.inc("kernel.fallbacks")
+        obs.event("kernel.fallback", site="walk_subtree_counts",
+                  reason="single_batch_walk",
+                  percentiles=len(config.percentiles))
     if mesh is not None:
         from pipelinedp_tpu.parallel import sharded_fused_aggregate
         with obs.device_annotation("pdp.sharded_fused_aggregate"):
@@ -2312,7 +2358,8 @@ def _run_fused_kernel(config: FusedConfig, encoded: EncodedData, scales,
                 mesh, config, P_pad, encoded.pid, encoded.pk,
                 encoded.values if config.needs_values else None,
                 np.ones(encoded.n_rows, bool), scales, keep_table, thr,
-                s_scale, min_count, rows_per_uid, key, fx_bits)
+                s_scale, min_count, rows_per_uid, key, fx_bits,
+                kernel_backend=kernel_backend)
         return keep_pk, raw, fx_bits
     pid, pk, values, valid = pad_and_put(encoded, config.vector_size,
                                          with_values=config.needs_values)
@@ -2321,7 +2368,8 @@ def _run_fused_kernel(config: FusedConfig, encoded: EncodedData, scales,
             config, P_pad, pid, pk, values, valid, jnp.asarray(scales),
             jnp.asarray(keep_table), jnp.float32(thr),
             jnp.float32(s_scale), jnp.float32(min_count),
-            jnp.float32(rows_per_uid), key, fx_bits=fx_bits)
+            jnp.float32(rows_per_uid), key, fx_bits=fx_bits,
+            kernel_backend=kernel_backend)
     return keep_pk, raw, fx_bits
 
 

@@ -1,0 +1,173 @@
+"""Kernel-backend dispatch: availability probe, envelopes, fallback.
+
+The ``kernel_backend`` knob selects between ``xla`` (the generic
+sort/scatter lowering — today's behavior, the default) and ``pallas``
+(the hand-tiled kernels in this package). Because the Pallas kernels
+keep their whole output resident in VMEM, they only run inside a
+shape ENVELOPE; a requested-but-infeasible dispatch degrades to XLA
+with a ``kernel.fallback`` obs event so the run report shows the
+actual path taken. All decisions here happen at jit-TRACE time — the
+shapes are static — so a warm program never re-pays them.
+
+This module holds no jax-at-import dependency beyond what the ops
+package already has, and no pallas import at all: the pallas modules
+import lazily at first dispatch, so a host without Pallas support
+still imports the library and falls back cleanly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+KNOWN_BACKENDS = ("xla", "pallas")
+
+#: The ``kernel_backend`` knob's module seam (plan/knobs.py registers
+#: it): tests and bench force a backend via ``plan.seam_override``;
+#: reads flow through the knob registry, never this name directly.
+_KERNEL_BACKEND = "xla"
+
+#: VMEM byte budget for a kernel's resident output block. 16 MB/core
+#: minus double-buffered input blocks and one-hot operands leaves a
+#: comfortable 4 MB; larger pass-B packings fall back to XLA (which
+#: the sweep planner already sized to the HBM cap, not VMEM).
+_OUT_BYTES_CAP = 4 << 20
+
+#: One-hot operand byte budget per row block (the [Pb+span, R] or
+#: [P, R] compare planes) — bounds the row-block width choice below.
+_ONEHOT_BYTES_CAP = 4 << 20
+
+#: Unrolled (tile x quantile-group) loop bound for the histogram
+#: binner: each (t, q) pair emits two MXU contractions per row block,
+#: and unrolling hundreds of them would bloat the program.
+_HIST_UNROLL_CAP = 64
+
+#: Lane-packed segment sum envelope: the [P, C] accumulator (and the
+#: [P, R] one-hot) must be VMEM-resident in ONE partition block —
+#: tiling P would re-stream the whole row axis once per block.
+_SEGSUM_MAX_P = 8192
+_SEGSUM_MAX_COLS = 32
+
+#: Row-block candidates, widest first. Exactness bound: every f32
+#: partial sum in the kernels is at most R * (2^12 - 1) < 2^24 at
+#: R <= 512, so integer accumulation through the f32 MXU stays exact.
+_ROW_BLOCKS = (512, 256, 128)
+
+#: Test seam: force ``pallas_available()`` to answer False, exercising
+#: the unavailability fallback without uninstalling anything.
+_FORCE_UNAVAILABLE = False
+
+_available: Optional[bool] = None
+
+
+def pallas_available() -> bool:
+    """Whether this jax build exposes the Pallas API (cached probe).
+    A host without it — older jax, stripped builds — dispatches every
+    request to XLA with a ``kernel.fallback`` event."""
+    global _available
+    if _FORCE_UNAVAILABLE:
+        return False
+    if _available is None:
+        try:
+            from jax.experimental import pallas  # noqa: F401
+            _available = True
+        except Exception:
+            _available = False
+    return _available
+
+
+def use_interpret() -> bool:
+    """Pallas interpret mode everywhere but a real TPU: the kernels
+    then lower to plain jax ops (bit-identical arithmetic), so the
+    CPU proxy and tier-1 CI assert the same parity the TPU path
+    claims."""
+    import jax
+    return jax.default_backend() != "tpu"
+
+
+def _row_block(per_row_bytes: int) -> Optional[int]:
+    """Widest row block whose one-hot operands fit the budget, or None
+    when even the narrowest block overflows (out of envelope)."""
+    for r in _ROW_BLOCKS:
+        if r * per_row_bytes <= _ONEHOT_BYTES_CAP:
+            return r
+    return None
+
+
+def hist_envelope(T: int, Pb: int, Qc: int, span: int) -> Optional[int]:
+    """Row-block width for an in-envelope ``[T, Pb, Qc, span]``
+    histogram request, or None when the shape falls outside the tiled
+    envelope (output not VMEM-resident, one-hots too wide, or the
+    (t, q) unroll too deep)."""
+    if T * Pb * Qc * span * 4 > _OUT_BYTES_CAP:
+        return None
+    if T * Qc > _HIST_UNROLL_CAP:
+        return None
+    return _row_block((Pb + span) * 4)
+
+
+def segsum_envelope(P: int, C: int) -> Optional[int]:
+    """Row-block width for an in-envelope ``[P, C]`` lane segment-sum
+    request, or None when out of envelope."""
+    if P > _SEGSUM_MAX_P or C > _SEGSUM_MAX_COLS or C < 1:
+        return None
+    if P * C * 4 > _OUT_BYTES_CAP:
+        return None
+    return _row_block(P * 4)
+
+
+def select_backend(requested: str, site: str,
+                   row_block: Optional[int], **shape) -> str:
+    """The one fallback decision: ``pallas`` only when requested,
+    available AND in-envelope; anything else resolves to ``xla``. A
+    degraded pallas request emits ``kernel.fallback`` (+ counter) so a
+    changed path is visible in the run report, never silent. Runs at
+    trace time — one event per compiled program, not per call."""
+    if requested != "pallas":
+        return "xla"
+    from pipelinedp_tpu import obs
+    if not pallas_available():
+        obs.inc("kernel.fallbacks")
+        obs.event("kernel.fallback", site=site,
+                  reason="pallas_unavailable", **shape)
+        return "xla"
+    if row_block is None:
+        obs.inc("kernel.fallbacks")
+        obs.event("kernel.fallback", site=site,
+                  reason="out_of_envelope", **shape)
+        return "xla"
+    obs.inc("kernel.pallas_dispatches")
+    return "pallas"
+
+
+def try_segment_sum_lanes(cols, pk, P: int, requested: str):
+    """The ONE dispatch seam for the lane-packed segment sum: the
+    Pallas result when ``requested`` resolves to an in-envelope pallas
+    dispatch, else None (after the ``kernel.fallback`` event) — the
+    caller then runs its XLA path. Keeps the envelope/fallback/
+    interpret logic out of the call sites."""
+    if requested != "pallas":
+        return None
+    C = int(cols.shape[1])
+    rb = segsum_envelope(P, C)
+    if select_backend(requested, "segment_sum_lanes", rb, P=int(P),
+                      C=C, rows=int(pk.shape[0])) != "pallas":
+        return None
+    from pipelinedp_tpu.ops.kernels.segsum import segment_sum_lanes
+    return segment_sum_lanes(cols, pk, P, rb, use_interpret())
+
+
+def try_hist_bin_multi(qpk, leaf, kept, sub_starts, p_offsets, Pb: int,
+                       span: int, requested: str):
+    """Dispatch seam for the multi-tile histogram binner — same
+    contract as :func:`try_segment_sum_lanes`."""
+    if requested != "pallas":
+        return None
+    T, _, Qc = sub_starts.shape
+    rb = hist_envelope(int(T), int(Pb), int(Qc), int(span))
+    if select_backend(requested, "hist_bin_multi", rb, T=int(T),
+                      Pb=int(Pb), Qc=int(Qc),
+                      span=int(span)) != "pallas":
+        return None
+    from pipelinedp_tpu.ops.kernels.hist import hist_bin_multi
+    return hist_bin_multi(qpk, leaf, kept, sub_starts, p_offsets, Pb,
+                          span, rb, use_interpret())

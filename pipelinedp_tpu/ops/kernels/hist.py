@@ -1,0 +1,157 @@
+"""The multi-tile pass-B histogram binner, as a Pallas TPU kernel.
+
+One batch's rows bin into EVERY packed ``[T, Pb, Qc, span]`` pass-B
+tile histogram in a single VMEM-resident pass — the Pallas twin of
+``jax_engine._subtree_counts_multi`` (one masked XLA scatter per
+(tile, quantile), T*Qc row passes in the generic lowering).
+
+Scatter-free formulation: for tile ``t`` and quantile-group column
+``q``, the count of bin ``(p, s)`` is::
+
+    #rows{ qpk - p_offsets[t] == p  AND
+           leaf - sub_starts[t, p, q] == s  AND kept }
+
+which is the matmul ``onehot_p^T @ onehot_s`` over a row block, where
+``onehot_p[p, r] = (qpk[r] - p_offsets[t] == p) & kept[r]`` and
+``onehot_s[s, r] = (leaf[r] - start_row[r] == s)``. The per-row walk
+start gathers through the SAME one-hot as a matvec
+(``sub_starts[t, :, q] @ onehot_p``, exact — one nonzero per row), so
+the kernel needs no gather, no scatter and no sort: two MXU
+contractions per (t, q) per row block, with the whole [T, Pb, Qc,
+span] output resident in VMEM across the row grid.
+
+Bit-identity: every product is 0/1 (or a single leaf index < 2^16),
+every per-block partial sum is at most the row-block width (<= 512 <
+2^24), so the f32 MXU arithmetic is exact integer arithmetic and the
+int32 accumulator equals the XLA scatter path bit for bit — asserted
+four ways in ``tests/test_pass_b.py`` and at the kernel level in
+``tests/test_kernels.py``. The tile-relative partition index is
+computed in INT32 (``qpk - p_offsets[t]``) before the one f32 cast:
+any int32 magnitude below 2^24 casts exactly, and anything at or past
+2^24 casts to a float of at least that magnitude — which can never
+equal an iota value below ``Pb`` — so the membership compare is
+correct for EVERY int32 partition id, not just ids below 2^24.
+
+Rows out of a tile's partition block, rows outside [0, span) of a
+walk start and padding rows all match no one-hot column: masking is
+free and identical to the XLA path's ``ok`` predicate.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from pipelinedp_tpu.obs.costs import instrumented_jit
+
+
+def _compiler_params(interpret: bool):
+    """Mosaic params for the compiled path: the row grid accumulates
+    into a revisited output block, so its dimension is 'arbitrary'
+    (never parallelized). Interpret mode takes none."""
+    if interpret:
+        return {}
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "TPUCompilerParams", None) or getattr(
+        pltpu, "CompilerParams", None)
+    if cls is None:
+        return {}
+    try:
+        return {"compiler_params": cls(
+            dimension_semantics=("arbitrary",))}
+    except TypeError:
+        return {"compiler_params": cls()}
+
+
+def _hist_kernel_body(T: int, Qc: int):
+    """The kernel body for a static (T, Qc) — python loops unroll the
+    (tile, quantile-group) grid (bounded by the dispatch envelope)."""
+
+    def body(qpk_ref, leaf_ref, kept_ref, starts_ref, poff_ref,
+             out_ref):
+        from jax.experimental import pallas as pl
+        _, Pb, _, span = out_ref.shape
+        R = qpk_ref.shape[1]
+
+        @pl.when(pl.program_id(0) == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+
+        qpk = qpk_ref[0, :]                           # [R] int32
+        leaf = leaf_ref[0, :].astype(jnp.float32)     # < 2^16: exact
+        kept = kept_ref[0, :] != 0
+        iota_p = jax.lax.broadcasted_iota(jnp.float32, (Pb, R), 0)
+        iota_s = jax.lax.broadcasted_iota(jnp.float32, (span, R), 0)
+        for t in range(T):
+            # int32 subtract FIRST (see module docstring): the f32
+            # cast of the small relative index is then exact for any
+            # int32 partition id / offset.
+            rel_pk = (qpk - poff_ref[t, 0]).astype(jnp.float32)
+            oh_p = jnp.where(
+                (rel_pk[None, :] == iota_p) & kept[None, :],
+                1.0, 0.0)                              # [Pb, R]
+            for q in range(Qc):
+                starts = starts_ref[t, :, q].astype(jnp.float32)
+                # Gather-as-matvec: one nonzero per row -> exact.
+                start_row = jax.lax.dot_general(
+                    starts[None, :], oh_p,
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)[0]  # [R]
+                rel = leaf - start_row
+                oh_s = jnp.where(rel[None, :] == iota_s, 1.0, 0.0)
+                part = jax.lax.dot_general(
+                    oh_p, oh_s,
+                    dimension_numbers=(((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)  # [Pb, span]
+                out_ref[t, :, q, :] += part.astype(jnp.int32)
+
+    return body
+
+
+def hist_bin_multi(qpk, leaf, kept, sub_starts, p_offsets, Pb: int,
+                   span: int, row_block: int, interpret: bool):
+    """Pallas multi-tile subtree-leaf counts: same contract as
+    ``jax_engine._subtree_counts_multi`` — ``sub_starts`` [T, Pb, Qc],
+    ``p_offsets`` [T], output [T, Pb, Qc, span] int32, bit-identical
+    to the per-tile XLA scatters. ``row_block`` comes from
+    ``dispatch.hist_envelope`` (callers dispatch through
+    ``select_backend``; this function assumes in-envelope shapes)."""
+    from jax.experimental import pallas as pl
+    T, _, Qc = sub_starts.shape
+    n = qpk.shape[0]
+    n_pad = -(-n // row_block) * row_block
+    pad = n_pad - n
+    # Padding rows carry kept=0 and match no one-hot column.
+    qpk2 = jnp.pad(qpk, (0, pad)).reshape(-1, row_block)
+    leaf2 = jnp.pad(leaf, (0, pad)).reshape(-1, row_block)
+    kept2 = jnp.pad(kept.astype(jnp.int32), (0, pad)).reshape(
+        -1, row_block)
+    poff = p_offsets.astype(jnp.int32).reshape(T, 1)
+    return pl.pallas_call(
+        _hist_kernel_body(T, Qc),
+        grid=(n_pad // row_block,),
+        in_specs=[
+            pl.BlockSpec((1, row_block), lambda i: (i, 0)),
+            pl.BlockSpec((1, row_block), lambda i: (i, 0)),
+            pl.BlockSpec((1, row_block), lambda i: (i, 0)),
+            pl.BlockSpec((T, Pb, Qc), lambda i: (0, 0, 0)),
+            pl.BlockSpec((T, 1), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((T, Pb, Qc, span),
+                               lambda i: (0, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((T, Pb, Qc, span), jnp.int32),
+        interpret=interpret,
+        **_compiler_params(interpret),
+    )(qpk2, leaf2, kept2, sub_starts, poff)
+
+
+#: Standalone instrumented entry (phase ``pass_b``): direct host
+#: callers — the bench's backend-compare record, kernel microbenches —
+#: compile through the device-cost observatory, so the run report's
+#: ``device_costs`` section carries the kernel's own roofline verdict.
+#: (Inside the streamed pass-B programs the kernel inlines into the
+#: already-instrumented ``_pct_multi_sub_kernel`` trace, where the
+#: ``kernel_backend`` static argument keys the before/after entries.)
+hist_bin_multi_program = instrumented_jit(
+    phase="pass_b", static_argnames=("Pb", "span", "row_block",
+                                     "interpret"))(hist_bin_multi)

@@ -1,0 +1,87 @@
+"""The fused lane-packed segment sum, as a Pallas TPU kernel.
+
+``jax_engine._reduce_per_pk`` accumulates every scalar metric column
+in 24-bit fixed-point integer lanes and reduces them per partition in
+ONE multi-feature ``jax.ops.segment_sum`` over the ``[N, C]`` stack
+(jax_engine.py's "one wide scatter"). XLA lowers that to a generic
+sorted scatter; this kernel replaces it with an MXU contraction that
+keeps the lanes in registers/VMEM across the whole reduction::
+
+    out[p, c] = sum_r (pk[r] == p) * cols[r, c]
+              = (onehot_pk^T @ cols)[p, c]
+
+with the ``[P, C]`` accumulator VMEM-resident across row blocks.
+
+Bit-identity: lane values are at most ``2^12 - 1`` (the widest lane
+plan) and count/marker columns are 0/1, so with row blocks of at most
+512 rows every f32 partial sum is below ``512 * 4095 < 2^21 < 2^24``
+— exact f32 integer arithmetic — and the int32 accumulation across
+blocks is associative integer addition. The result equals
+``jax.ops.segment_sum`` bit for bit (asserted in
+``tests/test_kernels.py``, including at the lane-plan boundary
+widths).
+
+Invalid rows already arrive masked (pk 0, all columns 0 — the XLA
+path's convention), so they add exact zeros; padding rows appended
+here do the same.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from pipelinedp_tpu.obs.costs import instrumented_jit
+from pipelinedp_tpu.ops.kernels.hist import _compiler_params
+
+
+def _segsum_kernel_body(pk_ref, cols_ref, out_ref):
+    from jax.experimental import pallas as pl
+    P, _ = out_ref.shape
+    R = pk_ref.shape[1]
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    pk = pk_ref[0, :].astype(jnp.float32)             # [R], exact ints
+    iota_p = jax.lax.broadcasted_iota(jnp.float32, (P, R), 0)
+    oh = jnp.where(pk[None, :] == iota_p, 1.0, 0.0)   # [P, R]
+    part = jax.lax.dot_general(
+        oh, cols_ref[...].astype(jnp.float32),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)           # [P, C]
+    out_ref[...] += part.astype(jnp.int32)
+
+
+def segment_sum_lanes(cols, pk, P: int, row_block: int,
+                      interpret: bool):
+    """Pallas lane-packed segment sum: ``cols`` [N, C] int32, ``pk``
+    [N] int32 in [0, P) — returns [P, C] int32 bit-identical to
+    ``jax.ops.segment_sum(cols, pk, num_segments=P)``. ``row_block``
+    comes from ``dispatch.segsum_envelope``."""
+    from jax.experimental import pallas as pl
+    n, C = cols.shape
+    n_pad = -(-n // row_block) * row_block
+    pad = n_pad - n
+    pk2 = jnp.pad(pk, (0, pad)).reshape(-1, row_block)
+    cols2 = jnp.pad(cols, ((0, pad), (0, 0)))
+    return pl.pallas_call(
+        _segsum_kernel_body,
+        grid=(n_pad // row_block,),
+        in_specs=[
+            pl.BlockSpec((1, row_block), lambda i: (i, 0)),
+            pl.BlockSpec((row_block, C), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((P, C), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((P, C), jnp.int32),
+        interpret=interpret,
+        **_compiler_params(interpret),
+    )(pk2, cols2)
+
+
+#: Standalone instrumented entry (phase ``engine``) — see
+#: ``hist.hist_bin_multi_program`` for the seam rationale.
+segment_sum_lanes_program = instrumented_jit(
+    phase="engine", static_argnames=("P", "row_block", "interpret"))(
+        segment_sum_lanes)

@@ -87,10 +87,11 @@ def make_mesh(n_devices: Optional[int] = None, axis_name: str = "data"
 
 
 @instrumented_jit(phase="engine", static_argnames=(
-    "config", "num_partitions", "mesh", "fx_bits"))
+    "config", "num_partitions", "mesh", "fx_bits", "kernel_backend"))
 def _sharded_kernel(config, num_partitions, mesh, pid, pk, values, valid,
                     noise_scales, keep_table, sel_threshold, sel_scale,
-                    sel_min_count, sel_rows_per_uid, key, fx_bits=7):
+                    sel_min_count, sel_rows_per_uid, key, fx_bits=7,
+                    kernel_backend="xla"):
     """``num_partitions`` is the GLOBAL (padded) pk axis, a multiple of
     the mesh size; outputs come back partition-sharded over the mesh."""
     axis = mesh.axis_names[0]
@@ -110,7 +111,7 @@ def _sharded_kernel(config, num_partitions, mesh, pid, pk, values, valid,
         k_bound = jax.random.fold_in(k_bound_g, jax.lax.axis_index(axis))
         part, part_nseg, qrows = jax_engine._partials(
             config, num_partitions, pid, pk, values, valid, k_bound,
-            fx_bits)
+            fx_bits, kernel_backend=kernel_backend)
         # Cross-chip exchange: each device keeps only the accumulator
         # block it owns (the percentile walk runs its own per-level
         # all_gather + psum_scatter protocol internally).
@@ -144,7 +145,8 @@ def sharded_fused_aggregate(mesh: Mesh, config, num_partitions: int,
                             values: np.ndarray, valid: np.ndarray,
                             noise_scales, keep_table, sel_threshold,
                             sel_scale, sel_min_count, sel_rows_per_uid,
-                            key, fx_bits: int = 7):
+                            key, fx_bits: int = 7,
+                            kernel_backend: str = "xla"):
     """Host entry: re-shards rows by hash(pid), pads each shard to a
     common length, places arrays over the mesh and runs the sharded
     kernel. Returns (keep_pk[P], accumulator dict) with the partition
@@ -197,4 +199,5 @@ def sharded_fused_aggregate(mesh: Mesh, config, num_partitions: int,
         values_dev, dev(valid_s), jnp.asarray(noise_scales),
         jnp.asarray(keep_table), jnp.float32(sel_threshold),
         jnp.float32(sel_scale), jnp.float32(sel_min_count),
-        jnp.float32(sel_rows_per_uid), key, fx_bits=fx_bits)
+        jnp.float32(sel_rows_per_uid), key, fx_bits=fx_bits,
+        kernel_backend=kernel_backend)

@@ -57,14 +57,22 @@ class KnobSpec:
     env_var: Optional[str]          #: explicit-override env name
     seam: Optional[Tuple[str, str]]  #: (module, attr) test seam
     dp_safe: bool                   #: may a plan file change it?
-    kind: type                      #: int or bool
+    kind: type                      #: int, bool or str (enumerated)
     doc: str
+    choices: Tuple[str, ...] = ()   #: legal values for str knobs
 
     def parse(self, raw: Any) -> Any:
         if self.kind is bool:
             if isinstance(raw, str):
                 return raw.lower() not in ("0", "false", "off")
             return bool(raw)
+        if self.kind is str:
+            # Enumerated string knobs (kernel_backend): an unknown
+            # value — a typo'd env var, a plan from a future schema —
+            # resolves to the default rather than crashing a request
+            # over a performance choice.
+            v = str(raw).strip().lower()
+            return v if v in self.choices else self.default
         return int(raw)
 
 
@@ -115,6 +123,20 @@ REGISTRY: Tuple[KnobSpec, ...] = (
         "plan_pass_b_sweeps search the (q_chunk, p_blk) grid. Every "
         "tiling is bit-identical (PARITY row 3); an infeasible pin "
         "falls back to the search."),
+    KnobSpec(
+        "kernel_backend", "xla | pallas", "xla",
+        "PIPELINEDP_TPU_KERNEL_BACKEND",
+        ("pipelinedp_tpu.ops.kernels.dispatch", "_KERNEL_BACKEND"),
+        True, str,
+        "Device kernel path for the pass-B multi-tile histogram binner "
+        "and the fused lane-packed segment_sum: 'xla' (generic "
+        "sort/scatter lowering — the default; cold start is "
+        "byte-identical to pre-knob behavior) or 'pallas' (the "
+        "hand-tiled VMEM-resident kernels in ops/kernels/, interpret "
+        "mode off-TPU). dp-safe: both paths are bit-identical (PARITY "
+        "row 33); out-of-envelope shapes or a host without Pallas "
+        "fall back to 'xla' with a kernel.fallback event.",
+        choices=("xla", "pallas")),
     KnobSpec(
         "select_units_cap", "privacy units per partition", _I32_MAX,
         None, ("pipelinedp_tpu.streaming", "_SELECT_UNITS_CAP"),

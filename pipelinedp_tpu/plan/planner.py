@@ -419,6 +419,10 @@ def autotune_candidates() -> list:
             {"stream_cache_bytes": 0},
             {"q_chunk": 1},
             {"subhist_byte_cap": 64 << 20},
+            # The Pallas kernel path: measured like any other dp-safe
+            # knob, so a device where it loses (CPU interpret mode)
+            # self-selects "xla" from the trial argmin.
+            {"kernel_backend": "pallas"},
     ):
         vec = dict(base)
         vec.update(deviation)

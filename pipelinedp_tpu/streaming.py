@@ -187,17 +187,20 @@ def _combine_shards(x, axis, dim, multiproc):
 
 
 def _chunk_body(config, num_partitions, planes, values, n_valid, key,
-                fx_bits, n_pid_planes):
+                fx_bits, n_pid_planes, kernel_backend="xla"):
     """The shared per-chunk trace: widen the narrow id planes, derive
     the validity mask from the row count, bound + reduce. ONE body for
     all four kernels (single-device / sharded x pass A / pass B) — the
     mesh-vs-single-device parity contract rests on them tracing
-    identical row math."""
+    identical row math. ``kernel_backend`` steers the per-pk reduction
+    (the Pallas lane-packed segment sum vs the XLA scatter — bit-
+    identical either way); the pass-B kernels leave it at "xla" since
+    their reduction output is dead code XLA eliminates anyway."""
     pid = je._widen_ids(planes[:n_pid_planes])
     pk = je._widen_ids(planes[n_pid_planes:])
     valid = jnp.arange(pid.shape[0]) < n_valid
     return je._partials(config, num_partitions, pid, pk, values, valid,
-                        key, fx_bits)
+                        key, fx_bits, kernel_backend=kernel_backend)
 
 
 def _pack_rank1(part, nseg):
@@ -221,9 +224,10 @@ def _mid_histogram(config, num_partitions, qrows):
 
 
 @instrumented_jit(phase="pass_a", static_argnames=(
-    "config", "num_partitions", "fx_bits", "n_pid_planes"))
+    "config", "num_partitions", "fx_bits", "n_pid_planes",
+    "kernel_backend"))
 def _partials_kernel(config, num_partitions, planes, values, n_valid, key,
-                     fx_bits, n_pid_planes):
+                     fx_bits, n_pid_planes, kernel_backend="xla"):
     """One chunk's bounding + per-pk reduction, packed for the fetch:
     the ``_pack_rank1`` stack, the rank-2 vector sums (or None), and —
     for percentile configs — the ``_mid_histogram`` (stays
@@ -235,7 +239,8 @@ def _partials_kernel(config, num_partitions, planes, values, n_valid, key,
     device from the scalar row count."""
     part, nseg, qrows = _chunk_body(config, num_partitions, planes,
                                     values, n_valid, key, fx_bits,
-                                    n_pid_planes)
+                                    n_pid_planes,
+                                    kernel_backend=kernel_backend)
     packed, vec = _pack_rank1(part, nseg)
     mid = (_mid_histogram(config, num_partitions, qrows)
            if config.percentiles else None)
@@ -262,10 +267,11 @@ def _pct_sub_kernel(config, num_partitions, planes, values, n_valid, key,
 
 
 @instrumented_jit(phase="pass_b", static_argnames=(
-    "config", "num_partitions", "fx_bits", "n_pid_planes", "n_block"))
+    "config", "num_partitions", "fx_bits", "n_pid_planes", "n_block",
+    "kernel_backend"))
 def _pct_multi_sub_kernel(config, num_partitions, planes, values, n_valid,
                           key, fx_bits, n_pid_planes, sub_starts,
-                          p_offsets, n_block):
+                          p_offsets, n_block, kernel_backend="xla"):
     """Multi-tile pass B: ONE bounding recompute of the chunk's rows
     (same key -> identical bounding sample as pass A) scatters into
     EVERY tile the sweep planner packed into this round —
@@ -279,7 +285,8 @@ def _pct_multi_sub_kernel(config, num_partitions, planes, values, n_valid,
     qpk, leaf, kept = qrows
     _, _, _, span = _tree_consts()
     return je._subtree_counts_multi(qpk, leaf, kept, sub_starts,
-                                    p_offsets, n_block, span)
+                                    p_offsets, n_block, span,
+                                    kernel_backend=kernel_backend)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -391,9 +398,11 @@ def plan_pass_b_sweeps(P_pad, Q, span, cap, q_chunk=0) -> PassBPlan:
 
 
 @instrumented_jit(phase="pass_a", static_argnames=(
-    "config", "num_partitions", "mesh", "fx_bits", "n_pid_planes"))
+    "config", "num_partitions", "mesh", "fx_bits", "n_pid_planes",
+    "kernel_backend"))
 def _sharded_partials_kernel(config, num_partitions, mesh, planes, values,
-                             n_valid_shard, key, fx_bits, n_pid_planes):
+                             n_valid_shard, key, fx_bits, n_pid_planes,
+                             kernel_backend="xla"):
     """Mesh twin of ``_partials_kernel``: each device bounds + reduces
     ITS shard of the chunk's rows (rows arrive pid-sharded over the
     mesh axis, so contribution bounding is shard-local exactly as in
@@ -413,7 +422,8 @@ def _sharded_partials_kernel(config, num_partitions, mesh, planes, values,
         k_bound = jax.random.fold_in(key, jax.lax.axis_index(axis))
         part, nseg, qrows = _chunk_body(config, num_partitions, planes,
                                         values, n_valid[0], k_bound,
-                                        fx_bits, n_pid_planes)
+                                        fx_bits, n_pid_planes,
+                                        kernel_backend=kernel_backend)
         packed, vec = _pack_rank1(part, nseg)
         outs = [_combine(packed, 1)]
         if vec is not None:
@@ -484,11 +494,11 @@ def _sharded_pct_sub_kernel(config, num_partitions, mesh, planes, values,
 
 @instrumented_jit(phase="pass_b", static_argnames=(
     "config", "num_partitions", "mesh", "fx_bits", "n_pid_planes",
-    "n_block"))
+    "n_block", "kernel_backend"))
 def _sharded_pct_multi_sub_kernel(config, num_partitions, mesh, planes,
                                   values, n_valid_shard, key, fx_bits,
                                   n_pid_planes, sub_starts, p_offsets,
-                                  n_block):
+                                  n_block, kernel_backend="xla"):
     """Mesh twin of ``_pct_multi_sub_kernel``: each shard recomputes its
     bounded rows once (same per-shard key derivation as pass A) and
     scatters them into every packed tile's [Pb, Qc, span] block; the
@@ -507,7 +517,8 @@ def _sharded_pct_multi_sub_kernel(config, num_partitions, mesh, planes,
                                   n_pid_planes)
         qpk, leaf, kept = qrows
         sub = je._subtree_counts_multi(qpk, leaf, kept, sub_starts,
-                                       p_offsets, n_block, span)
+                                       p_offsets, n_block, span,
+                                       kernel_backend=kernel_backend)
         return psh.combine_shards(sub, axis, 0, True)
 
     shard, repl = psh.PSpec(axis), psh.PSpec()
@@ -713,6 +724,11 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
 
     use_executor = (bool(knob_plan.values["ingest_executor"])
                     if executor is None else bool(executor))
+    # Resolved OUTSIDE jit and passed as a static argument to the
+    # chunk kernels: jit caches by signature, so a backend switch
+    # between requests re-traces instead of silently reusing the
+    # other backend's compiled program.
+    kernel_backend = str(knob_plan.values["kernel_backend"])
     if mesh is not None and mesh.is_multi_process:
         # Multi-PROCESS meshes run the serial path: every process must
         # enqueue the same device work in the same order, and the
@@ -1122,11 +1138,13 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
             if mesh is None:
                 packed, vec, mid = _partials_kernel(
                     config, P_pad, planes, values_d, nv, kb, fx_bits,
-                    n_pid_planes=n_pid_planes)
+                    n_pid_planes=n_pid_planes,
+                    kernel_backend=kernel_backend)
             else:
                 packed, vec, mid = _sharded_partials_kernel(
                     config, P_pad, mesh, planes, values_d, nv, kb,
-                    fx_bits, n_pid_planes=n_pid_planes)
+                    fx_bits, n_pid_planes=n_pid_planes,
+                    kernel_backend=kernel_backend)
         if cache is not None and not cache_frozen:
             # The budget is PER-DEVICE HBM: on a mesh the arrays are
             # row-sharded, so each device holds 1/n_dev of the bytes.
@@ -1336,6 +1354,18 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                                    np.int32))
                 sub_cell = [None]
 
+                # A pallas request on the un-chunked (single-full)
+                # branch routes through the multi-tile kernels as a
+                # T=1 pack — per tile the multi kernel IS the single
+                # kernel's math, so the values are bit-identical, and
+                # the request is either actually served by the Pallas
+                # binner or visibly degraded with a kernel.fallback
+                # event (the single-tile kernels have no dispatch
+                # point, which would make "pallas requested, xla ran"
+                # silent — the one thing the knob must never be).
+                as_multi = (not single_full
+                            or kernel_backend == "pallas")
+
                 def consume(item, ring_b, ss_dev=ss_dev,
                             p_offs=p_offs, Pb=Pb):
                     b, planes, values_d, nv, n_pid_planes = item
@@ -1344,15 +1374,20 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                     # pass-A fault could never land here).
                     faults.check_pass_b_chunk(b)
                     kb = jax.random.fold_in(k_bound, b)
+                    if single_full and as_multi:
+                        ss_m = ss_dev[None]
+                        p_offs_m = jnp.zeros(1, jnp.int32)
+                    else:
+                        ss_m, p_offs_m = ss_dev, p_offs
                     with obs.device_annotation("pdp.stream_pass_b"):
-                        if single_full and mesh is None:
+                        if not as_multi and mesh is None:
                             sub = _pct_sub_kernel(
                                 config, P_pad, planes, values_d, nv,
                                 kb, fx_bits,
                                 n_pid_planes=n_pid_planes,
                                 sub_start=ss_dev,
                                 p_offset=jnp.int32(0), n_block=P_pad)
-                        elif single_full:
+                        elif not as_multi:
                             sub = _sharded_pct_sub_kernel(
                                 config, P_pad, mesh, planes, values_d,
                                 nv, kb, fx_bits,
@@ -1364,15 +1399,21 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                                 config, P_pad, planes, values_d, nv,
                                 kb, fx_bits,
                                 n_pid_planes=n_pid_planes,
-                                sub_starts=ss_dev, p_offsets=p_offs,
-                                n_block=Pb)
+                                sub_starts=ss_m, p_offsets=p_offs_m,
+                                n_block=Pb,
+                                kernel_backend=kernel_backend)
                         else:
                             sub = _sharded_pct_multi_sub_kernel(
                                 config, P_pad, mesh, planes, values_d,
                                 nv, kb, fx_bits,
                                 n_pid_planes=n_pid_planes,
-                                sub_starts=ss_dev, p_offsets=p_offs,
-                                n_block=Pb)
+                                sub_starts=ss_m, p_offsets=p_offs_m,
+                                n_block=Pb,
+                                kernel_backend=kernel_backend)
+                        if single_full and as_multi:
+                            # Back to the single-full [Pb, Qc, span]
+                            # shape the walk consumes.
+                            sub = sub[0]
                     sub_cell[0] = (sub if sub_cell[0] is None
                                    else sub_cell[0] + sub)
                     if ring_b is not None:

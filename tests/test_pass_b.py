@@ -176,13 +176,30 @@ class TestMultiTileSweepParity:
     """Acceptance: with the cap seam shrunk to force >= 4 tiles, pass B
     runs ceil(tiles / tiles_per_sweep) sweeps — strictly fewer than
     tiles — and releases values and kept-partition sets bit-identical
-    to the per-tile loop and to the unchunked walk."""
+    FOUR ways: unchunked walk = multi-tile XLA = per-tile loop = the
+    Pallas multi-tile binner (``kernel_backend=pallas``, interpret
+    mode on the CPU proxy)."""
 
     def _assert_same(self, a, b, tag):
         assert set(a) == set(b), tag  # kept-partition sets
         for k in a:
             for f in _pct_fields(a):
                 assert getattr(a[k], f) == getattr(b[k], f), (tag, k, f)
+
+    def _run_pallas(self, run_fn):
+        """The fourth implementation, with proof it actually ran the
+        Pallas path (a silent XLA fallback would make the parity
+        assertion vacuous)."""
+        from pipelinedp_tpu import obs
+        from pipelinedp_tpu import plan as plan_mod
+
+        obs.reset()
+        with plan_mod.seam_override("kernel_backend", "pallas"):
+            out, t = run_fn()
+        counters = obs.ledger().snapshot()["counters"]
+        assert counters.get("kernel.pallas_dispatches", 0) >= 1
+        assert not counters.get("kernel.fallbacks")
+        return out, t
 
     def test_single_device(self, monkeypatch):
         ds = _dataset()
@@ -192,6 +209,14 @@ class TestMultiTileSweepParity:
         full, t_full = _run(ds, params, eps=4.0, monkeypatch=monkeypatch)
         assert t_full["stream_pass_b_sweeps"] == 1
         assert len(full) >= 4
+        # Un-chunked (single-full) pass B under pallas: the request
+        # routes through the multi-tile kernels as a T=1 pack — served
+        # by the binner (or a VISIBLE kernel.fallback), never a silent
+        # xla run through the dispatch-less single-tile kernel.
+        pallas_full, t_pf = self._run_pallas(
+            lambda: _run(ds, params, eps=4.0, monkeypatch=monkeypatch))
+        assert t_pf["stream_pass_b_sweeps"] == 1
+        self._assert_same(full, pallas_full, "pallas vs unchunked xla")
         monkeypatch.setattr(je, "_SUBHIST_BYTE_CAP", 5 * UNIT)
         multi, t_multi = _run(ds, params, eps=4.0,
                               monkeypatch=monkeypatch)
@@ -202,12 +227,16 @@ class TestMultiTileSweepParity:
             t_multi["stream_pass_b_tiles_per_sweep"])
         assert (t_multi["stream_pass_b_sweeps"] <
                 t_multi["stream_pass_b_tiles"])
+        pallas, t_pal = self._run_pallas(
+            lambda: _run(ds, params, eps=4.0, monkeypatch=monkeypatch))
+        assert t_pal["stream_pass_b_sweeps"] == 7
         _force_per_tile(monkeypatch)
         per_tile, t_tile = _run(ds, params, eps=4.0,
                                 monkeypatch=monkeypatch)
         assert t_tile["stream_pass_b_sweeps"] == 32
         self._assert_same(full, multi, "multi-tile vs unchunked")
         self._assert_same(full, per_tile, "per-tile vs unchunked")
+        self._assert_same(full, pallas, "pallas vs unchunked")
 
     def test_mesh(self, monkeypatch):
         from pipelinedp_tpu.parallel import make_mesh
@@ -227,10 +256,12 @@ class TestMultiTileSweepParity:
         multi, t_multi = run()
         assert (t_multi["stream_pass_b_sweeps"] <
                 t_multi["stream_pass_b_tiles"] == 32)
+        pallas, _ = self._run_pallas(run)
         _force_per_tile(monkeypatch)
         per_tile, _ = run()
         self._assert_same(full, multi, "mesh multi-tile vs unchunked")
         self._assert_same(full, per_tile, "mesh per-tile vs unchunked")
+        self._assert_same(full, pallas, "mesh pallas vs unchunked")
 
     def test_sweep_counters_reach_ledger(self, monkeypatch):
         from pipelinedp_tpu import obs

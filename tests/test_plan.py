@@ -66,6 +66,7 @@ HARDCODED_DEFAULTS = {
     "stream_cache_bytes": 4 << 30,
     "ingest_executor": True,
     "q_chunk": 0,
+    "kernel_backend": "xla",
     "select_units_cap": int(np.iinfo(np.int32).max),
     "tree_rows_cap": int(np.iinfo(np.int32).max),
 }
