@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast bench smoke multichip lint dev clean faultcheck nosleep perfcheck nofoldin obscheck noperf nostager ledgercheck noartifacts watchcheck costcheck nocost plancheck noknobs kernelcheck nopallas
+.PHONY: test test-fast bench smoke multichip lint dev clean faultcheck nosleep perfcheck nofoldin obscheck noperf nostager ledgercheck noartifacts watchcheck costcheck nocost plancheck noknobs kernelcheck nopallas servecheck noserve
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -48,6 +48,50 @@ perfcheck: nosleep nofoldin nostager nopallas
 # roofline peak row, and the in-tree nopallas AST twin.
 kernelcheck: nopallas
 	$(PYTHON) -m pytest tests/test_kernels.py tests/test_pass_b.py -q
+
+# Resident-service acceptance suite: durable per-tenant budget
+# ledgers (exactly-once debits, overdraw refused before compute,
+# kill-and-restart replay), admission control (malformed / queue-full
+# / per-tenant in-flight refusals as structured responses, graceful
+# drain with zero orphan pdp-serve threads), warm engine/program
+# reuse (second same-signature request captures no new
+# compile.program span), serve-vs-direct bit-parity (PARITY row 34),
+# per-tenant books, the run-namespaced multi-request heartbeat, and
+# the per-directory report-cursor regression.
+servecheck: noserve
+	$(PYTHON) -m pytest tests/test_serve.py tests/test_ledger.py -q
+
+# Lint-style check: durable budget-ledger state has ONE writer stack —
+# TenantBudgetLedger construction is confined to pipelinedp_tpu/serve/
+# (+ budget_accounting.py, the module whose two-phase state it lifts),
+# and the batch engine modules never import pipelinedp_tpu.serve (the
+# service depends on the engine, never the reverse — batch mode stays
+# byte-for-byte oblivious to serving). Docstring/comment mentions
+# (backquoted or #-prefixed) are ignored. (tests/test_serve.py
+# enforces the same two rules in-tree, AST-precise.)
+noserve:
+	@bad=$$(grep -rn "TenantBudgetLedger *(" --include='*.py' pipelinedp_tpu bench.py \
+	  | grep -v "pipelinedp_tpu/serve/" \
+	  | grep -v "pipelinedp_tpu/budget_accounting\.py" \
+	  | grep -v '``' | grep -vE ':[0-9]+: *#' || true); \
+	if [ -n "$$bad" ]; then \
+	  echo "$$bad"; \
+	  echo "ERROR: budget-ledger construction outside pipelinedp_tpu/serve/"; \
+	  echo "+ budget_accounting.py — budget debits must flow through the"; \
+	  echo "serve layer's durable ledger"; \
+	  exit 1; \
+	fi; \
+	bad=$$(grep -rnE "(from|import)[^#\"']*pipelinedp_tpu\.serve" \
+	  --include='*.py' pipelinedp_tpu \
+	  | grep -v "pipelinedp_tpu/serve/" \
+	  | grep -v '``' | grep -vE ':[0-9]+: *#' || true); \
+	if [ -n "$$bad" ]; then \
+	  echo "$$bad"; \
+	  echo "ERROR: serve import in a batch engine module — the service"; \
+	  echo "depends on the engine, never the reverse"; \
+	  exit 1; \
+	fi; \
+	echo "noserve: OK"
 
 # Lint-style check: pallas imports and pallas_call sites are confined
 # to pipelinedp_tpu/ops/kernels/ — every other module must dispatch

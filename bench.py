@@ -856,6 +856,120 @@ def bench_kernel_backend_compare(n_rows, smoke=False):
     return rec
 
 
+def bench_serve_latency(n_rows, smoke=False):
+    """``serve_request_latency`` record: a resident ``serve.Service``
+    held warm across N sequential + M concurrent requests over three
+    tenants. Reports the cold (first-request) wall, warm p50/p99
+    request latency, sequential and concurrent requests/s — the
+    serving-plane twin of the batch rows/s records. The headline value
+    is the CONCURRENT requests/s (unit ``req/s``), so ``--compare``
+    gates it like every other rate; ``plan_source``/``kernel_backend``
+    stamps ride in through the shared emitter."""
+    import shutil
+    import tempfile
+
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu import serve
+    from pipelinedp_tpu.ingest.executor import _CaptureThread
+
+    n_seq = 6 if smoke else 12
+    n_conc = 8 if smoke else 16
+    parts = 200 if smoke else 2_000
+    rng = np.random.default_rng(23)
+    ds = pdp.ArrayDataset(
+        privacy_ids=rng.integers(0, max(n_rows // 8, 1_000), n_rows),
+        partition_keys=(rng.zipf(1.3, n_rows) % parts).astype(np.int64),
+        values=rng.uniform(0.0, 10.0, n_rows))
+    params = pdp.AggregateParams(
+        metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM, pdp.Metrics.MEAN],
+        noise_kind=pdp.NoiseKind.LAPLACE,
+        max_partitions_contributed=4, max_contributions_per_partition=2,
+        min_value=0.0, max_value=10.0)
+    tenants = {f"bench-t{i}": (1e6, 1e-3) for i in range(3)}
+
+    def req(tenant, seed):
+        return serve.ServeRequest(tenant=tenant, params=params,
+                                  dataset=ds, epsilon=0.5, delta=1e-8,
+                                  rng_seed=seed)
+
+    def timed_submit(svc, tenant, seed):
+        ds.invalidate_cache()
+        with tracer().span("bench.serve_request", cat="bench",
+                           tenant=tenant) as sp:
+            out = svc.submit(req(tenant, seed))
+        assert out.ok, f"serve refused: {out}"
+        return sp.duration
+
+    state_dir = tempfile.mkdtemp(prefix="pdp_serve_bench_")
+    try:
+        with serve.Service(state_dir, tenants=tenants,
+                           max_queue=max(n_conc * 2, 16),
+                           max_inflight_per_tenant=n_conc,
+                           workers=4) as svc:
+            names = sorted(tenants)
+            cold_s = timed_submit(svc, names[0], seed=0)
+            warm: list = []
+            with tracer().span("bench.serve_sequential",
+                               cat="bench") as seq_sp:
+                for i in range(n_seq):
+                    warm.append(timed_submit(svc, names[i % 3],
+                                             seed=i + 1))
+            warm.sort()
+            p50 = warm[len(warm) // 2]
+            p99 = warm[min(len(warm) - 1,
+                           int(len(warm) * 0.99))]
+            durations = [None] * n_conc
+
+            def one(i):
+                def body():
+                    durations[i] = timed_submit(svc, names[i % 3],
+                                                seed=100 + i)
+                return _CaptureThread(body, f"pdp-serve-bench-{i}")
+
+            with tracer().span("bench.serve_concurrent",
+                               cat="bench") as conc_sp:
+                threads = [one(i) for i in range(n_conc)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+            for t in threads:
+                if t.exc is not None:
+                    raise t.exc
+            conc_rps = n_conc / max(conc_sp.duration, 1e-9)
+            seq_rps = n_seq / max(seq_sp.duration, 1e-9)
+            conc_sorted = sorted(d for d in durations if d is not None)
+            conc_p50 = (conc_sorted[len(conc_sorted) // 2]
+                        if conc_sorted else 0.0)
+    finally:
+        shutil.rmtree(state_dir, ignore_errors=True)
+
+    from pipelinedp_tpu import obs
+    counters = obs.ledger().snapshot()["counters"]
+    rec = {
+        "metric": "serve_request_latency",
+        "value": round(conc_rps, 2),
+        "unit": "req/s",
+        "rows_per_request": n_rows,
+        "tenants": len(tenants),
+        "sequential_requests": n_seq,
+        "concurrent_requests": n_conc,
+        "cold_s": round(cold_s, 4),
+        "warm_p50_s": round(p50, 4),
+        "warm_p99_s": round(p99, 4),
+        "sequential_req_per_s": round(seq_rps, 2),
+        "concurrent_p50_s": round(conc_p50, 4),
+        "warm_hits": int(counters.get("serve.warm_hits", 0)),
+        "cold_builds": int(counters.get("serve.cold_builds", 0)),
+    }
+    log(f"## serve_request_latency [{n_rows} rows x {parts} parts x "
+        f"{len(tenants)} tenants]: cold {cold_s:.3f}s, warm p50 "
+        f"{p50 * 1000:.1f}ms / p99 {p99 * 1000:.1f}ms, "
+        f"{seq_rps:.1f} seq req/s, {conc_rps:.1f} concurrent req/s")
+    emit(rec)
+    return rec
+
+
 def run_autotune(args):
     """``bench.py --autotune``: the bounded knob sweep that closes the
     measure→decide loop. Runs the streamed-percentile workload once per
@@ -1664,6 +1778,11 @@ def main():
         # bit-parity cross-check in one record.
         bench_kernel_backend_compare(30_000 if args.smoke else 500_000,
                                      smoke=args.smoke)
+
+        # The resident-service record: cold vs warm request latency +
+        # requests/s through a warm multi-tenant serve.Service.
+        bench_serve_latency(30_000 if args.smoke else 500_000,
+                            smoke=args.smoke)
 
         # Config 5: the analysis epsilon-sweep.
         bench_analysis_sweep(a_rows, max(1000, a_rows // 25),

@@ -187,6 +187,36 @@ class BudgetAccountant(abc.ABC):
         self._expected_num_aggregations = num_aggregations
         self._expected_aggregation_weights = aggregation_weights
         self._actual_aggregation_weights: List[float] = []
+        #: (tenant, request_id) books tag for resident-service runs —
+        #: see :meth:`bind_books`.
+        self._books: Optional[dict] = None
+
+    # --- resident-service integration ---
+
+    @property
+    def total_epsilon(self) -> float:
+        """The accountant's whole-pipeline epsilon. For a resident
+        service this IS the request's debit against the tenant's
+        durable budget ledger: the accountant by construction
+        distributes exactly its totals, so leasing (eps, delta) from
+        the ledger and constructing the per-request accountant with
+        those totals makes the ledger's arithmetic exact."""
+        return self._total_epsilon
+
+    @property
+    def total_delta(self) -> float:
+        """The accountant's whole-pipeline delta (see
+        :attr:`total_epsilon`)."""
+        return self._total_delta
+
+    def bind_books(self, tenant: str, request_id: str) -> None:
+        """Tag this accountant with the tenant's books it debits: the
+        audit record (and thus the run report / per-tenant ledger
+        entry) then names which tenant and which request the granted
+        (eps, delta) splits belong to. Idempotent; the serve layer
+        calls it right after leasing the request's budget."""
+        self._books = {"tenant": str(tenant),
+                       "request_id": str(request_id)}
 
     # --- scope management ---
 
@@ -330,13 +360,16 @@ class BudgetAccountant(abc.ABC):
                 "count": spec.count,
                 "internal_splits": m.internal_splits,
             })
-        return {
+        record = {
             "accountant": type(self).__name__,
             "total_epsilon": self._total_epsilon,
             "total_delta": self._total_delta,
             "finalized": self._finalized,
             "mechanisms": mechanisms,
         }
+        if self._books is not None:
+            record["books"] = dict(self._books)
+        return record
 
     def _spec_noise_std(self, m: MechanismSpecInternal) -> Optional[float]:
         """Noise stddev of ONE of the spec's ``internal_splits``

@@ -65,6 +65,33 @@ class DPEngine:
         self._backend = backend
         self._report_generators = []
 
+    def rebind_budget_accountant(self, accountant,
+                                 reset_reports: bool = True) -> None:
+        """Resident-service seam: swap in a fresh per-request budget
+        accountant so a warm engine (and with it the backend's jitted
+        programs and the planner's resolved knob vector) serves many
+        requests instead of one. Batch mode never calls this — an
+        engine built the classic way keeps its one accountant for life.
+
+        Refuses to swap while the CURRENT accountant still has
+        un-finalized mechanisms: those lazy specs are captured by a
+        pending lazy result, and rebinding under them would split one
+        request's two-phase protocol across two accountants.
+        ``reset_reports`` also drops the accumulated explain-report
+        generators, which otherwise grow without bound in a resident
+        process."""
+        if (self._budget_accountant is not None
+                and self._budget_accountant._mechanisms
+                and not self._budget_accountant.finalized):
+            raise RuntimeError(
+                "cannot rebind the budget accountant: the current one "
+                "has registered mechanisms but compute_budgets() has "
+                "not run — finalize (or abandon) the in-flight request "
+                "first")
+        self._budget_accountant = accountant
+        if reset_reports:
+            self._report_generators = []
+
     @property
     def _current_report_generator(self):
         return self._report_generators[-1]

@@ -158,6 +158,7 @@ def reset() -> None:
     audit.reset()
     costs.reset()
     store.reset_run_report_cursor()
+    monitor.reset_requests()
     # Lazy: plan imports obs, so a module-level import would cycle.
     from pipelinedp_tpu import plan as _plan
     _plan.reset()

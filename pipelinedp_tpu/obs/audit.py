@@ -32,6 +32,7 @@ dicts; no engine/jax imports ever flow through here.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 from typing import Any, Dict, List, Optional
@@ -68,11 +69,44 @@ def reset() -> None:
         _dropped = 0
 
 
+#: Per-thread "whose books is this" tag: the resident service wraps
+#: each request's compute in :func:`books_context`, and every record a
+#: producer (accountant finalize, engine aggregation audit, release-
+#: seam error estimate) appends on that thread is stamped with the
+#: (tenant, request_id) pair — so a multi-tenant process's interleaved
+#: audit trail still attributes each record to one request. Thread-
+#: local, not a contextvar: the serve worker model is one request per
+#: worker thread end-to-end.
+_books = threading.local()
+
+
+@contextlib.contextmanager
+def books_context(tenant: str, request_id: str):
+    """Stamp every audit record appended by THIS thread inside the
+    block with ``{"tenant", "request_id"}`` (nests; inner wins)."""
+    prev = getattr(_books, "value", None)
+    _books.value = {"tenant": str(tenant), "request_id": str(request_id)}
+    try:
+        yield
+    finally:
+        _books.value = prev
+
+
+def current_books() -> Optional[Dict[str, str]]:
+    """The calling thread's active (tenant, request_id) stamp, if any."""
+    value = getattr(_books, "value", None)
+    return dict(value) if value else None
+
+
 def _append(bucket: List[Dict[str, Any]], record: Dict[str, Any]) -> None:
     global _dropped
+    stamped = dict(record)
+    books = current_books()
+    if books is not None:
+        stamped.setdefault("books", books)
     with _lock:
         if len(bucket) < MAX_RECORDS:
-            bucket.append(dict(record))
+            bucket.append(stamped)
         else:
             _dropped += 1
 

@@ -14,13 +14,17 @@ module is the in-flight half of the obs stack:
 * **Heartbeat** — a single monitor thread (``pdp-monitor``) snapshots
   the live counter/span ledger every ``PIPELINEDP_TPU_HEARTBEAT_S``
   seconds into an atomically-replaced JSON file
-  (``<ledger_dir>/heartbeat.json`` by default, or the path named by
+  (``<ledger_dir>/heartbeat-<run>.json`` by default — namespaced by
+  run name so resident processes sharing one ledger directory never
+  clobber each other — or the path named by
   ``PIPELINEDP_TPU_HEARTBEAT``): current phase, batches/sweeps done vs
-  planned, rows/s so far, wall time per active span — and, when the
-  durable ledger store holds a same-fingerprint baseline run report,
-  an on-pace/behind verdict with a projected ETA. ``os.replace``
-  makes every write atomic: a concurrent ``watch cat`` or dashboard
-  poller never sees a torn file.
+  planned, rows/s so far, wall time per active span, every live
+  request registered via :func:`register_request` (the resident
+  service's in-flight picture, all requests in ONE document) — and,
+  when the durable ledger store holds a same-fingerprint baseline run
+  report, an on-pace/behind verdict with a projected ETA.
+  ``os.replace`` makes every write atomic: a concurrent ``watch cat``
+  or dashboard poller never sees a torn file.
 * **Stall watchdog** — if no span opens or closes for
   ``PIPELINEDP_TPU_STALL_S`` seconds, emit a structured
   ``watchdog.stalled`` event into the ledger and dump a **flight
@@ -86,17 +90,81 @@ def heartbeat_enabled() -> bool:
                                                        "off")
 
 
-def heartbeat_destination(default_dir: Optional[str] = None) -> str:
+def heartbeat_destination(default_dir: Optional[str] = None,
+                          run: Optional[str] = None) -> str:
     """Where the heartbeat lands: a path-like ``PIPELINEDP_TPU_HEARTBEAT``
-    value (contains a separator or ends in ``.json``) names the file;
-    bare switch values use ``<ledger_dir>/heartbeat.json`` so the live
-    view sits next to the durable history it projects."""
+    value (contains a separator or ends in ``.json``) names the file
+    verbatim; bare switch values use ``<ledger_dir>/heartbeat-<run>.json``
+    so the live view sits next to the durable history it projects AND
+    two resident processes sharing one ledger directory never clobber
+    each other's beat (without ``run`` the legacy shared
+    ``heartbeat.json`` name is kept for explicit single-run callers)."""
     v = os.environ.get(ENV_VAR, "")
     if os.sep in v or "/" in v or v.endswith(".json"):
         return v
     d = _store.ledger_dir(default=default_dir or
                           os.path.join(os.getcwd(), ".pdp_ledger"))
+    if run:
+        safe = "".join(c if (c.isalnum() or c in "-_.") else "-"
+                       for c in str(run))
+        return os.path.join(d, f"heartbeat-{safe}.json")
     return os.path.join(d, HEARTBEAT_FILENAME)
+
+
+# --- live-request registry -------------------------------------------
+#
+# A resident multi-tenant service runs MANY requests through one
+# process at once; a heartbeat that only says "phase: engine.device"
+# cannot say whose. Each in-flight request registers here (the serve
+# layer does it at admission), and every beat snapshots ALL live
+# requests into the one heartbeat document — one file, the whole
+# process's in-flight picture, instead of N requests clobbering a
+# single phase field.
+
+_REQS_LOCK = threading.Lock()
+_LIVE_REQUESTS: Dict[str, Dict[str, Any]] = {}
+_REQS_SEQ = 0
+
+
+def register_request(request_id: str, **attrs) -> None:
+    """Register one in-flight request (tenant, phase, ... — any
+    JSON-able attrs). Idempotent per ``request_id``; registration is a
+    dict write, cheap enough to do whether or not a monitor runs."""
+    global _REQS_SEQ
+    with _REQS_LOCK:
+        _REQS_SEQ += 1
+        rec = _LIVE_REQUESTS.setdefault(str(request_id),
+                                        {"request_id": str(request_id),
+                                         "seq": _REQS_SEQ})
+        rec.update(attrs)
+
+
+def update_request(request_id: str, **attrs) -> None:
+    """Update a live request's attrs (e.g. phase transitions); unknown
+    ids are ignored — the request may already have completed."""
+    with _REQS_LOCK:
+        rec = _LIVE_REQUESTS.get(str(request_id))
+        if rec is not None:
+            rec.update(attrs)
+
+
+def unregister_request(request_id: str) -> None:
+    """Drop a completed/refused request from the live set."""
+    with _REQS_LOCK:
+        _LIVE_REQUESTS.pop(str(request_id), None)
+
+
+def live_requests() -> List[Dict[str, Any]]:
+    """Snapshot of all live requests, admission order."""
+    with _REQS_LOCK:
+        return [dict(r) for r in sorted(_LIVE_REQUESTS.values(),
+                                        key=lambda r: r.get("seq", 0))]
+
+
+def reset_requests() -> None:
+    """Forget all live-request registrations (tests)."""
+    with _REQS_LOCK:
+        _LIVE_REQUESTS.clear()
 
 
 class Monitor:
@@ -128,8 +196,9 @@ class Monitor:
                            if interval_s is None else float(interval_s))
         self.stall_s = (float(os.environ.get(STALL_ENV, DEFAULT_STALL_S))
                         if stall_s is None else float(stall_s))
-        self.heartbeat_path = heartbeat_path or heartbeat_destination()
         self.run_name = run_name or f"run-{os.getpid()}"
+        self.heartbeat_path = (heartbeat_path or
+                               heartbeat_destination(run=self.run_name))
         self.flight_path = os.path.join(
             os.path.dirname(os.path.abspath(self.heartbeat_path)),
             f"{self.run_name}.flightrec.json")
@@ -347,6 +416,12 @@ class Monitor:
         hbm = _costs.hbm_snapshot()
         if hbm is not None:
             hb["hbm"] = hbm
+        reqs = live_requests()
+        if reqs:
+            # One document snapshots EVERY in-flight request of this
+            # resident process (tenant, phase, age) — the multi-tenant
+            # answer to "whose work is the current phase".
+            hb["requests"] = reqs
         if stalled:
             hb["stall"] = {"stalled_for_s": round(stalled_for, 3),
                            "deadline_s": self.stall_s,
@@ -397,6 +472,9 @@ class Monitor:
             "counters": counters,
             "threads": self._thread_stacks(),
         }
+        reqs = live_requests()
+        if reqs:
+            record["requests"] = reqs
         self._write_atomic(self.flight_path, record)
         info = {"diagnosis": diagnosis, "phase": phase,
                 "stalled_for_s": round(stalled_for, 3),

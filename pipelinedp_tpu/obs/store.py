@@ -248,20 +248,32 @@ class LedgerStore:
 #: release hot path.
 _proc_stores: Dict[str, LedgerStore] = {}
 _env_cache: Dict[Any, Dict[str, Any]] = {}
-#: Delta cursor for per-request appends: audit-registry lengths and the
-#: event count already persisted by this process, so entry k carries
-#: ONLY what request k added — never a cumulative duplicate of entries
-#: 1..k-1 (O(N^2) ledger growth otherwise).
-_report_cursor: Dict[str, Any] = {"audit": None, "events": 0}
+#: Delta cursors for per-request appends, KEYED BY RESOLVED DIRECTORY:
+#: audit-registry lengths and the event count already persisted to
+#: each store, so entry k carries ONLY what request k added — never a
+#: cumulative duplicate of entries 1..k-1 (O(N^2) ledger growth
+#: otherwise). Per-directory, not per-process: a resident multi-tenant
+#: service appends to one ledger directory per tenant, and a single
+#: process-wide cursor would let tenant A's append swallow the records
+#: tenant B's next entry still needs.
+_report_cursors: Dict[str, Dict[str, Any]] = {}
+
+
+def _cursor_for(directory: str) -> Dict[str, Any]:
+    key = os.path.abspath(directory)
+    cur = _report_cursors.get(key)
+    if cur is None:
+        cur = {"audit": None, "events": 0}
+        _report_cursors[key] = cur
+    return cur
 
 
 def reset_run_report_cursor() -> None:
-    """Forget the per-request delta cursor and the cached environment
-    probe (``obs.reset()`` calls this: a fresh ledger/audit registry
-    restarts the deltas from zero, and a run boundary may change the
-    flag set the fingerprint records)."""
-    _report_cursor["audit"] = None
-    _report_cursor["events"] = 0
+    """Forget the per-directory delta cursors and the cached
+    environment probe (``obs.reset()`` calls this: a fresh ledger/audit
+    registry restarts the deltas from zero, and a run boundary may
+    change the flag set the fingerprint records)."""
+    _report_cursors.clear()
     _env_cache.clear()
 
 
@@ -572,18 +584,23 @@ def main(argv: Optional[List[str]] = None) -> int:
 def maybe_append_run_report(name: str,
                             default_dir: Optional[str] = None,
                             extra: Optional[Dict[str, Any]] = None,
-                            mesh=None) -> Optional[Dict[str, Any]]:
+                            mesh=None,
+                            directory: Optional[str] = None
+                            ) -> Optional[Dict[str, Any]]:
     """Append this request's run-report DELTA as entry ``name`` — the
     traced-engine-run hook. The entry keeps the run-report shape but
     its ``privacy`` lists and ``events`` carry only records new since
-    this process's previous append (cumulative counters/span rollups
-    stay whole: they are fixed-size). A request that added nothing
-    appends nothing. ``mesh`` keys the entry's fingerprint on the mesh
-    shape actually used. No-op (returns None) when no ledger directory
-    resolves, and swallows every failure: the store must never take an
-    aggregation down."""
+    this process's previous append TO THE SAME DIRECTORY (cumulative
+    counters/span rollups stay whole: they are fixed-size). A request
+    that added nothing appends nothing. ``mesh`` keys the entry's
+    fingerprint on the mesh shape actually used. ``directory`` pins
+    the store outright (the serve layer's per-tenant books — the env
+    var must not reroute one tenant's entries into another's ledger);
+    without it the usual ``ledger_dir`` resolution applies. No-op
+    (returns None) when no ledger directory resolves, and swallows
+    every failure: the store must never take an aggregation down."""
     try:
-        directory = ledger_dir(default=default_dir)
+        directory = directory or ledger_dir(default=default_dir)
         if not directory:
             return None
         from pipelinedp_tpu import obs
@@ -593,11 +610,12 @@ def maybe_append_run_report(name: str,
             env = obs.environment_fingerprint(mesh=mesh)
             _env_cache[mesh_key] = env
         report = obs.build_run_report(mesh=mesh, env=env)
-        audit_since = dict(_report_cursor["audit"] or {})
+        cursor = _cursor_for(directory)
+        audit_since = dict(cursor["audit"] or {})
         report["privacy"] = obs.audit.build_privacy_section(
             counters=report.get("counters", {}), since=audit_since)
         events = report.get("events", [])
-        ev_start = min(int(_report_cursor["events"]), len(events))
+        ev_start = min(int(cursor["events"]), len(events))
         report["events"] = events[ev_start:]
         priv = report["privacy"]
         if not (priv["accountants"] or priv["aggregations"] or
@@ -613,7 +631,7 @@ def maybe_append_run_report(name: str,
                              env=env)
         # Advance by exactly what this entry carried — concurrent
         # producers appending mid-build land in the next entry.
-        _report_cursor["audit"] = {
+        cursor["audit"] = {
             "accountants": audit_since.get("accountants", 0) +
             len(priv["accountants"]),
             "aggregations": audit_since.get("aggregations", 0) +
@@ -621,7 +639,7 @@ def maybe_append_run_report(name: str,
             "expected_errors": audit_since.get("expected_errors", 0) +
             len(priv["expected_errors"]),
         }
-        _report_cursor["events"] = len(events)
+        cursor["events"] = len(events)
         return entry
     except Exception:
         return None

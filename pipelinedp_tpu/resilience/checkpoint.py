@@ -209,3 +209,45 @@ def as_store(checkpoint) -> Optional[CheckpointStore]:
     if isinstance(checkpoint, CheckpointStore):
         return checkpoint
     return CheckpointStore(checkpoint)
+
+
+# --- shared atomic-JSON discipline -----------------------------------
+#
+# The checkpoint store's write protocol (tmp file in the destination
+# directory + flush + fsync + ``os.replace``) is what makes a kill
+# mid-write leave the previous state intact. The serve layer's durable
+# per-tenant budget ledgers need exactly the same guarantee for small
+# JSON documents, so the discipline lives here once instead of being
+# re-derived per caller. ``json.dumps`` + write (never ``json.dump``):
+# run artifacts are obs/'s job, and the noartifacts lint holds.
+
+
+def atomic_write_json(path: str, payload) -> None:
+    """Durably replace ``path`` with ``payload`` as JSON: the new
+    document is fully written and fsync'd under a temp name before one
+    atomic ``os.replace`` — a concurrent reader (or a kill at any
+    instant) sees the old document or the new one, never a torn mix."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".json.tmp")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            f.write(json.dumps(payload, sort_keys=True, default=repr))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def read_json(path: str):
+    """Load an :func:`atomic_write_json` document; None when the file
+    does not exist. A corrupt document RAISES — with the atomic-replace
+    discipline a torn file means something outside this protocol wrote
+    it, and silently starting fresh would (for a budget ledger) forget
+    spent budget."""
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return json.loads(f.read())

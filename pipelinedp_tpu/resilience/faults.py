@@ -9,7 +9,10 @@ library consult the active plan:
 * ``check_chunk(b)`` — raise ``ChunkFailure`` when the streaming loop
   reaches chunk ``b`` (kills a streamed run mid-flight);
 * ``check_coordinator()`` — the first ``coordinator_timeouts`` calls
-  raise ``CoordinatorTimeout`` (a hung ``jax.distributed`` handshake).
+  raise ``CoordinatorTimeout`` (a hung ``jax.distributed`` handshake);
+* ``check_serve_request(i)`` — raise ``ServeKill`` when the resident
+  service reaches admitted request ``i`` (kills it between the durable
+  budget reserve and its commit — the reserve must survive restart).
 
 Plans install either in-process (``injected_faults(plan)`` context
 manager) or across a process boundary via the ``PIPELINEDP_TPU_FAULTS``
@@ -47,6 +50,11 @@ class CoordinatorTimeout(FaultInjected):
     """Injected ``jax.distributed`` coordinator timeout."""
 
 
+class ServeKill(FaultInjected):
+    """Injected hard kill of a resident-service request mid-compute
+    (between the durable budget reserve and its commit/release)."""
+
+
 @dataclasses.dataclass(frozen=True)
 class FaultPlan:
     #: first N device-probe / mesh-init attempts wedge (per site).
@@ -60,6 +68,14 @@ class FaultPlan:
     fail_pass_b_chunks: Tuple[int, ...] = ()
     #: first N coordinator connections raise ``CoordinatorTimeout``.
     coordinator_timeouts: int = 0
+    #: serve-request admission indices (0-based, in admission order)
+    #: whose compute raises ``ServeKill`` mid-request — AFTER the
+    #: durable budget reserve, BEFORE commit/release. The resident
+    #: service treats any ``FaultInjected`` as a hard process kill:
+    #: the reserved debit stands (DP-conservative — noise may already
+    #: have been drawn), which is exactly what the kill-and-restart
+    #: ledger-replay tests need to observe.
+    fail_serve_requests: Tuple[int, ...] = ()
     #: batch indices whose pass-A result FETCH blocks (holds) until
     #: :func:`release_holds` — a wedged device/link mid-stream, the
     #: stall the obs watchdog exists to catch. The hold is cooperative
@@ -85,6 +101,9 @@ class FaultPlan:
                          ":".join(str(c) for c in self.fail_pass_b_chunks))
         if self.coordinator_timeouts:
             parts.append(f"coordinator_timeouts={self.coordinator_timeouts}")
+        if self.fail_serve_requests:
+            parts.append("fail_serve_requests=" +
+                         ":".join(str(c) for c in self.fail_serve_requests))
         if self.hold_fetch_batches:
             parts.append("hold_fetch_batches=" +
                          ":".join(str(c) for c in self.hold_fetch_batches))
@@ -101,7 +120,7 @@ def plan_from_env(spec: str) -> FaultPlan:
             continue
         k, _, v = item.partition("=")
         if k in ("fail_chunks", "fail_pass_b_chunks",
-                 "hold_fetch_batches"):
+                 "hold_fetch_batches", "fail_serve_requests"):
             kw[k] = tuple(int(c) for c in v.split(":") if c)
         elif k == "wedged_hold":
             kw[k] = bool(int(v))
@@ -216,6 +235,20 @@ def check_fetch_hold(index: int) -> None:
     raise RuntimeError(
         f"injected hold at batch {index} was never released within "
         f"{_HOLD_MAX_S:g}s — call faults.release_holds()")
+
+
+def check_serve_request(index: int) -> None:
+    """Raise :class:`ServeKill` when the active plan kills serve
+    request ``index`` (admission order) mid-compute. The serve worker
+    lets this propagate WITHOUT releasing the budget reserve —
+    simulating the process dying between reserve and commit, the
+    window the durable ledger's replay semantics exist for."""
+    plan = active()
+    if plan is not None and index in plan.fail_serve_requests:
+        _record("serve_kill", index=int(index))
+        raise ServeKill(
+            f"injected hard kill at serve request {index} (reserved "
+            "budget debit must survive the restart)")
 
 
 def check_pass_b_chunk(index: int) -> None:

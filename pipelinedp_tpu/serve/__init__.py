@@ -1,0 +1,39 @@
+"""pipelinedp_tpu.serve — the resident multi-tenant aggregation service.
+
+A thin package over the existing engine: durable per-tenant budget
+ledgers (``budget_ledger``), admission control + bounded queue + warm
+program reuse (``service``). In-process API first::
+
+    from pipelinedp_tpu import serve
+
+    svc = serve.Service("/var/pdp", tenants={"acme": (4.0, 1e-6)})
+    out = svc.submit(serve.ServeRequest(
+        tenant="acme", params=params, dataset=ds,
+        epsilon=0.5, delta=1e-8))
+    if out.ok:
+        dict(out.results)
+    else:
+        out.reason, out.detail   # "overdraw" / "queue_full" / ...
+
+Batch mode never imports this package (enforced by ``make noserve``);
+the serve path runs the batch engine's own code, so serve-on/off is
+DP-bit-identical (PARITY row 34).
+"""
+
+from pipelinedp_tpu.serve.budget_ledger import (BudgetLease, LedgerError,
+                                                Overdraw,
+                                                TenantBudgetLedger,
+                                                TenantMismatch,
+                                                UnknownTenant,
+                                                tenant_slug)
+from pipelinedp_tpu.serve.service import (REFUSAL_REASONS, Refusal,
+                                          Service, ServeRequest,
+                                          ServeResponse,
+                                          params_signature)
+
+__all__ = [
+    "BudgetLease", "LedgerError", "Overdraw", "TenantBudgetLedger",
+    "TenantMismatch", "UnknownTenant", "tenant_slug",
+    "REFUSAL_REASONS", "Refusal", "Service", "ServeRequest",
+    "ServeResponse", "params_signature",
+]

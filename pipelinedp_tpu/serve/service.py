@@ -1,0 +1,579 @@
+"""Resident multi-tenant DP aggregation service.
+
+``Service`` turns the one-process-one-job library into a system: it
+stays resident, accepts a stream of aggregation requests for many
+tenants, and routes them through long-lived warm state —
+
+* **admission control** on the caller's thread, BEFORE any compute:
+  malformed requests, per-tenant in-flight caps, queue-full
+  backpressure and budget overdraws all come back as structured
+  :class:`Refusal` values (never exceptions), and the budget debit is
+  durably reserved in the tenant's ledger before the request is even
+  queued;
+* a **bounded queue** drained by a small pool of ingest-discipline
+  worker threads (``pdp-serve-*`` ``_CaptureThread``\\ s, poll-with-
+  timeout waits, graceful drain on ``close()`` — the zero-orphan
+  lifecycle the streaming executor established);
+* a **warm registry** of resident ``DPEngine`` + backend instances
+  keyed by (tenant, params-signature): a repeat request rebinds a
+  fresh per-request accountant into the resident engine
+  (``DPEngine.rebind_budget_accountant``) and hits the process's warm
+  jitted programs — no recompile, no re-probe — while every request
+  still gets its own two-phase accountant, audit record and books
+  entry.
+
+The transport is deliberately in-process (``submit(request)`` →
+response/refusal): the service is a thin package over the existing
+engine, batch mode is untouched, and serve-on/off is DP-bit-identical
+(PARITY row 34) because the serve path runs exactly the batch path's
+code with exactly the batch path's inputs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import queue
+import threading
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from pipelinedp_tpu.aggregate_params import AggregateParams
+from pipelinedp_tpu.budget_accounting import (Budget,
+                                              NaiveBudgetAccountant)
+from pipelinedp_tpu.dp_engine import DataExtractors, DPEngine
+from pipelinedp_tpu.serve.budget_ledger import (BudgetLease,
+                                                DuplicateRequest,
+                                                Overdraw,
+                                                TenantBudgetLedger,
+                                                UnknownTenant,
+                                                tenant_slug)
+
+#: Admission-control env knobs (constructor args win; see the README
+#: knob table). Queue depth bounds memory under backpressure; the
+#: per-tenant in-flight cap keeps one tenant from monopolizing the
+#: worker pool.
+QUEUE_ENV = "PIPELINEDP_TPU_SERVE_QUEUE"
+INFLIGHT_ENV = "PIPELINEDP_TPU_SERVE_INFLIGHT"
+WORKERS_ENV = "PIPELINEDP_TPU_SERVE_WORKERS"
+
+DEFAULT_QUEUE_DEPTH = 16
+DEFAULT_INFLIGHT_PER_TENANT = 4
+DEFAULT_WORKERS = 2
+
+#: Seconds between cancel polls while a worker blocks on the queue
+#: (same beat as the ingest executor).
+_POLL_S = 0.02
+
+
+@dataclasses.dataclass
+class ServeRequest:
+    """One aggregation request against a tenant's budget.
+
+    ``epsilon``/``delta`` are the request's DEMAND on the tenant's
+    durable ledger — they become the per-request accountant's totals,
+    so the ledger's debit and the accountant's distribution agree
+    exactly. ``rng_seed`` fixes the noise stream (tests, replayable
+    pipelines); None draws fresh noise per request."""
+    tenant: str
+    params: AggregateParams
+    dataset: Any
+    epsilon: float
+    delta: float = 0.0
+    data_extractors: Optional[DataExtractors] = None
+    public_partitions: Any = None
+    rng_seed: Optional[int] = None
+    request_id: Optional[str] = None
+
+
+@dataclasses.dataclass
+class ServeResponse:
+    """A served request: the released metrics plus the books."""
+    request_id: str
+    tenant: str
+    results: List[Tuple[Any, Any]]
+    remaining: Budget
+    warm: bool
+    signature: str
+    wall_s: float
+    audit: Dict[str, Any]
+
+    @property
+    def ok(self) -> bool:
+        return True
+
+
+#: The closed set of refusal reasons — admission control speaks a
+#: vocabulary, not free text (``detail`` carries the prose).
+REFUSAL_REASONS = ("overdraw", "malformed", "duplicate", "queue_full",
+                  "tenant_busy", "shutdown", "error")
+
+
+@dataclasses.dataclass
+class Refusal:
+    """A refused request: structured, never an exception. ``reason``
+    is one of :data:`REFUSAL_REASONS`; ``remaining`` is attached where
+    it informs the caller (overdraw)."""
+    request_id: str
+    tenant: str
+    reason: str
+    detail: str
+    remaining: Optional[Budget] = None
+
+    @property
+    def ok(self) -> bool:
+        return False
+
+
+def params_signature(request: ServeRequest) -> str:
+    """The warm-registry key half that names WHAT program a request
+    needs: the full aggregation params, the public-partition mode and
+    the extractor shape. Deliberately NOT the rng seed — the seed is
+    per-request noise state, set on the resident backend under the
+    entry lock, so requests that differ only in their noise stream
+    still share one warm engine. Two requests with equal signatures
+    (and tenant) may share a resident engine; the jitted program cache
+    underneath additionally keys on array shapes, so a signature hit
+    with new shapes simply compiles one more specialization."""
+    ext = request.data_extractors
+    basis = "|".join((
+        repr(request.params),
+        repr(sorted(map(repr, request.public_partitions))
+             if request.public_partitions is not None else None),
+        repr((ext is not None and ext.privacy_id_extractor is not None,
+              ext is not None and ext.partition_extractor is not None,
+              ext is not None and ext.value_extractor is not None)),
+    ))
+    return hashlib.sha256(basis.encode("utf-8")).hexdigest()[:16]
+
+
+class _WarmEntry:
+    """One resident (tenant, signature) slot: engine + backend + a
+    lock serializing same-key requests (an engine holds per-request
+    accountant state while it runs)."""
+
+    def __init__(self, engine: DPEngine, backend: Any):
+        self.engine = engine
+        self.backend = backend
+        self.lock = threading.Lock()
+        self.hits = 0
+
+
+class _Pending:
+    """A submitted request waiting for its worker: the caller blocks
+    on ``done``; ``outcome`` is ("response", r) / ("refusal", r) /
+    ("raise", exc) — the last one models a request the injected kill
+    took down, re-raised on the submitting thread."""
+
+    def __init__(self, request: ServeRequest, lease: BudgetLease,
+                 seq: int):
+        self.request = request
+        self.lease = lease
+        self.seq = seq
+        self.done = threading.Event()
+        self.outcome: Optional[Tuple[str, Any]] = None
+
+    def finish(self, kind: str, value: Any) -> None:
+        self.outcome = (kind, value)
+        self.done.set()
+
+
+class Service:
+    """The resident service. Construct once, ``register_tenant`` (or
+    pass ``tenants=``), then ``submit`` from any thread; ``close()``
+    (or the context manager) drains the queue and joins every worker.
+
+    Directory layout under ``ledger_dir``::
+
+        budgets/budget-<tenant-slug>.json   durable budget ledgers
+        books/<tenant-slug>/run_ledger.jsonl   per-tenant request books
+    """
+
+    def __init__(self, ledger_dir: str,
+                 tenants: Optional[Dict[str, Tuple[float, float]]] = None,
+                 *,
+                 max_queue: Optional[int] = None,
+                 max_inflight_per_tenant: Optional[int] = None,
+                 workers: Optional[int] = None,
+                 backend_factory=None,
+                 clock=None):
+        from pipelinedp_tpu import obs
+        self.ledger_dir = str(ledger_dir)
+        self.budgets = TenantBudgetLedger(
+            os.path.join(self.ledger_dir, "budgets"))
+        self.max_queue = int(
+            os.environ.get(QUEUE_ENV, DEFAULT_QUEUE_DEPTH)
+            if max_queue is None else max_queue)
+        self.max_inflight_per_tenant = int(
+            os.environ.get(INFLIGHT_ENV, DEFAULT_INFLIGHT_PER_TENANT)
+            if max_inflight_per_tenant is None
+            else max_inflight_per_tenant)
+        n_workers = int(os.environ.get(WORKERS_ENV, DEFAULT_WORKERS)
+                        if workers is None else workers)
+        self._backend_factory = backend_factory or self._default_backend
+        self._tr = obs.run_tracer(clock=clock)
+        self._q: queue.Queue = queue.Queue(maxsize=self.max_queue)
+        self._admit = threading.Lock()
+        self._inflight: Dict[str, int] = {}
+        self._registry: Dict[Tuple[str, str], _WarmEntry] = {}
+        self._registry_lock = threading.Lock()
+        self._books_stores: Dict[str, Any] = {}
+        self._env: Optional[Dict[str, Any]] = None
+        self._seq = 0
+        self._closed = threading.Event()
+        self._stop = threading.Event()
+        from pipelinedp_tpu.ingest.executor import _CaptureThread
+        self._workers = [
+            _CaptureThread(self._worker_loop, f"pdp-serve-{i}")
+            for i in range(max(1, n_workers))]
+        for t in self._workers:
+            t.start()
+        for tenant, (eps, delta) in (tenants or {}).items():
+            self.register_tenant(tenant, eps, delta)
+        obs.event("serve.started", workers=len(self._workers),
+                  max_queue=self.max_queue,
+                  max_inflight_per_tenant=self.max_inflight_per_tenant,
+                  ledger_dir=self.ledger_dir)
+
+    # --- lifecycle ---
+
+    @staticmethod
+    def _default_backend(request: ServeRequest):
+        from pipelinedp_tpu.backends import JaxBackend
+        return JaxBackend(rng_seed=request.rng_seed)
+
+    def register_tenant(self, tenant: str, total_epsilon: float,
+                        total_delta: float) -> Budget:
+        """Open (or re-open) a tenant's durable budget ledger; returns
+        the remaining budget — which a restart replays from disk."""
+        return self.budgets.open_tenant(tenant, total_epsilon,
+                                        total_delta)
+
+    def close(self) -> None:
+        """Graceful drain: refuse new submissions, serve everything
+        already queued, then stop and join every worker (zero orphan
+        ``pdp-serve-*`` threads — the executor discipline). Taking the
+        admission lock to flip ``_closed`` closes the race with an
+        in-flight ``submit()``: an admitter that already passed the
+        closed check finishes its enqueue before we proceed, and the
+        post-join sweep below refunds + refuses anything the departed
+        workers left behind — no submitter ever blocks forever."""
+        from pipelinedp_tpu import obs
+        from pipelinedp_tpu.obs import monitor as obs_monitor
+        with self._admit:
+            self._closed.set()
+        self._stop.set()
+        for t in self._workers:
+            while t.is_alive():
+                t.join(timeout=_POLL_S)
+        self._workers = []
+        while True:
+            try:
+                pending = self._q.get_nowait()
+            except queue.Empty:
+                break
+            tenant, rid = pending.lease.tenant, pending.lease.request_id
+            try:
+                self.budgets.release(tenant, rid)
+            except Exception:
+                obs.event("serve.release_failed", request_id=rid,
+                          tenant=tenant)
+            obs_monitor.unregister_request(rid)
+            pending.finish("refusal", self._refuse(
+                rid, tenant, "shutdown",
+                "service closed before a worker picked this request "
+                "up; the reserve was refunded"))
+        obs.event("serve.closed")
+
+    def __enter__(self) -> "Service":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
+    # --- admission control (caller thread; never any compute) ---
+
+    def _validate(self, request: ServeRequest) -> Optional[str]:
+        if not isinstance(request, ServeRequest):
+            return f"expected ServeRequest, got {type(request).__name__}"
+        if not request.tenant or not isinstance(request.tenant, str):
+            return "tenant must be a non-empty string"
+        if not isinstance(request.params, AggregateParams):
+            return ("params must be an AggregateParams, got "
+                    f"{type(request.params).__name__}")
+        try:
+            if request.dataset is None or len(request.dataset) == 0:
+                return "dataset must be non-empty"
+        except TypeError:
+            return "dataset must be sized (rows or ArrayDataset)"
+        if not (isinstance(request.epsilon, (int, float))
+                and request.epsilon > 0):
+            return f"epsilon must be positive, got {request.epsilon!r}"
+        if not (isinstance(request.delta, (int, float))
+                and request.delta >= 0):
+            return f"delta must be >= 0, got {request.delta!r}"
+        return None
+
+    def submit(self, request: ServeRequest):
+        """Admit, queue and serve one request; blocks until its
+        response (or refusal) is ready. Thread-safe — concurrent
+        callers model concurrent tenants. The call sequence is the
+        contract: a request REFUSED here has spent nothing and run
+        nothing (the overdraw check happens before any compute), and
+        a request admitted here has its (eps, delta) durably reserved
+        before the queue ever sees it."""
+        from pipelinedp_tpu import obs
+        from pipelinedp_tpu.obs import monitor as obs_monitor
+        rid = request.request_id or f"req-{uuid.uuid4().hex[:12]}"
+        tenant = request.tenant
+        if self._closed.is_set():
+            return self._refuse(rid, tenant, "shutdown",
+                                "service is draining; submit refused")
+        detail = self._validate(request)
+        if detail is not None:
+            return self._refuse(rid, tenant, "malformed", detail)
+        with self._admit:
+            if self._closed.is_set():
+                return self._refuse(rid, tenant, "shutdown",
+                                    "service is draining; submit "
+                                    "refused")
+            inflight = self._inflight.get(tenant, 0)
+            if inflight >= self.max_inflight_per_tenant:
+                return self._refuse(
+                    rid, tenant, "tenant_busy",
+                    f"tenant '{tenant}' already has {inflight} "
+                    f"request(s) in flight (cap "
+                    f"{self.max_inflight_per_tenant})")
+            if self._q.full():
+                return self._refuse(
+                    rid, tenant, "queue_full",
+                    f"request queue is full ({self.max_queue} deep); "
+                    "back off and resubmit")
+            try:
+                lease = self.budgets.reserve(tenant, rid,
+                                             request.epsilon,
+                                             request.delta)
+            except Overdraw as e:
+                return self._refuse(
+                    rid, tenant, "overdraw",
+                    f"insufficient budget: requested {e.requested}, "
+                    f"remaining {e.remaining}, shortfall "
+                    f"{e.shortfall}", remaining=e.remaining)
+            except DuplicateRequest as e:
+                return self._refuse(rid, tenant, "duplicate", str(e))
+            except UnknownTenant as e:
+                return self._refuse(rid, tenant, "malformed", str(e))
+            pending = _Pending(request, lease, self._seq)
+            self._seq += 1
+            self._inflight[tenant] = inflight + 1
+            # Register BEFORE the enqueue: the worker's update/
+            # unregister must always follow the registration, or a
+            # fast completion would leave a phantom live request in
+            # every later heartbeat.
+            obs_monitor.register_request(rid, tenant=tenant,
+                                         phase="queued")
+            try:
+                self._q.put_nowait(pending)
+            except queue.Full:  # raced another admitter
+                self._inflight[tenant] = self._inflight[tenant] - 1
+                self.budgets.release(tenant, rid)
+                obs_monitor.unregister_request(rid)
+                return self._refuse(
+                    rid, tenant, "queue_full",
+                    f"request queue is full ({self.max_queue} deep); "
+                    "back off and resubmit")
+        obs.inc("serve.requests_admitted")
+        pending.done.wait()
+        kind, value = pending.outcome
+        if kind == "raise":
+            raise value
+        return value
+
+    def _refuse(self, rid: str, tenant: str, reason: str, detail: str,
+                remaining: Optional[Budget] = None) -> Refusal:
+        from pipelinedp_tpu import obs
+        obs.inc("serve.requests_refused")
+        obs.inc(f"serve.refusals.{reason}")
+        obs.event("serve.refusal", request_id=rid, tenant=str(tenant),
+                  reason=reason, detail=detail)
+        refusal = Refusal(request_id=rid, tenant=str(tenant),
+                          reason=reason, detail=detail,
+                          remaining=remaining)
+        self._append_books(str(tenant), "serve.refusal", {
+            "request_id": rid, "reason": reason, "detail": detail})
+        return refusal
+
+    # --- the workers ---
+
+    def _worker_loop(self) -> None:
+        while True:
+            try:
+                pending = self._q.get(timeout=_POLL_S)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            try:
+                self._execute(pending)
+            except BaseException as e:  # safety net: a worker must
+                # never die holding an unfinished pending — the
+                # submitter would block forever and the pool would
+                # shrink. Surface the failure on the caller instead.
+                if not pending.done.is_set():
+                    pending.finish("raise", e)
+            finally:
+                with self._admit:
+                    tenant = pending.request.tenant
+                    self._inflight[tenant] = max(
+                        0, self._inflight.get(tenant, 0) - 1)
+
+    def _warm_entry(self, request: ServeRequest,
+                    signature: str) -> Tuple[_WarmEntry, bool]:
+        key = (request.tenant, signature)
+        with self._registry_lock:
+            entry = self._registry.get(key)
+            if entry is not None:
+                entry.hits += 1
+                return entry, True
+        # Build outside the registry lock (backend construction may
+        # probe); last writer wins on a same-key race — both entries
+        # work, one simply stays cold.
+        backend = self._backend_factory(request)
+        engine = DPEngine(None, backend)
+        entry = _WarmEntry(engine, backend)
+        with self._registry_lock:
+            self._registry.setdefault(key, entry)
+            return self._registry[key], False
+
+    def _drop_entry(self, request: ServeRequest, signature: str) -> None:
+        """A failed request may leave its engine holding a half-run
+        accountant; drop the slot so the next request rebuilds clean."""
+        with self._registry_lock:
+            self._registry.pop((request.tenant, signature), None)
+
+    def _execute(self, pending: _Pending) -> None:
+        from pipelinedp_tpu import obs
+        from pipelinedp_tpu.obs import audit as obs_audit
+        from pipelinedp_tpu.obs import monitor as obs_monitor
+        from pipelinedp_tpu.resilience import faults
+        request, lease = pending.request, pending.lease
+        rid, tenant = lease.request_id, lease.tenant
+        signature = params_signature(request)
+        obs_monitor.update_request(rid, phase="running",
+                                   signature=signature)
+        try:
+            # The injected hard-kill seam: between the durable reserve
+            # and any commit/release — a FaultInjected here models the
+            # process dying mid-request, so the reserve MUST stand.
+            faults.check_serve_request(pending.seq)
+            entry, warm = self._warm_entry(request, signature)
+            obs.inc("serve.warm_hits" if warm else "serve.cold_builds")
+            with entry.lock:
+                # Per-request noise state on the resident backend: the
+                # engine reads ``backend.rng_seed`` at aggregate time,
+                # and the entry lock serializes same-key requests, so
+                # each request's noise stream is its own while the
+                # compiled program stays shared.
+                if hasattr(entry.backend, "rng_seed"):
+                    entry.backend.rng_seed = request.rng_seed
+                accountant = NaiveBudgetAccountant(
+                    total_epsilon=lease.epsilon,
+                    total_delta=lease.delta)
+                accountant.bind_books(tenant, rid)
+                entry.engine.rebind_budget_accountant(accountant)
+                extractors = (request.data_extractors
+                              if request.data_extractors is not None
+                              else DataExtractors())
+                with obs_audit.books_context(tenant, rid):
+                    with self._tr.span("serve.request", cat="serve",
+                                       tenant=tenant, warm=warm) as sp:
+                        result = entry.engine.aggregate(
+                            request.dataset, request.params, extractors,
+                            public_partitions=request.public_partitions)
+                        accountant.compute_budgets()
+                        results = list(result)
+        except faults.FaultInjected as e:
+            # Hard kill: do NOT release — noise may have been drawn.
+            # The submitting caller sees the crash; the durable ledger
+            # keeps the reserved debit, exactly what a real process
+            # death leaves behind.
+            obs.inc("serve.requests_killed")
+            obs.event("serve.request_killed", request_id=rid,
+                      tenant=tenant, error=repr(e))
+            obs_monitor.unregister_request(rid)
+            pending.finish("raise", e)
+            return
+        except Exception as e:
+            # Clean failure before any DP release: refund the reserve
+            # and refuse with the error — the engine slot is dropped
+            # so half-run accountant state cannot leak into the next
+            # request.
+            self._drop_entry(request, signature)
+            try:
+                self.budgets.release(tenant, rid)
+            except Exception:
+                obs.event("serve.release_failed", request_id=rid,
+                          tenant=tenant)
+            obs_monitor.unregister_request(rid)
+            pending.finish("refusal", self._refuse(
+                rid, tenant, "error",
+                f"{type(e).__name__}: {e}"))
+            return
+        try:
+            # The DP output exists past this point; a bookkeeping
+            # failure (commit I/O, audit build) must surface on the
+            # CALLER, with the reserve left standing — the output was
+            # computed, so refunding would be the unsafe direction.
+            self.budgets.commit(tenant, rid)
+            remaining = self.budgets.remaining(tenant)
+            audit_record = accountant.audit_record()
+        except Exception as e:
+            obs.event("serve.commit_failed", request_id=rid,
+                      tenant=tenant, error=repr(e))
+            obs_monitor.unregister_request(rid)
+            pending.finish("raise", e)
+            return
+        self._append_books(tenant, "serve.request", {
+            "request_id": rid,
+            "signature": signature,
+            "warm": warm,
+            "wall_s": round(sp.duration, 6),
+            "partitions_released": len(results),
+            "epsilon": lease.epsilon,
+            "delta": lease.delta,
+            "remaining_epsilon": remaining.epsilon,
+            "remaining_delta": remaining.delta,
+            "audit": audit_record,
+        })
+        obs.inc("serve.requests_served")
+        obs_monitor.unregister_request(rid)
+        pending.finish("response", ServeResponse(
+            request_id=rid, tenant=tenant, results=results,
+            remaining=remaining, warm=warm, signature=signature,
+            wall_s=sp.duration, audit=audit_record))
+
+    # --- per-tenant books ---
+
+    def books_dir(self, tenant: str) -> str:
+        return os.path.join(self.ledger_dir, "books",
+                            tenant_slug(tenant))
+
+    def _append_books(self, tenant: str, name: str,
+                      payload: Dict[str, Any]) -> None:
+        """Append one entry to the tenant's own run-ledger store (the
+        fsync'd JSONL appender — the store appends deltas linearly, so
+        the books come for free). Never takes a request down."""
+        try:
+            from pipelinedp_tpu import obs
+            from pipelinedp_tpu.obs.store import LedgerStore
+            store = self._books_stores.get(tenant)
+            if store is None:
+                store = LedgerStore(self.books_dir(tenant))
+                self._books_stores[tenant] = store
+            if self._env is None:
+                self._env = obs.environment_fingerprint()
+            store.append(name, {"serve": dict(payload, tenant=tenant)},
+                         env=self._env)
+        except Exception:
+            pass

@@ -306,9 +306,14 @@ class TestBenchDegradation:
         flight = json.load(open(diagnosis["flight_record"],
                                 encoding="utf-8"))
         assert flight["stall"]["deadline_s"] == 0.3
-        # The live heartbeat streamed next to the durable ledger.
-        hb = json.load(open(os.path.join(ledger_dir, "heartbeat.json"),
-                            encoding="utf-8"))
+        # The live heartbeat streamed next to the durable ledger,
+        # namespaced by the bench's run name (resident processes
+        # sharing a ledger dir must not clobber each other's beat).
+        import glob as _glob
+        hb_files = _glob.glob(os.path.join(ledger_dir,
+                                           "heartbeat-bench-*.json"))
+        assert hb_files, os.listdir(ledger_dir)
+        hb = json.load(open(hb_files[0], encoding="utf-8"))
         assert hb["phase"]
 
     def test_probe_helper_degrades_without_subprocess(self, monkeypatch):

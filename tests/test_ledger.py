@@ -189,6 +189,62 @@ class TestLedgerDirResolution:
         assert obs_store.ledger_dir(default="/x") == "/x"
 
 
+class TestReportCursorPerDirectory:
+    """Regression for the resident multi-tenant service: the per-
+    request delta cursor behind ``maybe_append_run_report`` must key
+    by resolved directory — a process-wide cursor lets tenant A's
+    append swallow the audit records tenant B's ledger never saw."""
+
+    @staticmethod
+    def _accountant_ids(entry):
+        priv = entry["payload"]["run_report"]["privacy"]
+        return [a["books"]["request_id"] for a in priv["accountants"]]
+
+    def _push(self, request_id):
+        from pipelinedp_tpu.obs import audit as obs_audit
+        with obs_audit.books_context("t", request_id):
+            obs_audit.record_accountant({
+                "accountant": "NaiveBudgetAccountant",
+                "total_epsilon": 1.0, "total_delta": 0.0,
+                "finalized": True, "mechanisms": []})
+
+    def test_interleaved_directories_each_get_complete_deltas(
+            self, monkeypatch, tmp_path):
+        monkeypatch.delenv(obs_store.ENV_VAR, raising=False)
+        obs.reset()
+        dir_a, dir_b = str(tmp_path / "a"), str(tmp_path / "b")
+        self._push("r1")
+        assert obs_store.maybe_append_run_report(
+            "serve.request", directory=dir_a) is not None
+        self._push("r2")
+        # Directory B starts its own cursor: its first entry carries
+        # BOTH records — r1 was never persisted to B's books.
+        entry_b = obs_store.maybe_append_run_report(
+            "serve.request", directory=dir_b)
+        assert self._accountant_ids(entry_b) == ["r1", "r2"]
+        # Directory A's next entry carries ONLY the new record.
+        entry_a = obs_store.maybe_append_run_report(
+            "serve.request", directory=dir_a)
+        assert self._accountant_ids(entry_a) == ["r2"]
+        # On-disk stores agree entry for entry.
+        a_entries = obs_store.LedgerStore(dir_a).entries()
+        assert [self._accountant_ids(e) for e in a_entries] == [
+            ["r1"], ["r2"]]
+
+    def test_directory_param_overrides_env(self, monkeypatch, tmp_path):
+        monkeypatch.setenv(obs_store.ENV_VAR, str(tmp_path / "env_dir"))
+        obs.reset()
+        self._push("r1")
+        pinned = str(tmp_path / "pinned")
+        entry = obs_store.maybe_append_run_report("serve.request",
+                                                  directory=pinned)
+        assert entry is not None
+        assert obs_store.LedgerStore(pinned).entries()
+        assert not os.path.exists(
+            os.path.join(str(tmp_path / "env_dir"),
+                         obs_store.LEDGER_FILENAME))
+
+
 def run_engine(seed=0, eps=1.0, n=6_000, parts=10):
     rng = np.random.default_rng(5)
     ds = pdp.ArrayDataset(privacy_ids=rng.integers(0, 1_500, n),

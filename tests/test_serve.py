@@ -1,0 +1,648 @@
+"""Resident multi-tenant serve acceptance suite.
+
+The ISSUE-12 acceptance criteria, end to end:
+
+* a resident ``serve.Service`` handles >= 3 tenants' interleaved
+  requests through warm programs — the second same-signature request
+  is a registry hit AND captures no new ``compile.program`` span;
+* an overdrawing request is refused BEFORE any compute runs, with the
+  shortfall named;
+* two threads racing ``submit()`` against a tenant whose remaining
+  budget covers only one request: exactly one succeeds, and the
+  durable ledger after a kill-and-restart replays to exactly one
+  debit and the same remaining (eps, delta);
+* serve-path outputs are bit-identical to the direct ``DPEngine``
+  path (PARITY row 34);
+* admission control refuses malformed params / queue-full /
+  per-tenant in-flight overflow as structured responses, and a
+  drained service leaves zero orphan ``pdp-*`` threads;
+* the heartbeat snapshots every live request in one document, at a
+  run-namespaced path;
+* the ``noserve`` AST twins: budget-ledger writes confined to
+  ``serve/`` + ``budget_accounting.py``, and no batch-engine module
+  imports ``pipelinedp_tpu.serve``.
+"""
+
+import ast
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import obs, serve
+from pipelinedp_tpu.backends import JaxBackend
+from pipelinedp_tpu.budget_accounting import Budget
+from pipelinedp_tpu.obs import monitor as obs_monitor
+from pipelinedp_tpu.resilience import faults
+from pipelinedp_tpu.resilience.clock import FakeClock
+from pipelinedp_tpu.serve.budget_ledger import (DuplicateRequest,
+                                                Overdraw,
+                                                TenantBudgetLedger,
+                                                TenantMismatch)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BIG_EPS = 1e6
+
+
+@pytest.fixture(autouse=True)
+def fresh_state(monkeypatch, tmp_path):
+    """Fresh obs state, isolated ledger dir, heartbeat off — and a
+    zero-orphan-thread assertion over EVERY test in this file (the
+    ingest-executor drain discipline, applied to pdp-serve-*)."""
+    monkeypatch.setenv("PIPELINEDP_TPU_LEDGER_DIR",
+                       str(tmp_path / "obs_ledger"))
+    monkeypatch.delenv(obs_monitor.ENV_VAR, raising=False)
+    obs.reset()
+    yield
+    obs_monitor.stop()
+    obs.reset()
+    orphans = [t.name for t in threading.enumerate()
+               if t.name.startswith("pdp-serve") and t.is_alive()]
+    assert not orphans, f"orphan serve threads: {orphans}"
+
+
+def make_ds(seed=0, n=6_000, users=1_500, parts=10):
+    rng = np.random.default_rng(seed)
+    return pdp.ArrayDataset(privacy_ids=rng.integers(0, users, n),
+                            partition_keys=rng.integers(0, parts, n),
+                            values=rng.uniform(0.0, 10.0, n))
+
+
+def count_params(parts=10):
+    return pdp.AggregateParams(
+        metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+        max_partitions_contributed=parts,
+        max_contributions_per_partition=20,
+        min_value=0.0, max_value=10.0)
+
+
+def request(tenant, ds, eps=1.0, delta=1e-8, seed=7, rid=None,
+            params=None):
+    return serve.ServeRequest(tenant=tenant,
+                              params=params or count_params(),
+                              dataset=ds, epsilon=eps, delta=delta,
+                              rng_seed=seed, request_id=rid)
+
+
+# ---------------------------------------------------------------------
+# durable budget ledger
+# ---------------------------------------------------------------------
+
+
+class TestBudgetLedger:
+
+    def test_reserve_commit_remaining_and_restart_replay(self, tmp_path):
+        led = TenantBudgetLedger(str(tmp_path))
+        rem = led.open_tenant("acme", 4.0, 1e-6)
+        assert rem.epsilon == 4.0 and rem.delta == 1e-6
+        lease = led.reserve("acme", "r1", 1.5, 2e-7)
+        assert lease.state == "reserved"
+        led.commit("acme", "r1")
+        rem = led.remaining("acme")
+        assert rem.epsilon == pytest.approx(2.5)
+        assert rem.delta == pytest.approx(8e-7)
+        # Kill-and-restart: a fresh instance over the same directory
+        # replays to the same remaining (eps, delta).
+        led2 = TenantBudgetLedger(str(tmp_path))
+        rem2 = led2.remaining("acme")
+        assert rem2.epsilon == pytest.approx(rem.epsilon)
+        assert rem2.delta == pytest.approx(rem.delta)
+        assert led2.debits("acme")["r1"]["state"] == "committed"
+
+    def test_reserve_is_exactly_once_per_request_id(self, tmp_path):
+        led = TenantBudgetLedger(str(tmp_path))
+        led.open_tenant("t", 2.0, 0.0)
+        led.reserve("t", "r1", 1.5, 0.0)
+        # Same id again: the SAME lease comes back, no second debit —
+        # even though a fresh 1.5 would overdraw the remaining 0.5.
+        again = led.reserve("t", "r1", 1.5, 0.0)
+        assert again.epsilon == 1.5 and again.state == "reserved"
+        assert led.remaining("t").epsilon == pytest.approx(0.5)
+
+    def test_committed_id_refuses_re_reserve(self, tmp_path):
+        """A committed debit's output was RELEASED: re-running the id
+        would publish a second noisy view on one charge — refused."""
+        led = TenantBudgetLedger(str(tmp_path))
+        led.open_tenant("t", 5.0, 0.0)
+        led.reserve("t", "r1", 1.0, 0.0)
+        led.commit("t", "r1")
+        with pytest.raises(DuplicateRequest):
+            led.reserve("t", "r1", 1.0, 0.0)
+        assert led.remaining("t").epsilon == pytest.approx(4.0)
+
+    def test_released_id_may_retry_as_fresh_debit(self, tmp_path):
+        """A released debit was refunded (clean pre-release failure):
+        the retry is a fresh debit at the NEW amounts, overdraw-checked
+        like any other."""
+        led = TenantBudgetLedger(str(tmp_path))
+        led.open_tenant("t", 2.0, 0.0)
+        led.reserve("t", "r1", 1.5, 0.0)
+        led.release("t", "r1")
+        lease = led.reserve("t", "r1", 1.0, 0.0)
+        assert lease.epsilon == 1.0 and lease.state == "reserved"
+        assert led.remaining("t").epsilon == pytest.approx(1.0)
+        assert len(led.debits("t")) == 1
+
+    def test_overdraw_refused_without_writing(self, tmp_path):
+        led = TenantBudgetLedger(str(tmp_path))
+        led.open_tenant("t", 1.0, 1e-8)
+        before = open(led.path_for("t"), "rb").read()
+        with pytest.raises(Overdraw) as ei:
+            led.reserve("t", "r1", 3.0, 0.0)
+        assert ei.value.shortfall.epsilon == pytest.approx(2.0)
+        assert "shortfall" in str(ei.value)
+        assert open(led.path_for("t"), "rb").read() == before
+        assert led.remaining("t").epsilon == pytest.approx(1.0)
+
+    def test_reserved_but_uncommitted_stays_spent_on_replay(
+            self, tmp_path):
+        """The kill-mid-request window: a reserve with no commit and
+        no release must count as SPENT after restart (noise may have
+        been drawn) — the DP-conservative direction."""
+        led = TenantBudgetLedger(str(tmp_path))
+        led.open_tenant("t", 2.0, 0.0)
+        led.reserve("t", "dead", 1.5, 0.0)
+        led2 = TenantBudgetLedger(str(tmp_path))
+        assert led2.remaining("t").epsilon == pytest.approx(0.5)
+        assert led2.debits("t")["dead"]["state"] == "reserved"
+
+    def test_release_refunds_clean_failures(self, tmp_path):
+        led = TenantBudgetLedger(str(tmp_path))
+        led.open_tenant("t", 2.0, 0.0)
+        led.reserve("t", "r1", 1.5, 0.0)
+        led.release("t", "r1")
+        assert led.remaining("t").epsilon == pytest.approx(2.0)
+        # A committed debit can never be released back.
+        led.reserve("t", "r2", 1.0, 0.0)
+        led.commit("t", "r2")
+        with pytest.raises(serve.LedgerError):
+            led.release("t", "r2")
+
+    def test_totals_mismatch_refused(self, tmp_path):
+        led = TenantBudgetLedger(str(tmp_path))
+        led.open_tenant("t", 2.0, 0.0)
+        led.open_tenant("t", 2.0, 0.0)  # idempotent re-open
+        with pytest.raises(TenantMismatch):
+            TenantBudgetLedger(str(tmp_path)).open_tenant("t", 3.0, 0.0)
+
+
+# ---------------------------------------------------------------------
+# the resident service
+# ---------------------------------------------------------------------
+
+
+class TestServiceAcceptance:
+
+    def test_three_tenants_interleaved_warm_no_new_compiles(
+            self, tmp_path, monkeypatch):
+        """>= 3 tenants' requests interleave through one resident
+        service; each tenant's SECOND same-signature request is a warm
+        registry hit and — with the cost observatory watching every
+        jitted entry — captures zero new ``compile.program`` spans."""
+        monkeypatch.setenv("PIPELINEDP_TPU_COSTS", "1")
+        tenants = {f"t{i}": (10.0, 1e-6) for i in range(3)}
+        ds = make_ds()
+        with serve.Service(str(tmp_path / "svc"),
+                           tenants=tenants) as svc:
+            first = {}
+            for tenant in tenants:  # round 1: cold registry builds
+                ds.invalidate_cache()
+                out = svc.submit(request(tenant, ds, eps=1.0))
+                assert out.ok, out
+                assert out.warm is False
+                first[tenant] = dict(out.results)
+            captured = obs.ledger().snapshot()["counters"].get(
+                "cost.programs_captured", 0)
+            for tenant in tenants:  # round 2: warm, zero new programs
+                ds.invalidate_cache()
+                out = svc.submit(request(tenant, ds, eps=1.0))
+                assert out.ok, out
+                assert out.warm is True
+                # Same seed + same data -> the warm program replays
+                # the identical release.
+                assert dict(out.results) == first[tenant]
+                assert out.remaining.epsilon == pytest.approx(8.0)
+            after = obs.ledger().snapshot()["counters"].get(
+                "cost.programs_captured", 0)
+            assert after == captured, (
+                "second same-signature requests captured new "
+                "compile.program spans")
+
+    def test_overdraw_refused_before_any_compute(self, tmp_path):
+        ds = make_ds()
+        with serve.Service(str(tmp_path / "svc"),
+                           tenants={"t": (1.0, 1e-8)}) as svc:
+            out = svc.submit(request("t", ds, eps=5.0))
+            assert not out.ok
+            assert out.reason == "overdraw"
+            assert "shortfall" in out.detail
+            assert out.remaining.epsilon == pytest.approx(1.0)
+            counters = obs.ledger().snapshot()["counters"]
+            # Nothing ran: no engine was ever built for the request.
+            assert counters.get("serve.cold_builds", 0) == 0
+            assert counters.get("serve.requests_admitted", 0) == 0
+            # And the durable ledger still holds the full budget.
+            assert svc.budgets.remaining("t").epsilon == pytest.approx(
+                1.0)
+
+    def test_serve_path_bit_identical_to_direct_engine(self, tmp_path):
+        """PARITY row 34: same params, data and seed through the
+        resident service and through a hand-built DPEngine release
+        bit-identical outputs — twice, so the WARM program is also in
+        scope."""
+        ds = make_ds(seed=3)
+        params = count_params()
+        with serve.Service(str(tmp_path / "svc"),
+                           tenants={"t": (10.0, 1e-6)}) as svc:
+            served = []
+            for _ in range(2):
+                ds.invalidate_cache()
+                out = svc.submit(request("t", ds, eps=0.8, delta=1e-8,
+                                         seed=11, params=params))
+                assert out.ok, out
+                served.append(dict(out.results))
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=0.8,
+                                        total_delta=1e-8)
+        engine = pdp.DPEngine(acc, JaxBackend(rng_seed=11))
+        ds.invalidate_cache()
+        res = engine.aggregate(ds, params, pdp.DataExtractors())
+        acc.compute_budgets()
+        direct = dict(res)
+        assert served[0] == direct
+        assert served[1] == direct
+
+    def test_malformed_refusals(self, tmp_path):
+        ds = make_ds()
+        with serve.Service(str(tmp_path / "svc"),
+                           tenants={"t": (5.0, 1e-6)}) as svc:
+            bad_params = svc.submit(serve.ServeRequest(
+                tenant="t", params="not-params", dataset=ds,
+                epsilon=1.0))
+            assert bad_params.reason == "malformed"
+            empty = svc.submit(request("t", pdp.ArrayDataset(
+                privacy_ids=np.array([], dtype=np.int64),
+                partition_keys=np.array([], dtype=np.int64),
+                values=np.array([]))))
+            assert empty.reason == "malformed"
+            unknown = svc.submit(request("ghost", ds))
+            assert unknown.reason == "malformed"
+            nonpos = svc.submit(request("t", ds, eps=0.0))
+            assert nonpos.reason == "malformed"
+            # None of it burned budget.
+            assert svc.budgets.remaining("t").epsilon == pytest.approx(
+                5.0)
+
+    def test_duplicate_request_id_refused_after_success(self, tmp_path):
+        """Resubmitting a SERVED request id is a structured
+        'duplicate' refusal — never a silent second release."""
+        ds = make_ds(n=800, parts=4)
+        with serve.Service(str(tmp_path / "svc"),
+                           tenants={"t": (5.0, 1e-6)}) as svc:
+            first = svc.submit(request("t", ds, eps=1.0, rid="dup"))
+            assert first.ok
+            again = svc.submit(request("t", ds, eps=1.0, rid="dup"))
+            assert not again.ok and again.reason == "duplicate"
+            assert svc.budgets.remaining("t").epsilon == pytest.approx(
+                4.0)
+
+    def test_engine_error_releases_the_reserve(self, tmp_path):
+        """A request that fails CLEANLY inside the engine (no DP
+        output ever existed) refunds its reserve and comes back as a
+        structured 'error' refusal."""
+        # Rows that no extractor can pull apart: AggregateParams
+        # validation passes at admission, but the engine's own checks
+        # reject the request once the worker runs it.
+        broken_rows = [1, 2, 3]
+        with serve.Service(str(tmp_path / "svc"),
+                           tenants={"t": (5.0, 1e-6)}) as svc:
+            out = svc.submit(request("t", broken_rows, eps=1.0))
+            assert not out.ok and out.reason == "error"
+            assert svc.budgets.remaining("t").epsilon == pytest.approx(
+                5.0)
+            assert svc.budgets.debits("t")[out.request_id][
+                "state"] == "released"
+
+    def test_queue_full_and_tenant_busy_backpressure(self, tmp_path,
+                                                     monkeypatch):
+        """Admission control under load: a gated worker holds the one
+        queue slot + the in-flight cap, and further submits come back
+        as structured queue_full / tenant_busy refusals — budget
+        untouched."""
+        gate = threading.Event()
+        started = threading.Event()
+        real_execute = serve.Service._execute
+
+        def gated_execute(self, pending):
+            started.set()
+            gate.wait(timeout=30)
+            real_execute(self, pending)
+
+        monkeypatch.setattr(serve.Service, "_execute", gated_execute)
+        ds = make_ds(n=800, parts=4)
+        with serve.Service(str(tmp_path / "svc"),
+                           tenants={"a": (50.0, 1e-5),
+                                    "b": (50.0, 1e-5),
+                                    "c": (50.0, 1e-5)},
+                           max_queue=1, max_inflight_per_tenant=1,
+                           workers=1) as svc:
+            outs = {}
+
+            def bg(name, req):
+                outs[name] = svc.submit(req)
+
+            t1 = threading.Thread(target=bg, args=(
+                "first", request("a", ds, eps=1.0)))
+            t1.start()
+            assert started.wait(timeout=30)
+            # Worker busy with tenant a; same tenant again -> the
+            # per-tenant in-flight cap refuses first.
+            busy = svc.submit(request("a", ds, eps=1.0))
+            assert busy.reason == "tenant_busy"
+            # Another tenant fills the one queue slot...
+            t2 = threading.Thread(target=bg, args=(
+                "second", request("b", ds, eps=1.0)))
+            t2.start()
+            deadline = [svc._q.full()]
+            for _ in range(500):
+                if deadline[-1]:
+                    break
+                threading.Event().wait(0.01)
+                deadline.append(svc._q.full())
+            assert deadline[-1], "queued request never landed"
+            # ...so a THIRD tenant sees pure queue-full backpressure
+            # (its own in-flight count is zero).
+            full = svc.submit(request("c", ds, eps=1.0))
+            assert full.reason == "queue_full"
+            gate.set()
+            t1.join(timeout=60)
+            t2.join(timeout=60)
+            assert outs["first"].ok and outs["second"].ok
+            # Refused requests burned nothing; served ones debited.
+            assert svc.budgets.remaining("a").epsilon == pytest.approx(
+                49.0)
+            assert svc.budgets.remaining("b").epsilon == pytest.approx(
+                49.0)
+            assert svc.budgets.remaining("c").epsilon == pytest.approx(
+                50.0)
+
+    def test_shutdown_refusal_after_close(self, tmp_path):
+        svc = serve.Service(str(tmp_path / "svc"),
+                            tenants={"t": (5.0, 1e-6)})
+        ds = make_ds(n=500, parts=4)
+        first = svc.submit(request("t", ds, eps=1.0))
+        assert first.ok
+        svc.close()
+        out = svc.submit(request("t", ds, eps=1.0))
+        assert not out.ok and out.reason == "shutdown"
+        svc.close()  # idempotent
+
+
+# ---------------------------------------------------------------------
+# concurrent overdraw + kill-and-restart (satellite 3)
+# ---------------------------------------------------------------------
+
+
+class TestConcurrentOverdraw:
+
+    def test_racing_submits_exactly_one_debit_and_restart_replay(
+            self, tmp_path):
+        """Two threads race submit() against one tenant whose budget
+        covers only ONE request: exactly one succeeds, the refusal
+        names the shortfall, and after a kill-and-restart the durable
+        ledger replays to exactly one debit."""
+        ds = make_ds(n=1_000, parts=4)
+        ledger_dir = str(tmp_path / "svc")
+        with serve.Service(ledger_dir,
+                           tenants={"t": (1.0, 1e-7)},
+                           workers=2) as svc:
+            barrier = threading.Barrier(2)
+            outs = [None, None]
+
+            def racer(i):
+                req = request("t", ds, eps=0.8, delta=1e-8,
+                              rid=f"race-{i}")
+                barrier.wait(timeout=30)
+                outs[i] = svc.submit(req)
+
+            threads = [threading.Thread(target=racer, args=(i,))
+                       for i in range(2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            oks = [o for o in outs if o.ok]
+            refusals = [o for o in outs if not o.ok]
+            assert len(oks) == 1 and len(refusals) == 1
+            assert refusals[0].reason == "overdraw"
+            assert "shortfall" in refusals[0].detail
+            assert refusals[0].remaining.epsilon <= 0.2 + 1e-9
+        # Kill-and-restart: the durable per-tenant ledger replays to
+        # the SAME remaining (eps, delta), with exactly one debit.
+        led = TenantBudgetLedger(os.path.join(ledger_dir, "budgets"))
+        debits = led.debits("t")
+        assert len(debits) == 1
+        (debit,) = debits.values()
+        assert debit["state"] == "committed"
+        assert led.remaining("t").epsilon == pytest.approx(0.2)
+        # And a restarted SERVICE over the same books agrees.
+        with serve.Service(ledger_dir,
+                           tenants={"t": (1.0, 1e-7)}) as svc2:
+            again = svc2.submit(request("t", ds, eps=0.8, delta=1e-8))
+            assert not again.ok and again.reason == "overdraw"
+
+    def test_kill_mid_request_leaves_reserve_spent(self, tmp_path):
+        """The faults seam kills request 0 between reserve and commit
+        (the process-death window): the caller sees the crash, the
+        reserve is neither committed nor released, and a restarted
+        service counts it as spent."""
+        ds = make_ds(n=1_000, parts=4)
+        ledger_dir = str(tmp_path / "svc")
+        with faults.injected_faults(
+                faults.FaultPlan(fail_serve_requests=(0,))):
+            with serve.Service(ledger_dir,
+                               tenants={"t": (1.0, 0.0)}) as svc:
+                with pytest.raises(faults.ServeKill):
+                    svc.submit(request("t", ds, eps=0.8, delta=0.0,
+                                       rid="killed"))
+        led = TenantBudgetLedger(os.path.join(ledger_dir, "budgets"))
+        assert led.debits("t")["killed"]["state"] == "reserved"
+        assert led.remaining("t").epsilon == pytest.approx(0.2)
+        # Restarted service: the dead request's budget stays spent, so
+        # a same-size follow-up is refused...
+        with serve.Service(ledger_dir, tenants={"t": (1.0, 0.0)}) as s2:
+            out = s2.submit(request("t", ds, eps=0.8, delta=0.0))
+            assert not out.ok and out.reason == "overdraw"
+            # ...and a RETRY of the killed id dedupes onto the
+            # existing debit instead of double-spending.
+            lease = s2.budgets.reserve("t", "killed", 0.8, 0.0)
+            assert lease.epsilon == 0.8
+            assert len(s2.budgets.debits("t")) == 1
+
+
+# ---------------------------------------------------------------------
+# per-tenant books + live-request heartbeat
+# ---------------------------------------------------------------------
+
+
+class TestBooksAndHeartbeat:
+
+    def test_books_appended_under_each_tenant(self, tmp_path):
+        ds = make_ds(n=1_000, parts=4)
+        with serve.Service(str(tmp_path / "svc"),
+                           tenants={"a": (5.0, 1e-6),
+                                    "b": (5.0, 1e-6)}) as svc:
+            ra = svc.submit(request("a", ds, eps=1.0))
+            ds.invalidate_cache()
+            rb = svc.submit(request("b", ds, eps=1.0))
+            refused = svc.submit(request("a", ds, eps=99.0))
+            assert ra.ok and rb.ok and refused.reason == "overdraw"
+            for tenant, resp in (("a", ra), ("b", rb)):
+                path = os.path.join(svc.books_dir(tenant),
+                                    "run_ledger.jsonl")
+                entries = [json.loads(line) for line in
+                           open(path, encoding="utf-8")]
+                served = [e for e in entries
+                          if e["name"] == "serve.request"]
+                assert len(served) == 1
+                book = served[0]["payload"]["serve"]
+                assert book["tenant"] == tenant
+                assert book["request_id"] == resp.request_id
+                assert book["audit"]["books"]["tenant"] == tenant
+                assert book["remaining_epsilon"] == pytest.approx(4.0)
+            refusals = [json.loads(line) for line in
+                        open(os.path.join(svc.books_dir("a"),
+                                          "run_ledger.jsonl"),
+                             encoding="utf-8")
+                        if json.loads(line)["name"] == "serve.refusal"]
+            assert refusals and refusals[0]["payload"]["serve"][
+                "reason"] == "overdraw"
+
+    def test_heartbeat_snapshots_all_live_requests_one_document(
+            self, tmp_path):
+        """The monitor satellite: a resident process's heartbeat names
+        EVERY live request (tenant + phase) in one document, at a
+        run-namespaced path — no per-request clobbering."""
+        clk = FakeClock()
+        mon = obs_monitor.Monitor(
+            clock=clk, interval_s=1.0, stall_s=60.0,
+            heartbeat_path=str(tmp_path / "hb.json"),
+            run_name="svc").start_inline()
+        try:
+            obs_monitor.register_request("r1", tenant="a",
+                                         phase="queued")
+            obs_monitor.register_request("r2", tenant="b",
+                                         phase="running")
+            obs_monitor.update_request("r1", phase="running")
+            hb = mon.poll_once()
+            reqs = {r["request_id"]: r for r in hb["requests"]}
+            assert set(reqs) == {"r1", "r2"}
+            assert reqs["r1"]["tenant"] == "a"
+            assert reqs["r1"]["phase"] == "running"
+            on_disk = json.load(open(mon.heartbeat_path,
+                                     encoding="utf-8"))
+            assert len(on_disk["requests"]) == 2
+            obs_monitor.unregister_request("r1")
+            obs_monitor.unregister_request("r2")
+            hb = mon.poll_once()
+            assert "requests" not in hb
+        finally:
+            obs_monitor.reset_requests()
+            from pipelinedp_tpu.obs.tracer import ACTIVITY
+            ACTIVITY.reset(enabled=False)
+
+    def test_heartbeat_path_namespaced_by_run(self, monkeypatch,
+                                              tmp_path):
+        monkeypatch.setenv("PIPELINEDP_TPU_LEDGER_DIR",
+                           str(tmp_path / "led"))
+        monkeypatch.delenv(obs_monitor.ENV_VAR, raising=False)
+        dest = obs_monitor.heartbeat_destination(run="bench-7")
+        assert dest.endswith(os.path.join("led",
+                                          "heartbeat-bench-7.json"))
+        # Unsafe characters in a run name never escape the directory.
+        weird = obs_monitor.heartbeat_destination(run="a/../b c")
+        assert os.path.dirname(weird) == str(tmp_path / "led")
+        # Explicit env paths still win verbatim.
+        monkeypatch.setenv(obs_monitor.ENV_VAR,
+                           str(tmp_path / "x.json"))
+        assert obs_monitor.heartbeat_destination(
+            run="r") == str(tmp_path / "x.json")
+        mon = obs_monitor.Monitor(clock=FakeClock(), run_name="r7")
+        assert mon.heartbeat_path == str(tmp_path / "x.json")
+        monkeypatch.delenv(obs_monitor.ENV_VAR)
+        mon = obs_monitor.Monitor(clock=FakeClock(), run_name="r7")
+        assert mon.heartbeat_path.endswith("heartbeat-r7.json")
+
+
+# ---------------------------------------------------------------------
+# the noserve lint, AST-precise (twin of ``make noserve``)
+# ---------------------------------------------------------------------
+
+
+def _walk_library_files(skip_prefixes=()):
+    root = os.path.join(REPO, "pipelinedp_tpu")
+    for dirpath, _, files in os.walk(root):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, REPO).replace(os.sep, "/")
+            if any(rel.startswith(p) for p in skip_prefixes):
+                continue
+            yield path, rel
+
+
+class TestNoServeLint:
+
+    def test_serve_imports_confined_to_serve_package(self):
+        """Batch-engine modules must never import the serve layer:
+        the service depends on the engine, never the reverse — batch
+        mode stays byte-for-byte oblivious to serving."""
+        offenders = []
+        for path, rel in _walk_library_files(
+                skip_prefixes=("pipelinedp_tpu/serve/",)):
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=rel)
+            for node in ast.walk(tree):
+                names = []
+                if isinstance(node, ast.ImportFrom) and node.module:
+                    names = [node.module] + [
+                        f"{node.module}.{a.name}" for a in node.names]
+                elif isinstance(node, ast.Import):
+                    names = [a.name for a in node.names]
+                if any(n == "pipelinedp_tpu.serve" or
+                       n.startswith("pipelinedp_tpu.serve.")
+                       for n in names):
+                    offenders.append(f"{rel}:{node.lineno}")
+        assert not offenders, (
+            "serve import outside pipelinedp_tpu/serve/ — the batch "
+            "engine must not depend on the service layer:\n" +
+            "\n".join(offenders))
+
+    def test_budget_ledger_writes_confined(self):
+        """Durable budget-ledger state has exactly one writer stack:
+        ``serve/`` (plus the accountant module it lifts state from).
+        Constructing the ledger — or reaching for its atomic-write
+        helper with a budget file — anywhere else would scatter
+        spend-tracking across the tree."""
+        allowed = ("pipelinedp_tpu/serve/",)
+        offenders = []
+        for path, rel in _walk_library_files(skip_prefixes=allowed):
+            if rel == "pipelinedp_tpu/budget_accounting.py":
+                continue
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=rel)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                name = (fn.id if isinstance(fn, ast.Name)
+                        else fn.attr if isinstance(fn, ast.Attribute)
+                        else None)
+                if name == "TenantBudgetLedger":
+                    offenders.append(f"{rel}:{node.lineno}")
+        assert not offenders, (
+            "budget-ledger construction outside pipelinedp_tpu/serve/ "
+            "+ budget_accounting.py:\n" + "\n".join(offenders))
