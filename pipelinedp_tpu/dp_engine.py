@@ -92,6 +92,15 @@ class DPEngine:
         if reset_reports:
             self._report_generators = []
 
+    def clear_budget_accountant(self) -> None:
+        """Resident-service seam, failure path: drop a half-run
+        accountant (registered mechanisms, never finalized) so the
+        warm engine is rebindable again — a same-signature request
+        already holding this engine must be served on a fresh
+        accountant, not refused over the failed request's leftovers.
+        The ledger-side refund/keep decision belongs to the caller."""
+        self._budget_accountant = None
+
     @property
     def _current_report_generator(self):
         return self._report_generators[-1]

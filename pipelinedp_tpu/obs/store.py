@@ -257,6 +257,12 @@ _env_cache: Dict[Any, Dict[str, Any]] = {}
 #: process-wide cursor would let tenant A's append swallow the records
 #: tenant B's next entry still needs.
 _report_cursors: Dict[str, Dict[str, Any]] = {}
+#: One lock per resolved directory serializing the cursor's
+#: read-delta-append-advance cycle: concurrent producers (the serve
+#: workers) racing a lock-free cursor would persist the same records
+#: twice — exactly the duplication the cursor exists to prevent.
+_report_locks: Dict[str, threading.Lock] = {}
+_report_locks_guard = threading.Lock()
 
 
 def _cursor_for(directory: str) -> Dict[str, Any]:
@@ -266,6 +272,16 @@ def _cursor_for(directory: str) -> Dict[str, Any]:
         cur = {"audit": None, "events": 0}
         _report_cursors[key] = cur
     return cur
+
+
+def _report_lock_for(directory: str) -> threading.Lock:
+    key = os.path.abspath(directory)
+    with _report_locks_guard:
+        lock = _report_locks.get(key)
+        if lock is None:
+            lock = threading.Lock()
+            _report_locks[key] = lock
+        return lock
 
 
 def reset_run_report_cursor() -> None:
@@ -594,9 +610,11 @@ def maybe_append_run_report(name: str,
     counters/span rollups stay whole: they are fixed-size). A request
     that added nothing appends nothing. ``mesh`` keys the entry's
     fingerprint on the mesh shape actually used. ``directory`` pins
-    the store outright (the serve layer's per-tenant books — the env
-    var must not reroute one tenant's entries into another's ledger);
-    without it the usual ``ledger_dir`` resolution applies. No-op
+    the store outright, for embedders that must not let the env var
+    reroute entries (the serve layer's per-tenant books use their own
+    ``LedgerStore`` appends; engine-run reports during a serve request
+    still land in the process's obs ledger via the default
+    resolution); without it the usual ``ledger_dir`` applies. No-op
     (returns None) when no ledger directory resolves, and swallows
     every failure: the store must never take an aggregation down."""
     try:
@@ -610,36 +628,43 @@ def maybe_append_run_report(name: str,
             env = obs.environment_fingerprint(mesh=mesh)
             _env_cache[mesh_key] = env
         report = obs.build_run_report(mesh=mesh, env=env)
-        cursor = _cursor_for(directory)
-        audit_since = dict(cursor["audit"] or {})
-        report["privacy"] = obs.audit.build_privacy_section(
-            counters=report.get("counters", {}), since=audit_since)
-        events = report.get("events", [])
-        ev_start = min(int(cursor["events"]), len(events))
-        report["events"] = events[ev_start:]
-        priv = report["privacy"]
-        if not (priv["accountants"] or priv["aggregations"] or
-                priv["expected_errors"] or report["events"]):
-            return None
-        if extra:
-            report.update(extra)
-        store = _proc_stores.get(directory)
-        if store is None:
-            store = LedgerStore(directory)
-            _proc_stores[directory] = store
-        entry = store.append(name, {"run_report": report, "env": env},
-                             env=env)
-        # Advance by exactly what this entry carried — concurrent
-        # producers appending mid-build land in the next entry.
-        cursor["audit"] = {
-            "accountants": audit_since.get("accountants", 0) +
-            len(priv["accountants"]),
-            "aggregations": audit_since.get("aggregations", 0) +
-            len(priv["aggregations"]),
-            "expected_errors": audit_since.get("expected_errors", 0) +
-            len(priv["expected_errors"]),
-        }
-        cursor["events"] = len(events)
+        # The cursor's read -> delta -> append -> advance cycle is
+        # atomic per directory: two concurrent producers on one store
+        # must not both carry the same not-yet-persisted records.
+        with _report_lock_for(directory):
+            cursor = _cursor_for(directory)
+            audit_since = dict(cursor["audit"] or {})
+            report["privacy"] = obs.audit.build_privacy_section(
+                counters=report.get("counters", {}), since=audit_since)
+            events = report.get("events", [])
+            ev_start = min(int(cursor["events"]), len(events))
+            report["events"] = events[ev_start:]
+            priv = report["privacy"]
+            if not (priv["accountants"] or priv["aggregations"] or
+                    priv["expected_errors"] or report["events"]):
+                return None
+            if extra:
+                report.update(extra)
+            store = _proc_stores.get(directory)
+            if store is None:
+                store = LedgerStore(directory)
+                _proc_stores[directory] = store
+            entry = store.append(name, {"run_report": report, "env": env},
+                                 env=env)
+            # Advance by exactly what this entry carried — concurrent
+            # producers building mid-append land in the next entry.
+            cursor["audit"] = {
+                "accountants": audit_since.get("accountants", 0) +
+                len(priv["accountants"]),
+                "aggregations": audit_since.get("aggregations", 0) +
+                len(priv["aggregations"]),
+                "expected_errors": audit_since.get("expected_errors", 0) +
+                len(priv["expected_errors"]),
+            }
+            # max(): a producer whose snapshot predates a concurrent
+            # append must never move the cursor BACKWARDS — that would
+            # re-persist events a later entry already carried.
+            cursor["events"] = max(int(cursor["events"]), len(events))
         return entry
     except Exception:
         return None

@@ -23,7 +23,11 @@ restarts. This module is that half:
   request id — a second ``reserve()`` for the same id returns the
   existing lease instead of double-debiting, and per-tenant locks
   serialize the read-modify-write so two racing requests can never
-  both fit into one remaining slice.
+  both fit into one remaining slice. The dedup lease exists for
+  RESTART REPLAY (a retry of a request the dead process never
+  finished); while the original is still live in-process, the serve
+  layer refuses the duplicate at admission — handing the retry a
+  lease there would let one charge release two noisy views.
 
 The per-request accountant then simply takes the leased (eps, delta)
 as its totals — the accountant by construction distributes exactly
@@ -109,6 +113,12 @@ class BudgetLease:
     #: "reserved" on a fresh grant; the prior state when ``reserve``
     #: deduplicated an id it had already seen (exactly-once).
     state: str = "reserved"
+    #: True when this lease dedups onto a debit reserved BEFORE this
+    #: reserve call (restart replay). A replayed lease must NEVER be
+    #: refunded on a clean failure: the ORIGINAL attempt may already
+    #: have drawn noise before the process died, so the conservative
+    #: direction is to leave the debit spent.
+    replayed: bool = False
 
 
 def tenant_slug(tenant: str) -> str:
@@ -163,6 +173,11 @@ class TenantBudgetLedger:
         return state
 
     def _write(self, tenant: str, state: Dict[str, Any]) -> None:
+        """Durably write ``state``, then install it as the cached
+        document. Callers pass a NEW doc (never the cached one mutated
+        in place), so a failed write — disk full, I/O error — leaves
+        the cache on the last durable doc and memory never diverges
+        from disk."""
         atomic_write_json(self.path_for(tenant), state)
         self._states[tenant] = state
 
@@ -217,6 +232,14 @@ class TenantBudgetLedger:
         return Budget(state["total_epsilon"] - spent.epsilon,
                       state["total_delta"] - spent.delta)
 
+    def has_tenant(self, tenant: str) -> bool:
+        """Whether the tenant has a ledger here (cache or disk). An
+        advisory, lock-free check: refusal bookkeeping uses it so
+        garbage tenant names never grow books directories — or even
+        per-tenant lock entries here."""
+        return tenant in self._states or os.path.isfile(
+            self.path_for(tenant))
+
     def remaining(self, tenant: str) -> Budget:
         """The tenant's remaining (eps, delta) — totals minus every
         reserved/committed debit, replayed from disk if needed."""
@@ -256,17 +279,35 @@ class TenantBudgetLedger:
                                     f"under {self.directory}")
             existing = state["debits"].get(str(request_id))
             if existing is not None and existing["state"] == "reserved":
-                # Exactly-once: the debit already happened (possibly
-                # before a restart that killed the request mid-compute);
-                # hand back the same lease. A retry that wants
-                # bit-identical replay must carry a fixed rng_seed —
-                # the same discipline the checkpoint store documents.
+                # Exactly-once restart replay: the debit already
+                # happened before a restart (or kill) took the request
+                # down mid-compute; hand back the same lease. The
+                # serve layer refuses an id whose original is still
+                # live IN-PROCESS before ever reaching here. A retry
+                # that wants bit-identical replay must carry a fixed
+                # rng_seed — the same discipline the checkpoint store
+                # documents.
+                if (float(existing["epsilon"]) != float(epsilon) or
+                        float(existing["delta"]) != float(delta)):
+                    # A replay must carry the ORIGINAL demand: handing
+                    # the old lease to a retry that asked for different
+                    # amounts would silently run it under amounts the
+                    # caller never requested.
+                    raise LedgerError(
+                        f"tenant '{tenant}' request '{request_id}' is "
+                        f"already reserved at (eps="
+                        f"{existing['epsilon']}, delta="
+                        f"{existing['delta']}); a replay retry must "
+                        f"carry those amounts, not (eps={epsilon}, "
+                        f"delta={delta}) — use a fresh request id for "
+                        "a different demand")
                 obs.inc("serve.budget_reserve_dedups")
                 return BudgetLease(tenant=str(tenant),
                                    request_id=str(request_id),
                                    epsilon=float(existing["epsilon"]),
                                    delta=float(existing["delta"]),
-                                   state=str(existing["state"]))
+                                   state=str(existing["state"]),
+                                   replayed=True)
             if existing is not None and existing["state"] == "committed":
                 # The id's output was already RELEASED: re-running it
                 # would publish a second noisy view on one charge.
@@ -292,10 +333,13 @@ class TenantBudgetLedger:
                 raise Overdraw(str(tenant), str(request_id),
                                Budget(float(epsilon), float(delta)),
                                remaining)
-            state["debits"][str(request_id)] = {
+            # Copy-on-write: mutate a fresh doc so a failed durable
+            # write leaves the cached doc untouched (see _write).
+            debits = {k: dict(v) for k, v in state["debits"].items()}
+            debits[str(request_id)] = {
                 "epsilon": float(epsilon), "delta": float(delta),
                 "state": "reserved"}
-            self._write(tenant, state)
+            self._write(tenant, dict(state, debits=debits))
             obs.inc("serve.budget_reserves")
             return BudgetLease(tenant=str(tenant),
                                request_id=str(request_id),
@@ -320,8 +364,11 @@ class TenantBudgetLedger:
                 raise LedgerError(
                     f"debit '{request_id}' is {debit['state']}, cannot "
                     f"move to {new_state} (only a reserve can)")
-            debit["state"] = new_state
-            self._write(tenant, state)
+            # Copy-on-write: mutate a fresh doc so a failed durable
+            # write leaves the cached doc untouched (see _write).
+            debits = {k: dict(v) for k, v in state["debits"].items()}
+            debits[str(request_id)]["state"] = new_state
+            self._write(tenant, dict(state, debits=debits))
 
     def commit(self, tenant: str, request_id: str) -> None:
         """Mark a reserve final — the request's DP output was released."""
@@ -332,7 +379,9 @@ class TenantBudgetLedger:
     def release(self, tenant: str, request_id: str) -> None:
         """Refund a reserve whose request failed CLEANLY before any DP
         output (or noise) existed. Never call this on a kill path —
-        a request that may have drawn noise stays spent."""
+        a request that may have drawn noise stays spent — nor for a
+        lease ``reserve()`` handed back with ``replayed=True``: the
+        pre-restart attempt may have drawn noise before dying."""
         self._transition(tenant, request_id, "released")
         from pipelinedp_tpu import obs
         obs.inc("serve.budget_releases")

@@ -45,6 +45,7 @@ from pipelinedp_tpu.budget_accounting import (Budget,
 from pipelinedp_tpu.dp_engine import DataExtractors, DPEngine
 from pipelinedp_tpu.serve.budget_ledger import (BudgetLease,
                                                 DuplicateRequest,
+                                                LedgerError,
                                                 Overdraw,
                                                 TenantBudgetLedger,
                                                 UnknownTenant,
@@ -173,8 +174,17 @@ class _Pending:
         self.seq = seq
         self.done = threading.Event()
         self.outcome: Optional[Tuple[str, Any]] = None
+        #: Set by the worker that picks this request up: frees the
+        #: in-flight slot and live id. Run by ``finish`` BEFORE the
+        #: submitter is unblocked — a caller whose submit() returned
+        #: must be able to resubmit the id (or fill the slot)
+        #: immediately, not race the worker's cleanup.
+        self.teardown: Optional[Any] = None
 
     def finish(self, kind: str, value: Any) -> None:
+        teardown, self.teardown = self.teardown, None
+        if teardown is not None:
+            teardown()
         self.outcome = (kind, value)
         self.done.set()
 
@@ -216,8 +226,18 @@ class Service:
         self._q: queue.Queue = queue.Queue(maxsize=self.max_queue)
         self._admit = threading.Lock()
         self._inflight: Dict[str, int] = {}
+        #: (tenant, request id) pairs currently live in THIS process
+        #: (admitted, not yet finished), guarded by ``_admit``. A
+        #: duplicate id is refused while its original is in flight —
+        #: the ledger's reserved-dedup lease is for restart replay
+        #: only, and without this guard a client retry racing its own
+        #: original would release two noisy views on one charge. Keyed
+        #: per tenant, like the ledger's debits: tenants never collide
+        #: on each other's ids.
+        self._live: set = set()
         self._registry: Dict[Tuple[str, str], _WarmEntry] = {}
         self._registry_lock = threading.Lock()
+        self._books_lock = threading.Lock()
         self._books_stores: Dict[str, Any] = {}
         self._env: Optional[Dict[str, Any]] = None
         self._seq = 0
@@ -274,16 +294,16 @@ class Service:
             except queue.Empty:
                 break
             tenant, rid = pending.lease.tenant, pending.lease.request_id
-            try:
-                self.budgets.release(tenant, rid)
-            except Exception:
-                obs.event("serve.release_failed", request_id=rid,
-                          tenant=tenant)
+            self._release_lease(pending.lease)
+            self._live.discard((tenant, rid))
             obs_monitor.unregister_request(rid)
             pending.finish("refusal", self._refuse(
                 rid, tenant, "shutdown",
                 "service closed before a worker picked this request "
-                "up; the reserve was refunded"))
+                "up; " + ("the replayed reserve stays spent (the "
+                          "pre-restart attempt may have drawn noise)"
+                          if pending.lease.replayed else
+                          "the reserve was refunded")))
         obs.event("serve.closed")
 
     def __enter__(self) -> "Service":
@@ -295,8 +315,8 @@ class Service:
     # --- admission control (caller thread; never any compute) ---
 
     def _validate(self, request: ServeRequest) -> Optional[str]:
-        if not isinstance(request, ServeRequest):
-            return f"expected ServeRequest, got {type(request).__name__}"
+        # submit() has already refused a non-ServeRequest before any
+        # attribute of it was touched.
         if not request.tenant or not isinstance(request.tenant, str):
             return "tenant must be a non-empty string"
         if not isinstance(request.params, AggregateParams):
@@ -322,10 +342,25 @@ class Service:
         contract: a request REFUSED here has spent nothing and run
         nothing (the overdraw check happens before any compute), and
         a request admitted here has its (eps, delta) durably reserved
-        before the queue ever sees it."""
+        before the queue ever sees it. A request id whose original is
+        still in flight is refused as 'duplicate' — admitting the
+        retry would let one durable debit release two noisy views."""
         from pipelinedp_tpu import obs
         from pipelinedp_tpu.obs import monitor as obs_monitor
-        rid = request.request_id or f"req-{uuid.uuid4().hex[:12]}"
+        if not isinstance(request, ServeRequest):
+            # Refuse before touching any attribute — a non-ServeRequest
+            # has no request_id/tenant to read.
+            return self._refuse(
+                f"req-{uuid.uuid4().hex[:12]}", "<unknown>", "malformed",
+                f"expected ServeRequest, got {type(request).__name__}")
+        # Normalized to str up front: the ledger stores str(request_id)
+        # in its leases, and _live teardown keys must match admission's.
+        # Only None/"" mean "absent" — a falsy id like 0 is a real id,
+        # and generating a fresh one for it would void exactly-once.
+        if request.request_id is None or request.request_id == "":
+            rid = f"req-{uuid.uuid4().hex[:12]}"
+        else:
+            rid = str(request.request_id)
         tenant = request.tenant
         if self._closed.is_set():
             return self._refuse(rid, tenant, "shutdown",
@@ -333,62 +368,125 @@ class Service:
         detail = self._validate(request)
         if detail is not None:
             return self._refuse(rid, tenant, "malformed", detail)
+        if not self.budgets.has_tenant(tenant):
+            # Before the tentative admission: a resident process must
+            # not grow per-tenant state (in-flight slots, ledger
+            # locks) for arbitrary unknown tenant names.
+            return self._refuse(
+                rid, tenant, "malformed",
+                f"tenant '{tenant}' has no ledger under "
+                f"{self.budgets.directory}; register_tenant first")
+        full_detail = (f"request queue is full ({self.max_queue} "
+                       "deep); back off and resubmit")
+        verdict: Optional[Tuple[str, str]] = None
         with self._admit:
             if self._closed.is_set():
-                return self._refuse(rid, tenant, "shutdown",
-                                    "service is draining; submit "
-                                    "refused")
-            inflight = self._inflight.get(tenant, 0)
-            if inflight >= self.max_inflight_per_tenant:
-                return self._refuse(
-                    rid, tenant, "tenant_busy",
-                    f"tenant '{tenant}' already has {inflight} "
-                    f"request(s) in flight (cap "
-                    f"{self.max_inflight_per_tenant})")
-            if self._q.full():
-                return self._refuse(
-                    rid, tenant, "queue_full",
-                    f"request queue is full ({self.max_queue} deep); "
-                    "back off and resubmit")
-            try:
-                lease = self.budgets.reserve(tenant, rid,
-                                             request.epsilon,
-                                             request.delta)
-            except Overdraw as e:
-                return self._refuse(
-                    rid, tenant, "overdraw",
-                    f"insufficient budget: requested {e.requested}, "
-                    f"remaining {e.remaining}, shortfall "
-                    f"{e.shortfall}", remaining=e.remaining)
-            except DuplicateRequest as e:
-                return self._refuse(rid, tenant, "duplicate", str(e))
-            except UnknownTenant as e:
-                return self._refuse(rid, tenant, "malformed", str(e))
-            pending = _Pending(request, lease, self._seq)
-            self._seq += 1
-            self._inflight[tenant] = inflight + 1
-            # Register BEFORE the enqueue: the worker's update/
-            # unregister must always follow the registration, or a
-            # fast completion would leave a phantom live request in
-            # every later heartbeat.
-            obs_monitor.register_request(rid, tenant=tenant,
-                                         phase="queued")
-            try:
-                self._q.put_nowait(pending)
-            except queue.Full:  # raced another admitter
-                self._inflight[tenant] = self._inflight[tenant] - 1
-                self.budgets.release(tenant, rid)
-                obs_monitor.unregister_request(rid)
-                return self._refuse(
-                    rid, tenant, "queue_full",
-                    f"request queue is full ({self.max_queue} deep); "
-                    "back off and resubmit")
+                verdict = ("shutdown",
+                           "service is draining; submit refused")
+            elif (tenant, rid) in self._live:
+                verdict = (
+                    "duplicate",
+                    f"request id '{rid}' is already in flight; one "
+                    "charge can never release two noisy views — wait "
+                    "for the original to finish or use a fresh id")
+            else:
+                inflight = self._inflight.get(tenant, 0)
+                if inflight >= self.max_inflight_per_tenant:
+                    verdict = (
+                        "tenant_busy",
+                        f"tenant '{tenant}' already has {inflight} "
+                        f"request(s) in flight (cap "
+                        f"{self.max_inflight_per_tenant})")
+                elif self._q.full():
+                    verdict = ("queue_full", full_detail)
+                else:
+                    # Tentative admission: hold the in-flight slot and
+                    # the live id while the durable (fsync'd) reserve
+                    # runs OUTSIDE the global lock — one tenant's disk
+                    # sync must not serialize every other tenant's
+                    # admission.
+                    self._inflight[tenant] = inflight + 1
+                    self._live.add((tenant, rid))
+        if verdict is not None:
+            return self._refuse(rid, tenant, *verdict)
+        try:
+            lease = self.budgets.reserve(tenant, rid, request.epsilon,
+                                         request.delta)
+        except Overdraw as e:
+            self._rollback_admission(tenant, rid)
+            return self._refuse(
+                rid, tenant, "overdraw",
+                f"insufficient budget: requested {e.requested}, "
+                f"remaining {e.remaining}, shortfall "
+                f"{e.shortfall}", remaining=e.remaining)
+        except DuplicateRequest as e:
+            self._rollback_admission(tenant, rid)
+            return self._refuse(rid, tenant, "duplicate", str(e))
+        except UnknownTenant as e:
+            self._rollback_admission(tenant, rid)
+            return self._refuse(rid, tenant, "malformed", str(e))
+        except LedgerError as e:
+            # e.g. a restart replay whose (eps, delta) do not match
+            # the reserved debit's amounts.
+            self._rollback_admission(tenant, rid)
+            return self._refuse(rid, tenant, "malformed", str(e))
+        except BaseException:
+            self._rollback_admission(tenant, rid)
+            raise
+        # Register BEFORE the enqueue: the worker's update/unregister
+        # must always follow the registration, or a fast completion
+        # would leave a phantom live request in every later heartbeat.
+        obs_monitor.register_request(rid, tenant=tenant, phase="queued")
+        with self._admit:
+            if self._closed.is_set():  # raced close()
+                verdict = ("shutdown",
+                           "service is draining; submit refused")
+            else:
+                pending = _Pending(request, lease, self._seq)
+                self._seq += 1
+                try:
+                    self._q.put_nowait(pending)
+                except queue.Full:  # raced another admitter
+                    verdict = ("queue_full", full_detail)
+        if verdict is not None:
+            # Release BEFORE the rollback drops the id from _live —
+            # see _release_lease for the dedup race this order closes.
+            self._release_lease(lease)
+            self._rollback_admission(tenant, rid)
+            obs_monitor.unregister_request(rid)
+            return self._refuse(rid, tenant, *verdict)
         obs.inc("serve.requests_admitted")
         pending.done.wait()
         kind, value = pending.outcome
         if kind == "raise":
             raise value
         return value
+
+    def _rollback_admission(self, tenant: str, rid: str) -> None:
+        """Undo a tentative admission: give back the in-flight slot
+        and the live request id."""
+        with self._admit:
+            self._inflight[tenant] = max(
+                0, self._inflight.get(tenant, 0) - 1)
+            self._live.discard((tenant, rid))
+
+    def _release_lease(self, lease: BudgetLease) -> None:
+        """Refund a reserve that failed cleanly before any DP output
+        existed — unless the lease is a restart replay, whose
+        pre-death attempt may have drawn noise: that debit stays
+        spent. Every caller MUST invoke this BEFORE removing the id
+        from ``_live``: released first, a same-id retry arriving in
+        between sees a 'released' debit and reserves fresh; removed
+        first, the retry would dedup onto the still-'reserved' debit
+        as a replayed lease whose budget this refund then yanks away."""
+        if lease.replayed:
+            return
+        from pipelinedp_tpu import obs
+        try:
+            self.budgets.release(lease.tenant, lease.request_id)
+        except Exception:
+            obs.event("serve.release_failed",
+                      request_id=lease.request_id, tenant=lease.tenant)
 
     def _refuse(self, rid: str, tenant: str, reason: str, detail: str,
                 remaining: Optional[Budget] = None) -> Refusal:
@@ -400,8 +498,11 @@ class Service:
         refusal = Refusal(request_id=rid, tenant=str(tenant),
                           reason=reason, detail=detail,
                           remaining=remaining)
-        self._append_books(str(tenant), "serve.refusal", {
-            "request_id": rid, "reason": reason, "detail": detail})
+        # Books only for tenants that exist: refusals naming garbage
+        # tenants must not grow directories/stores without bound.
+        if self.budgets.has_tenant(str(tenant)):
+            self._append_books(str(tenant), "serve.refusal", {
+                "request_id": rid, "reason": reason, "detail": detail})
         return refusal
 
     # --- the workers ---
@@ -414,6 +515,15 @@ class Service:
                 if self._stop.is_set():
                     return
                 continue
+            def _teardown(pending=pending):
+                with self._admit:
+                    tenant = pending.request.tenant
+                    self._inflight[tenant] = max(
+                        0, self._inflight.get(tenant, 0) - 1)
+                    self._live.discard((tenant,
+                                        pending.lease.request_id))
+
+            pending.teardown = _teardown
             try:
                 self._execute(pending)
             except BaseException as e:  # safety net: a worker must
@@ -423,10 +533,12 @@ class Service:
                 if not pending.done.is_set():
                     pending.finish("raise", e)
             finally:
-                with self._admit:
-                    tenant = pending.request.tenant
-                    self._inflight[tenant] = max(
-                        0, self._inflight.get(tenant, 0) - 1)
+                # finish() ran the teardown before unblocking the
+                # submitter; this residual only fires if _execute
+                # somehow exited without ever finishing the pending.
+                teardown, pending.teardown = pending.teardown, None
+                if teardown is not None:
+                    teardown()
 
     def _warm_entry(self, request: ServeRequest,
                     signature: str) -> Tuple[_WarmEntry, bool]:
@@ -470,34 +582,50 @@ class Service:
             entry, warm = self._warm_entry(request, signature)
             obs.inc("serve.warm_hits" if warm else "serve.cold_builds")
             with entry.lock:
-                # Per-request noise state on the resident backend: the
-                # engine reads ``backend.rng_seed`` at aggregate time,
-                # and the entry lock serializes same-key requests, so
-                # each request's noise stream is its own while the
-                # compiled program stays shared.
-                if hasattr(entry.backend, "rng_seed"):
-                    entry.backend.rng_seed = request.rng_seed
-                accountant = NaiveBudgetAccountant(
-                    total_epsilon=lease.epsilon,
-                    total_delta=lease.delta)
-                accountant.bind_books(tenant, rid)
-                entry.engine.rebind_budget_accountant(accountant)
-                extractors = (request.data_extractors
-                              if request.data_extractors is not None
-                              else DataExtractors())
-                with obs_audit.books_context(tenant, rid):
-                    with self._tr.span("serve.request", cat="serve",
-                                       tenant=tenant, warm=warm) as sp:
-                        result = entry.engine.aggregate(
-                            request.dataset, request.params, extractors,
-                            public_partitions=request.public_partitions)
-                        accountant.compute_budgets()
-                        results = list(result)
+                try:
+                    # Per-request noise state on the resident backend:
+                    # the engine reads ``backend.rng_seed`` at
+                    # aggregate time, and the entry lock serializes
+                    # same-key requests, so each request's noise
+                    # stream is its own while the compiled program
+                    # stays shared.
+                    if hasattr(entry.backend, "rng_seed"):
+                        entry.backend.rng_seed = request.rng_seed
+                    accountant = NaiveBudgetAccountant(
+                        total_epsilon=lease.epsilon,
+                        total_delta=lease.delta)
+                    accountant.bind_books(tenant, rid)
+                    entry.engine.rebind_budget_accountant(accountant)
+                    extractors = (request.data_extractors
+                                  if request.data_extractors is not None
+                                  else DataExtractors())
+                    with obs_audit.books_context(tenant, rid):
+                        with self._tr.span("serve.request", cat="serve",
+                                           tenant=tenant,
+                                           warm=warm) as sp:
+                            result = entry.engine.aggregate(
+                                request.dataset, request.params,
+                                extractors,
+                                public_partitions=(
+                                    request.public_partitions))
+                            accountant.compute_budgets()
+                            results = list(result)
+                except BaseException:
+                    # Heal BEFORE the lock releases: a same-signature
+                    # waiter may already hold this entry (fetched
+                    # before the failure dropped it from the registry)
+                    # and must rebind a fresh accountant, not be
+                    # refused over this request's half-run one.
+                    entry.engine.clear_budget_accountant()
+                    raise
         except faults.FaultInjected as e:
             # Hard kill: do NOT release — noise may have been drawn.
             # The submitting caller sees the crash; the durable ledger
             # keeps the reserved debit, exactly what a real process
-            # death leaves behind.
+            # death leaves behind. The warm slot IS dropped: its engine
+            # may hold a half-run accountant that would spuriously
+            # refuse the next same-signature request.
+            self._drop_entry(request, signature)
             obs.inc("serve.requests_killed")
             obs.event("serve.request_killed", request_id=rid,
                       tenant=tenant, error=repr(e))
@@ -508,13 +636,11 @@ class Service:
             # Clean failure before any DP release: refund the reserve
             # and refuse with the error — the engine slot is dropped
             # so half-run accountant state cannot leak into the next
-            # request.
+            # request. A REPLAYED lease is the exception: its
+            # pre-restart attempt may have drawn noise, so the debit
+            # stays spent even though this attempt failed cleanly.
             self._drop_entry(request, signature)
-            try:
-                self.budgets.release(tenant, rid)
-            except Exception:
-                obs.event("serve.release_failed", request_id=rid,
-                          tenant=tenant)
+            self._release_lease(lease)
             obs_monitor.unregister_request(rid)
             pending.finish("refusal", self._refuse(
                 rid, tenant, "error",
@@ -567,13 +693,19 @@ class Service:
         try:
             from pipelinedp_tpu import obs
             from pipelinedp_tpu.obs.store import LedgerStore
-            store = self._books_stores.get(tenant)
-            if store is None:
-                store = LedgerStore(self.books_dir(tenant))
-                self._books_stores[tenant] = store
-            if self._env is None:
-                self._env = obs.environment_fingerprint()
+            # Creation is serialized so each tenant gets exactly ONE
+            # LedgerStore instance (the store's one-lock-per-file
+            # contract); the append itself runs outside the lock —
+            # the store has its own.
+            with self._books_lock:
+                store = self._books_stores.get(tenant)
+                if store is None:
+                    store = LedgerStore(self.books_dir(tenant))
+                    self._books_stores[tenant] = store
+                if self._env is None:
+                    self._env = obs.environment_fingerprint()
+                env = self._env
             store.append(name, {"serve": dict(payload, tenant=tenant)},
-                         env=self._env)
+                         env=env)
         except Exception:
             pass

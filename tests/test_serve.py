@@ -122,6 +122,20 @@ class TestBudgetLedger:
         assert again.epsilon == 1.5 and again.state == "reserved"
         assert led.remaining("t").epsilon == pytest.approx(0.5)
 
+    def test_replay_retry_must_match_reserved_amounts(self, tmp_path):
+        """The restart-replay dedup hands back the original lease ONLY
+        to a retry carrying the original (eps, delta) — a different
+        demand under the same id must not silently run at amounts the
+        caller never asked for."""
+        led = TenantBudgetLedger(str(tmp_path))
+        led.open_tenant("t", 2.0, 0.0)
+        led.reserve("t", "r1", 1.5, 0.0)
+        with pytest.raises(serve.LedgerError, match="must carry"):
+            led.reserve("t", "r1", 0.5, 0.0)
+        # The refused mismatch touched nothing.
+        assert led.debits("t")["r1"]["epsilon"] == 1.5
+        assert led.remaining("t").epsilon == pytest.approx(0.5)
+
     def test_committed_id_refuses_re_reserve(self, tmp_path):
         """A committed debit's output was RELEASED: re-running the id
         would publish a second noisy view on one charge — refused."""
@@ -187,6 +201,38 @@ class TestBudgetLedger:
         led.open_tenant("t", 2.0, 0.0)  # idempotent re-open
         with pytest.raises(TenantMismatch):
             TenantBudgetLedger(str(tmp_path)).open_tenant("t", 3.0, 0.0)
+
+    def test_failed_durable_write_leaves_cache_on_disk_state(
+            self, tmp_path, monkeypatch):
+        """A durable-write failure (disk full, I/O error) must not
+        leave the in-memory cache ahead of disk: the exception
+        propagates AND the cached doc stays on the last durable state,
+        so memory and disk never diverge for the rest of the process."""
+        from pipelinedp_tpu.serve import budget_ledger as bl
+        led = TenantBudgetLedger(str(tmp_path))
+        led.open_tenant("t", 2.0, 0.0)
+        led.reserve("t", "r1", 0.5, 0.0)
+        real_write = bl.atomic_write_json
+
+        def full_disk(path, doc):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(bl, "atomic_write_json", full_disk)
+        with pytest.raises(OSError):
+            led.reserve("t", "r2", 0.5, 0.0)
+        with pytest.raises(OSError):
+            led.commit("t", "r1")
+        # In-memory state is exactly the last durable state...
+        assert led.remaining("t").epsilon == pytest.approx(1.5)
+        assert "r2" not in led.debits("t")
+        assert led.debits("t")["r1"]["state"] == "reserved"
+        # ...and a disk replay agrees with it to the byte.
+        monkeypatch.setattr(bl, "atomic_write_json", real_write)
+        assert TenantBudgetLedger(str(tmp_path)).debits(
+            "t") == led.debits("t")
+        # The healed ledger proceeds normally.
+        led.commit("t", "r1")
+        assert led.remaining("t").epsilon == pytest.approx(1.5)
 
 
 # ---------------------------------------------------------------------
@@ -278,6 +324,10 @@ class TestServiceAcceptance:
         ds = make_ds()
         with serve.Service(str(tmp_path / "svc"),
                            tenants={"t": (5.0, 1e-6)}) as svc:
+            not_a_request = svc.submit({"tenant": "t"})
+            assert not not_a_request.ok
+            assert not_a_request.reason == "malformed"
+            assert "ServeRequest" in not_a_request.detail
             bad_params = svc.submit(serve.ServeRequest(
                 tenant="t", params="not-params", dataset=ds,
                 epsilon=1.0))
@@ -289,6 +339,12 @@ class TestServiceAcceptance:
             assert empty.reason == "malformed"
             unknown = svc.submit(request("ghost", ds))
             assert unknown.reason == "malformed"
+            # Refusals naming unknown tenants never grow per-tenant
+            # state in a resident process: no books dir, no in-flight
+            # slot, no ledger lock entry.
+            assert not os.path.exists(svc.books_dir("ghost"))
+            assert "ghost" not in svc._inflight
+            assert "ghost" not in svc.budgets._tenant_locks
             nonpos = svc.submit(request("t", ds, eps=0.0))
             assert nonpos.reason == "malformed"
             # None of it burned budget.
@@ -305,6 +361,209 @@ class TestServiceAcceptance:
             assert first.ok
             again = svc.submit(request("t", ds, eps=1.0, rid="dup"))
             assert not again.ok and again.reason == "duplicate"
+            assert svc.budgets.remaining("t").epsilon == pytest.approx(
+                4.0)
+
+    def test_duplicate_request_id_refused_while_in_flight(
+            self, tmp_path, monkeypatch):
+        """A retry of an id whose ORIGINAL IS STILL RUNNING (a client
+        re-sending a slow request) is refused at admission — without
+        this, both copies would execute against the ledger's one
+        reserved debit and release two noisy views on one charge. The
+        ledger's reserved-dedup lease is for restart replay only."""
+        gate = threading.Event()
+        started = threading.Event()
+        real_execute = serve.Service._execute
+
+        def gated_execute(self, pending):
+            started.set()
+            gate.wait(timeout=30)
+            real_execute(self, pending)
+
+        monkeypatch.setattr(serve.Service, "_execute", gated_execute)
+        ds = make_ds(n=800, parts=4)
+        with serve.Service(str(tmp_path / "svc"),
+                           tenants={"t": (5.0, 1e-6)},
+                           workers=1) as svc:
+            outs = {}
+
+            def bg():
+                outs["first"] = svc.submit(
+                    request("t", ds, eps=1.0, rid="dup"))
+
+            t1 = threading.Thread(target=bg)
+            t1.start()
+            assert started.wait(timeout=30)
+            retry = svc.submit(request("t", ds, eps=1.0, rid="dup"))
+            assert not retry.ok and retry.reason == "duplicate"
+            assert "in flight" in retry.detail
+            gate.set()
+            t1.join(timeout=120)
+            assert outs["first"].ok
+            # Exactly one debit, one charge, one released output.
+            debits = svc.budgets.debits("t")
+            assert list(debits) == ["dup"]
+            assert debits["dup"]["state"] == "committed"
+            assert svc.budgets.remaining("t").epsilon == pytest.approx(
+                4.0)
+
+    def test_same_request_id_across_tenants_never_collides(
+            self, tmp_path, monkeypatch):
+        """The in-flight guard is scoped per tenant, like the ledger's
+        debits: tenant b reusing tenant a's request id (both clients
+        numbering their own requests) must be admitted, not refused as
+        a duplicate of a's still-running request."""
+        gate = threading.Event()
+        started = threading.Event()
+        real_execute = serve.Service._execute
+
+        def gated_execute(self, pending):
+            if pending.request.tenant == "a":
+                started.set()
+                gate.wait(timeout=30)
+            real_execute(self, pending)
+
+        monkeypatch.setattr(serve.Service, "_execute", gated_execute)
+        ds = make_ds(n=800, parts=4)
+        with serve.Service(str(tmp_path / "svc"),
+                           tenants={"a": (5.0, 1e-6),
+                                    "b": (5.0, 1e-6)},
+                           workers=2) as svc:
+            outs = {}
+            t1 = threading.Thread(
+                target=lambda: outs.setdefault("a", svc.submit(
+                    request("a", ds, eps=1.0, rid="same"))))
+            t1.start()
+            assert started.wait(timeout=30)
+            got_b = svc.submit(request("b", ds, eps=1.0, rid="same"))
+            assert got_b.ok, got_b
+            gate.set()
+            t1.join(timeout=120)
+            assert outs["a"].ok
+            assert svc.budgets.debits("a")["same"]["state"] == "committed"
+            assert svc.budgets.debits("b")["same"]["state"] == "committed"
+
+    def test_replayed_lease_never_refunded_on_clean_failure(
+            self, tmp_path):
+        """A restart replay whose retry fails CLEANLY must leave the
+        debit SPENT: the pre-restart attempt may have drawn noise
+        before dying, so refunding would be the unsafe direction —
+        unlike a fresh reserve, which a clean failure refunds."""
+        with serve.Service(str(tmp_path / "svc"),
+                           tenants={"t": (5.0, 1e-6)}) as svc:
+            # The restart-replay state: a reserved debit with no live
+            # request, then a retry whose rows no extractor can pull
+            # apart (fails inside the engine, before any DP output).
+            svc.budgets.reserve("t", "replay", 1.0, 1e-8)
+            out = svc.submit(request("t", [1, 2, 3], eps=1.0,
+                                     rid="replay"))
+            assert not out.ok and out.reason == "error"
+            assert svc.budgets.debits("t")["replay"][
+                "state"] == "reserved"
+            assert svc.budgets.remaining("t").epsilon == pytest.approx(
+                4.0)
+
+    def test_clean_failure_heals_engine_for_stale_entry_holders(
+            self, tmp_path, monkeypatch):
+        """A failure AFTER the accountant registered mechanisms (but
+        before finalize) must leave the warm engine rebindable before
+        the entry lock releases: a same-signature waiter that fetched
+        the entry before the failure dropped it from the registry is
+        served on a fresh accountant, not refused over leftovers."""
+        ds = make_ds(n=500, parts=4)
+        with serve.Service(str(tmp_path / "svc"),
+                           tenants={"t": (5.0, 1e-6)}) as svc:
+            ok = svc.submit(request("t", ds, eps=1.0))
+            assert ok.ok
+            (entry,) = list(svc._registry.values())
+            real = pdp.NaiveBudgetAccountant.compute_budgets
+
+            def boom(self):
+                raise RuntimeError("post-registration failure")
+
+            monkeypatch.setattr(pdp.NaiveBudgetAccountant,
+                                "compute_budgets", boom)
+            ds.invalidate_cache()
+            bad = svc.submit(request("t", ds, eps=1.0))
+            assert not bad.ok and bad.reason == "error"
+            monkeypatch.setattr(pdp.NaiveBudgetAccountant,
+                                "compute_budgets", real)
+            # The stale entry's engine rebinds cleanly — the failure
+            # path cleared its half-run accountant under the lock.
+            entry.engine.rebind_budget_accountant(
+                pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                          total_delta=0.0))
+            # And the failed FRESH reserve was refunded.
+            assert svc.budgets.remaining("t").epsilon == pytest.approx(
+                4.0)
+
+    def test_replay_with_mismatched_amounts_refused(self, tmp_path):
+        """A restart replay must carry the reserved debit's original
+        (eps, delta): a different demand under the same id is refused
+        as malformed instead of silently running at the old amounts;
+        the matching retry dedupes onto the debit and serves."""
+        ds = make_ds(n=800, parts=4)
+        with serve.Service(str(tmp_path / "svc"),
+                           tenants={"t": (5.0, 1e-6)}) as svc:
+            # The restart-replay state: a reserved debit with no live
+            # request (the previous process died mid-compute).
+            svc.budgets.reserve("t", "replay", 1.0, 1e-9)
+            bad = svc.submit(request("t", ds, eps=0.5, delta=1e-9,
+                                     rid="replay"))
+            assert not bad.ok and bad.reason == "malformed"
+            assert "must carry" in bad.detail
+            good = svc.submit(request("t", ds, eps=1.0, delta=1e-9,
+                                      rid="replay"))
+            assert good.ok
+            assert svc.budgets.remaining("t").epsilon == pytest.approx(
+                4.0)
+            assert svc.budgets.debits("t")["replay"][
+                "state"] == "committed"
+
+    def test_non_string_request_id_never_ghosts_the_live_set(
+            self, tmp_path):
+        """A non-string request_id is normalized to str at admission,
+        so the worker's teardown key matches and the id never sticks
+        in the live set refusing later submits as phantom duplicates."""
+        ds = make_ds(n=800, parts=4)
+        with serve.Service(str(tmp_path / "svc"),
+                           tenants={"t": (5.0, 1e-6)}) as svc:
+            first = svc.submit(request("t", ds, eps=1.0, rid=7))
+            assert first.ok and first.request_id == "7"
+            assert not svc._live
+            # The committed id refuses a re-run (ledger, not a ghost).
+            again = svc.submit(request("t", ds, eps=1.0, rid=7))
+            assert not again.ok and again.reason == "duplicate"
+            assert "committed" in again.detail
+            # A FALSY id like 0 is a real id, not "absent": its second
+            # submit must hit the same exactly-once refusal, never a
+            # fresh generated id (which would charge twice and release
+            # two noisy views of one logical request).
+            ds.invalidate_cache()
+            zero = svc.submit(request("t", ds, eps=1.0, rid=0))
+            assert zero.ok and zero.request_id == "0"
+            zero_again = svc.submit(request("t", ds, eps=1.0, rid=0))
+            assert not zero_again.ok and zero_again.reason == "duplicate"
+
+    def test_slot_and_live_id_freed_before_submit_returns(
+            self, tmp_path):
+        """finish() runs the worker's teardown BEFORE unblocking the
+        submitter: the moment submit() returns, an immediate same-id
+        retry of a cleanly-failed (refunded) request is admitted, and
+        the in-flight slot is free — no racing the worker's cleanup."""
+        ds = make_ds(n=800, parts=4)
+        with serve.Service(str(tmp_path / "svc"),
+                           tenants={"t": (5.0, 1e-6)},
+                           max_inflight_per_tenant=1) as svc:
+            failed = svc.submit(request("t", [1, 2, 3], eps=1.0,
+                                        rid="retry-me"))
+            assert not failed.ok and failed.reason == "error"
+            # Immediately: slot free, id free, fresh debit admitted.
+            assert svc._inflight.get("t", 0) == 0
+            assert not svc._live
+            retried = svc.submit(request("t", ds, eps=1.0,
+                                         rid="retry-me"))
+            assert retried.ok, retried
             assert svc.budgets.remaining("t").epsilon == pytest.approx(
                 4.0)
 
@@ -519,6 +778,46 @@ class TestBooksAndHeartbeat:
                         if json.loads(line)["name"] == "serve.refusal"]
             assert refusals and refusals[0]["payload"]["serve"][
                 "reason"] == "overdraw"
+
+    def test_books_store_built_once_per_tenant_under_concurrency(
+            self, tmp_path, monkeypatch):
+        """Concurrent appends for one tenant must share a single
+        LedgerStore instance (the store's one-lock-per-file contract):
+        a slowed constructor + a thread barrier would race the old
+        unguarded creation into duplicate stores."""
+        from pipelinedp_tpu.obs import store as obs_store
+        builds = []
+        real_store = obs_store.LedgerStore
+
+        class SlowStore(real_store):
+            def __init__(self, *a, **k):
+                builds.append(threading.current_thread().name)
+                threading.Event().wait(0.05)
+                super().__init__(*a, **k)
+
+        monkeypatch.setattr(obs_store, "LedgerStore", SlowStore)
+        with serve.Service(str(tmp_path / "svc"),
+                           tenants={"t": (5.0, 1e-6)}) as svc:
+            n = 6
+            barrier = threading.Barrier(n)
+
+            def append(i):
+                barrier.wait(timeout=30)
+                svc._append_books("t", "serve.test", {"i": i})
+
+            threads = [threading.Thread(target=append, args=(i,))
+                       for i in range(n)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert len(builds) == 1, builds
+            assert len(svc._books_stores) == 1
+            path = os.path.join(svc.books_dir("t"), "run_ledger.jsonl")
+            entries = [json.loads(line) for line in
+                       open(path, encoding="utf-8")
+                       if json.loads(line)["name"] == "serve.test"]
+            assert len(entries) == n
 
     def test_heartbeat_snapshots_all_live_requests_one_document(
             self, tmp_path):
