@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast bench smoke multichip lint dev clean faultcheck nosleep perfcheck nofoldin obscheck noperf nostager ledgercheck noartifacts watchcheck costcheck nocost plancheck noknobs kernelcheck nopallas servecheck noserve
+.PHONY: test test-fast bench smoke multichip lint lintcheck dev clean faultcheck nosleep perfcheck nofoldin obscheck noperf nostager ledgercheck noartifacts watchcheck costcheck nocost plancheck noknobs kernelcheck nopallas servecheck noserve
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -34,7 +34,9 @@ faultcheck: nosleep
 # bit-parity, partition-block chunking, guard-cliff boundaries) and
 # the pass-B sweep suite (planner invariants, multi-tile-vs-per-tile
 # bit-parity, hybrid prefix cache, pass-B fault drain).
-perfcheck: nosleep nofoldin nostager nopallas
+perfcheck:
+	$(PYTHON) -m pipelinedp_tpu.lint --rule nosleep --rule nofoldin \
+	  --rule nostager --rule nopallas
 	$(PYTHON) -m pytest tests/test_ingest.py tests/test_faults.py \
 	  tests/test_walk.py tests/test_pass_b.py -q
 
@@ -61,65 +63,13 @@ kernelcheck: nopallas
 servecheck: noserve
 	$(PYTHON) -m pytest tests/test_serve.py tests/test_ledger.py -q
 
-# Lint-style check: durable budget-ledger state has ONE writer stack —
-# TenantBudgetLedger construction is confined to pipelinedp_tpu/serve/
-# (+ budget_accounting.py, the module whose two-phase state it lifts),
-# and the batch engine modules never import pipelinedp_tpu.serve (the
-# service depends on the engine, never the reverse — batch mode stays
-# byte-for-byte oblivious to serving). Docstring/comment mentions
-# (backquoted or #-prefixed) are ignored. (tests/test_serve.py
-# enforces the same two rules in-tree, AST-precise.)
-noserve:
-	@bad=$$(grep -rn "TenantBudgetLedger *(" --include='*.py' pipelinedp_tpu bench.py \
-	  | grep -v "pipelinedp_tpu/serve/" \
-	  | grep -v "pipelinedp_tpu/budget_accounting\.py" \
-	  | grep -v '``' | grep -vE ':[0-9]+: *#' || true); \
-	if [ -n "$$bad" ]; then \
-	  echo "$$bad"; \
-	  echo "ERROR: budget-ledger construction outside pipelinedp_tpu/serve/"; \
-	  echo "+ budget_accounting.py — budget debits must flow through the"; \
-	  echo "serve layer's durable ledger"; \
-	  exit 1; \
-	fi; \
-	bad=$$(grep -rnE "(from|import)[^#\"']*pipelinedp_tpu\.serve" \
-	  --include='*.py' pipelinedp_tpu \
-	  | grep -v "pipelinedp_tpu/serve/" \
-	  | grep -v '``' | grep -vE ':[0-9]+: *#' || true); \
-	if [ -n "$$bad" ]; then \
-	  echo "$$bad"; \
-	  echo "ERROR: serve import in a batch engine module — the service"; \
-	  echo "depends on the engine, never the reverse"; \
-	  exit 1; \
-	fi; \
-	echo "noserve: OK"
-
-# Lint-style check: pallas imports and pallas_call sites are confined
-# to pipelinedp_tpu/ops/kernels/ — every other module must dispatch
-# through the kernels package (kernel_backend knob -> select_backend),
-# so the fallback events, the envelope checks and the interpret-mode
-# story stay in ONE place. Docstring/comment mentions (backquoted or
-# #-prefixed) are ignored. (tests/test_kernels.py enforces the same
-# rule in-tree, AST-precise.)
-nopallas:
-	@bad=$$(grep -rnE "(from|import)[^#\"']*pallas|pallas_call *\(|[^a-zA-Z_.]pl\.|^pl\." \
-	  --include='*.py' pipelinedp_tpu bench.py \
-	  | grep -v "pipelinedp_tpu/ops/kernels/" \
-	  | grep -v '``' | grep -vE ':[0-9]+: *#' || true); \
-	if [ -n "$$bad" ]; then \
-	  echo "$$bad"; \
-	  echo "ERROR: pallas usage outside pipelinedp_tpu/ops/kernels/ —"; \
-	  echo "dispatch through pipelinedp_tpu.ops.kernels (the"; \
-	  echo "kernel_backend knob + select_backend fallback seam)"; \
-	  exit 1; \
-	fi; \
-	echo "nopallas: OK"
-
 # Observability acceptance suite: tracer thread-safety under a live
 # overlapped-ingest run, no-op-mode zero emission, bench-field parity
 # (names/semantics unchanged, DP outputs bit-identical trace on/off),
 # Chrome-trace round-trip, run-report schema, resilience/fault event
 # coverage — plus the no-raw-perf-counter and no-ad-hoc-artifact lints.
-obscheck: noperf noartifacts
+obscheck:
+	$(PYTHON) -m pipelinedp_tpu.lint --rule noperf --rule noartifacts
 	$(PYTHON) -m pytest tests/test_obs.py -q
 
 # Audit-record + run-ledger acceptance suite: schema-v2 privacy section
@@ -137,7 +87,8 @@ ledgercheck: noartifacts
 # threads on drain), flight-record ring + thread-stack round-trip,
 # heartbeat on/off DP bit-parity, the --summarize ledger analytics
 # CLI, and the wedged-probe watchdog-cancel path.
-watchcheck: noperf nosleep
+watchcheck:
+	$(PYTHON) -m pipelinedp_tpu.lint --rule noperf --rule nosleep
 	$(PYTHON) -m pytest tests/test_monitor.py tests/test_obs.py -q
 
 # Device-cost observatory acceptance suite: roofline verdict math,
@@ -163,159 +114,48 @@ costcheck: nocost
 plancheck: noknobs
 	$(PYTHON) -m pytest tests/test_plan.py -q
 
-# Lint-style check: no direct reads of the registered knob constants
-# (_SUBHIST_BYTE_CAP / _SELECT_UNITS_CAP / _TREE_ROWS_CAP / _Q_CHUNK)
-# outside pipelinedp_tpu/plan/ — every consumer must resolve through
-# the knob registry (plan.knobs: env > seam > plan file > default) so
-# an autotuned plan can actually steer the value and every resolution
-# lands in the run report's plan section. The defining modules keep
-# the names as module-level assignments (the blessed test seams);
-# docstring/comment mentions (backquoted or #-prefixed) are ignored.
-# (tests/test_plan.py enforces the same rule in-tree, AST-precise.)
+# ---------------------------------------------------------------------
+# Static analysis: ONE AST rule engine (pipelinedp_tpu/lint/) replaced
+# the former grep forest. Every legacy target below is now a thin
+# alias over `python -m pipelinedp_tpu.lint --rule <id>`; `lintcheck`
+# runs the full registry (9 ported rules + rng-purity,
+# blocking-under-lock, jit-staticness). Findings are `file:line
+# rule-id message`; deliberate exceptions are inline
+# `# lint: disable=<rule>(reason)` suppressions, counted and reported.
+# See README "Static analysis" for the rule table.
+# ---------------------------------------------------------------------
+
+lintcheck:
+	$(PYTHON) -m pipelinedp_tpu.lint
+
+noserve:
+	$(PYTHON) -m pipelinedp_tpu.lint --rule noserve
+
+nopallas:
+	$(PYTHON) -m pipelinedp_tpu.lint --rule nopallas
+
 noknobs:
-	@bad=$$(grep -rnE "_SUBHIST_BYTE_CAP|_SELECT_UNITS_CAP|_TREE_ROWS_CAP|_Q_CHUNK" \
-	  --include='*.py' pipelinedp_tpu bench.py \
-	  | grep -v "pipelinedp_tpu/plan/" \
-	  | grep -v '``' | grep -vE ':[0-9]+: *#' \
-	  | grep -vE '^pipelinedp_tpu/(jax_engine|streaming)\.py:[0-9]+:(_SUBHIST_BYTE_CAP|_SELECT_UNITS_CAP|_TREE_ROWS_CAP|_Q_CHUNK) *=' \
-	  || true); \
-	if [ -n "$$bad" ]; then \
-	  echo "$$bad"; \
-	  echo "ERROR: direct knob-constant access — resolve through"; \
-	  echo "pipelinedp_tpu.plan (knobs.value / resolve / seam_override)"; \
-	  exit 1; \
-	fi; \
-	echo "noknobs: OK"
+	$(PYTHON) -m pipelinedp_tpu.lint --rule noknobs
 
-# Lint-style check: no direct compiled-program analysis or live-array
-# sampling outside pipelinedp_tpu/obs/ — cost_analysis( /
-# memory_analysis( / live_arrays( calls must flow through the
-# device-cost observatory (obs/costs.py) so every measurement lands in
-# the schema-versioned run report keyed by the env fingerprint.
-# (tests/test_costs.py enforces the same rule in-tree, AST-precise.)
 nocost:
-	@bad=$$(grep -rnE "cost_analysis *\(|memory_analysis *\(|live_arrays *\(" \
-	  --include='*.py' pipelinedp_tpu bench.py \
-	  | grep -v "pipelinedp_tpu/obs/" || true); \
-	if [ -n "$$bad" ]; then \
-	  echo "$$bad"; \
-	  echo "ERROR: direct device-analysis call — route through"; \
-	  echo "pipelinedp_tpu.obs.costs (instrumented_jit / sample_live_bytes)"; \
-	  exit 1; \
-	fi; \
-	echo "nocost: OK"
+	$(PYTHON) -m pipelinedp_tpu.lint --rule nocost
 
-# Lint-style check: no ad-hoc run-report/JSON-artifact writes — every
-# json.dump( file write in library/bench code must live in
-# pipelinedp_tpu/obs/ (the exporters + the durable ledger store),
-# pipelinedp_tpu/plan/ (the atomically-replaced plan file) or
-# bench.py (the one artifact emitter), so run knowledge lands in the
-# schema-versioned report/store/plan instead of scattered one-off
-# files. (tests/test_ledger.py enforces the same rule, AST-precise.)
 noartifacts:
-	@bad=$$(grep -rn "json\.dump *(" --include='*.py' pipelinedp_tpu \
-	  | grep -v "pipelinedp_tpu/obs/" \
-	  | grep -v "pipelinedp_tpu/plan/" || true); \
-	if [ -n "$$bad" ]; then \
-	  echo "$$bad"; \
-	  echo "ERROR: ad-hoc JSON artifact write — route run reports/"; \
-	  echo "artifacts through pipelinedp_tpu/obs (report/store) or bench.py"; \
-	  exit 1; \
-	fi; \
-	echo "noartifacts: OK"
+	$(PYTHON) -m pipelinedp_tpu.lint --rule noartifacts
 
-# Lint-style check: no bare time.perf_counter() phase timing outside
-# pipelinedp_tpu/obs/ — every measured phase must flow through obs
-# spans so it lands in the run ledger and the bench timing fields stay
-# derived views over spans (bench.py's helpers route through
-# obs.run_tracer; tests/test_obs.py enforces the same rule in-tree).
-# obs/ is the ONE package allowed the raw timer — EXCEPT obs/monitor.py:
-# the watchdog's entire deadline story rides the injectable resilience
-# clock, so raw perf_counter there would reintroduce wall-time waits
-# no FakeClock test could pin. (time.sleep in monitor.py is already
-# banned by `nosleep`, which never excluded obs/.)
 noperf:
-	@bad=$$(grep -rn "perf_counter *(" --include='*.py' pipelinedp_tpu bench.py \
-	  | grep -v "pipelinedp_tpu/obs/" || true); \
-	badmon=$$(grep -n "perf_counter *(" pipelinedp_tpu/obs/monitor.py || true); \
-	if [ -n "$$bad" ] || [ -n "$$badmon" ]; then \
-	  echo "$$bad"; echo "$$badmon"; \
-	  echo "ERROR: raw perf_counter timing — use pipelinedp_tpu.obs spans"; \
-	  echo "(obs/monitor.py must use the injectable resilience clock)"; \
-	  exit 1; \
-	fi; \
-	echo "noperf: OK"
+	$(PYTHON) -m pipelinedp_tpu.lint --rule noperf
 
-# Lint-style check: no per-element vmap(fold_in) key constructions —
-# they rebuild a full threefry key schedule per element, the cost the
-# counter-based node-noise generator (ops/counter_rng.py, the one
-# blessed keyed-generator module) removed from the quantile walk.
-# (tests/test_walk.py enforces the same rule in-tree.)
 nofoldin:
-	@bad=$$(grep -rnE "vmap.*fold_in|fold_in.*vmap" --include='*.py' \
-	  pipelinedp_tpu bench.py \
-	  | grep -v "pipelinedp_tpu/ops/counter_rng\.py" || true); \
-	if [ -n "$$bad" ]; then \
-	  echo "$$bad"; \
-	  echo "ERROR: per-element vmap(fold_in) key construction — use"; \
-	  echo "the counter-based generator (pipelinedp_tpu/ops/counter_rng)"; \
-	  exit 1; \
-	fi; \
-	echo "nofoldin: OK"
+	$(PYTHON) -m pipelinedp_tpu.lint --rule nofoldin
 
-# Lint-style check: pass-B restreaming must flow through the sweep
-# planner's ONE stream source (streaming.py run_sweep) — a new direct
-# BackgroundStager construction outside pipelinedp_tpu/ingest/ and the
-# two blessed streaming.py sites (pass A's overlapped loop + the
-# pass-B sweep source) silently re-introduces per-tile restreaming.
-# (tests/test_pass_b.py enforces the same rule in-tree, AST-precise.)
 nostager:
-	@bad=$$(grep -rn "BackgroundStager *(" --include='*.py' pipelinedp_tpu bench.py \
-	  | grep -v "pipelinedp_tpu/ingest/" \
-	  | grep -v "pipelinedp_tpu/streaming\.py" || true); \
-	if [ -n "$$bad" ]; then \
-	  echo "$$bad"; \
-	  echo "ERROR: direct BackgroundStager construction — only"; \
-	  echo "pipelinedp_tpu/ingest/ and the two blessed streaming.py"; \
-	  echo "sites (pass A + the pass-B sweep source) may build stagers"; \
-	  exit 1; \
-	fi; \
-	n=$$(grep -c "BackgroundStager *(" pipelinedp_tpu/streaming.py); \
-	if [ "$$n" -gt 2 ]; then \
-	  echo "ERROR: $$n BackgroundStager sites in pipelinedp_tpu/streaming.py"; \
-	  echo "(max 2: pass A + the sweep planner's run_sweep) — pass-B"; \
-	  echo "restreaming must go through the sweep planner"; \
-	  exit 1; \
-	fi; \
-	echo "nostager: OK"
+	$(PYTHON) -m pipelinedp_tpu.lint --rule nostager
 
-# Lint-style check: no library/bench code path may call time.sleep
-# directly — waits must route through the injectable
-# pipelinedp_tpu.resilience.clock so fault tests stay fast and
-# deterministic — and no bare threading.Thread outside
-# pipelinedp_tpu/ingest/ and pipelinedp_tpu/resilience/: every worker
-# thread must go through the ingest executor's cancellable lifecycle
-# so fault-injected kills can always drain to zero orphan threads.
-# (tests/test_resilience.py enforces both in-tree.)
 nosleep:
-	@bad=$$(grep -rn "time\.sleep *(" --include='*.py' pipelinedp_tpu bench.py \
-	  | grep -v "resilience/clock\.py" || true); \
-	if [ -n "$$bad" ]; then \
-	  echo "$$bad"; \
-	  echo "ERROR: direct time.sleep — use pipelinedp_tpu.resilience.clock"; \
-	  exit 1; \
-	fi; \
-	bad=$$(grep -rn "threading\.Thread *(" --include='*.py' pipelinedp_tpu bench.py \
-	  | grep -v "pipelinedp_tpu/ingest/" \
-	  | grep -v "pipelinedp_tpu/resilience/" || true); \
-	if [ -n "$$bad" ]; then \
-	  echo "$$bad"; \
-	  echo "ERROR: bare threading.Thread — use the pipelinedp_tpu.ingest executor"; \
-	  exit 1; \
-	fi; \
-	echo "nosleep: OK"
+	$(PYTHON) -m pipelinedp_tpu.lint --rule nosleep
 
-lint:
+lint: lintcheck
 	@if $(PYTHON) -c "import pyflakes" 2>/dev/null; then \
 	  $(PYTHON) -m pyflakes pipelinedp_tpu tests; \
 	else \

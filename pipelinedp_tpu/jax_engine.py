@@ -656,6 +656,9 @@ def fused_aggregate_kernel(config: FusedConfig, num_partitions: int, pid,
       sel_threshold/sel_scale: f32 scalars for thresholding strategies;
       key: PRNG key.
     """
+    # Seeded entry seam: the ONE root split into the bounding /
+    # selection / noise streams, pure in the caller's key.
+    # lint: disable=rng-purity(root split seam, pure in caller's key)
     k_bound, k_sel, k_noise = jax.random.split(key, 3)
     part, part_nseg, qrows = _partials(config, num_partitions, pid, pk,
                                        values, valid, k_bound, fx_bits,
@@ -714,8 +717,13 @@ def _partials(config: FusedConfig, num_partitions: int, pid, pk, values,
         part_nseg = part["count"]
         return part, part_nseg, qrows
 
+    # Blessed seam: tie-break/salt/sample bits for contribution
+    # bounding, all derived from the bounding stream's key.
+    # lint: disable=rng-purity(bounding tie-break bits, keyed by k_bound)
     k_tie, k_salt, k_m = jax.random.split(key, 3)
+    # lint: disable=rng-purity(per-run salt from the bounding stream)
     salt = jax.random.bits(k_salt, (), dtype=jnp.uint32)
+    # lint: disable=rng-purity(sort tie-break bits from the bounding stream)
     tiebreak = jax.random.bits(k_tie, (n,), dtype=jnp.uint32)
     big_pid = jnp.where(valid, pid, seg_ops.PAD_ID)
     big_pk = jnp.where(valid, pk, seg_ops.PAD_ID)
@@ -747,6 +755,7 @@ def _partials(config: FusedConfig, num_partitions: int, pid, pk, values,
         # uniform over the unit's ROWS, not follow the hpk segment order,
         # so rank rows by an independent random key in a second sort and
         # carry the keep bits back through the permutations.
+        # lint: disable=rng-purity(total-cap sample bits from the bounding stream)
         tie_m = jax.random.bits(k_m, (n,), dtype=jnp.uint32)
         order_m = jnp.lexsort((tie_m, big_pid))
         mpid = big_pid[order_m]
@@ -1065,6 +1074,8 @@ def _selection_and_metrics(config: FusedConfig, num_partitions: int, part,
     same key whenever the global axis equals the single-chip padded axis
     (any power-of-two mesh; see ``sharded_fused_aggregate``'s rounding
     note)."""
+    from pipelinedp_tpu.ops import noise as noise_ops
+
     P = num_partitions
     if pk_axis is None:
         offset = None
@@ -1096,16 +1107,20 @@ def _selection_and_metrics(config: FusedConfig, num_partitions: int, part,
                 PartitionSelectionStrategy.TRUNCATED_GEOMETRIC):
             idx = jnp.clip(counts, 0, keep_table.shape[0] - 1)
             p_keep = keep_table[idx]
+            # Selection draws route through the blessed noise module
+            # (unit scale here; sel_scale applies outside the draw).
             keep_pk = owned(
-                lambda s: jax.random.uniform(k_sel, s)) < p_keep
+                lambda s: noise_ops.jax_uniform(k_sel, s)) < p_keep
         else:
             if config.selection == (
                     PartitionSelectionStrategy.LAPLACE_THRESHOLDING):
                 noise_sel = owned(
-                    lambda s: jax.random.laplace(k_sel, s)) * sel_scale
+                    lambda s: noise_ops.jax_laplace(k_sel, s, 1.0)
+                ) * sel_scale
             else:
                 noise_sel = owned(
-                    lambda s: jax.random.normal(k_sel, s)) * sel_scale
+                    lambda s: noise_ops.jax_gaussian(k_sel, s, 1.0)
+                ) * sel_scale
             keep_pk = ((est_users + noise_sel) >= sel_threshold) & (
                 est_users >= sel_min_count)  # pre-threshold hard floor
         keep_pk = keep_pk & (part_nseg > 0)
@@ -1122,6 +1137,7 @@ def _selection_and_metrics(config: FusedConfig, num_partitions: int, part,
     if config.percentiles:
         # Percentile noise scale is the last _noise_scales entry; the tree
         # key is independent of the selection key stream.
+        # lint: disable=rng-purity(tree key: constant fold of the noise stream)
         k_tree = jax.random.fold_in(k_noise, 0x7ee)
         if pk_axis is None:
             vals = _percentile_values(config, P, qrows, noise_scales[-1],
@@ -2166,6 +2182,7 @@ class LazyFusedResult:
                               for k, v in part64.items()}
                     rel_sel = np.arange(len(kept_idx))
                     vocab_idx = kept_idx
+                # lint: disable=rng-purity(host-release rng seeded by the engine seed)
                 rng = (np.random.default_rng(self._rng_seed)
                        if self._rng_seed is not None else None)
                 metric_arrays = _host_release(
@@ -2281,6 +2298,7 @@ class LazyFusedResult:
             }
             # Reassemble fixed-point value lanes into float64 columns.
             _fold_fixedpoint(config, part64, fx_bits)
+            # lint: disable=rng-purity(host-release rng seeded by the engine seed)
             rng = (np.random.default_rng(self._rng_seed)
                    if self._rng_seed is not None else None)
             metric_arrays = _host_release(config, self._specs, part64,
@@ -2319,6 +2337,7 @@ def _run_fused_kernel(config: FusedConfig, encoded: EncodedData, scales,
     P_pad = _pad_pow2(P)
     seed = (rng_seed if rng_seed is not None else
             int(noise_ops._host_rng.integers(0, 2**31 - 1)))
+    # lint: disable=rng-purity(seed protocol root key, pure in rng_seed)
     key = jax.random.PRNGKey(seed)
     # Lane plan from the GLOBAL row count (the mesh's cross-device psum
     # adds per-shard lane sums, so capacity is a global bound; padding

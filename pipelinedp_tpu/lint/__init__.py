@@ -1,0 +1,40 @@
+"""One AST-based invariant checker for the whole tree.
+
+Replaces the Makefile grep forest (nosleep, nofoldin, nostager,
+noperf, noartifacts, nocost, noknobs, nopallas, noserve) and the 8
+hand-copied AST twins in the test tree with ONE engine: a rule
+registry over a single parse per file, structured findings, counted
+inline suppressions, and three whole-program analyses grep cannot do
+(rng-purity, blocking-under-lock, jit-staticness).
+
+CLI::
+
+    python -m pipelinedp_tpu.lint [--rule ID ...] [--json] [--list]
+
+Test seam: :func:`check_tree` (list of formatted unsuppressed
+findings, for one-line twin delegations) and
+:func:`~pipelinedp_tpu.lint.engine.lint_source` (lint a source string
+as if it lived at a given path, for rule fixtures).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from pipelinedp_tpu.lint import rules
+from pipelinedp_tpu.lint.engine import (Finding, LintResult,
+                                        Suppression, lint_source,
+                                        repo_root, run)
+
+__all__ = ["Finding", "LintResult", "Suppression", "check_tree",
+           "lint_source", "repo_root", "rules", "run"]
+
+
+def check_tree(*rule_ids: str, root: Optional[str] = None
+               ) -> List[str]:
+    """Run rules over the tree; return formatted UNSUPPRESSED findings
+    (empty == invariant holds).  The one-liner the legacy test twins
+    delegate to."""
+    ids: Optional[Sequence[str]] = list(rule_ids) or None
+    result = run(root=root, rule_ids=ids)
+    return [f.format() for f in result.findings]

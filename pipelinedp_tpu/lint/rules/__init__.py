@@ -1,0 +1,56 @@
+"""The rule registry: 9 ported Makefile lints + 3 born-AST analyses.
+
+Adding a rule: subclass :class:`~pipelinedp_tpu.lint.rules.base.Rule`
+in a module here, list it in :data:`ALL_RULE_CLASSES`, and add a
+bad+clean fixture pair to ``tests/test_lint.py`` (the registry
+meta-test will fail until the fixture exists — see
+``contributing/CONTRIBUTING.md``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from pipelinedp_tpu.lint.rules.base import Rule
+from pipelinedp_tpu.lint.rules.confinement import PORTED_RULES
+from pipelinedp_tpu.lint.rules.jit_static import JitStaticnessRule
+from pipelinedp_tpu.lint.rules.locks import BlockingUnderLockRule
+from pipelinedp_tpu.lint.rules.rng_purity import RngPurityRule
+
+ALL_RULE_CLASSES = tuple(PORTED_RULES) + (
+    RngPurityRule, BlockingUnderLockRule, JitStaticnessRule)
+
+_REGISTRY: Dict[str, Rule] = {}
+for _cls in ALL_RULE_CLASSES:
+    _rule = _cls()
+    assert _rule.id and _rule.id not in _REGISTRY, _cls
+    _REGISTRY[_rule.id] = _rule
+
+
+def all_rules() -> List[Rule]:
+    return list(_REGISTRY.values())
+
+
+def rule_ids() -> List[str]:
+    return list(_REGISTRY)
+
+
+def get(rule_id: str) -> Rule:
+    try:
+        return _REGISTRY[rule_id]
+    except KeyError:
+        raise KeyError(
+            f"unknown lint rule '{rule_id}' — known: "
+            f"{', '.join(_REGISTRY)}") from None
+
+
+def select(rule_ids_seq: Optional[Sequence[str]]) -> List[Rule]:
+    if rule_ids_seq is None:
+        return all_rules()
+    return [get(rid) for rid in rule_ids_seq]
+
+
+def legacy_targets() -> Dict[str, str]:
+    """Makefile grep target -> owning rule id (the port inventory)."""
+    return {r.legacy_target: r.id for r in _REGISTRY.values()
+            if r.legacy_target is not None}

@@ -1,0 +1,110 @@
+"""Rule base class + the small AST vocabulary every rule shares."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator, List, Optional, Sequence, Tuple
+
+#: (line, message) pairs — the engine wraps them into Findings.
+RuleHits = Iterable[Tuple[int, str]]
+
+
+class Rule:
+    """One invariant: an id, the prose of what it protects, a fix
+    hint, and a ``check`` over a parsed file.
+
+    ``legacy_target`` names the Makefile grep this rule superseded
+    (None for the born-AST analyses); the registry meta-test asserts
+    every legacy target still has an owner.
+    """
+
+    id: str = ""
+    legacy_target: Optional[str] = None
+    invariant: str = ""
+    fix_hint: str = ""
+    #: Path prefixes (or exact files) this rule never scans — the
+    #: blessed modules.  Documentation AND behavior: ``applies_to``
+    #: consults it, and the README rule table renders it.
+    blessed: Sequence[str] = ()
+    #: Scan scope; None means the engine default (library + bench.py).
+    #: A rule may narrow to library-only by overriding ``scans_bench``.
+    scans_bench: bool = True
+
+    def applies_to(self, rel: str) -> bool:
+        if rel == "bench.py":
+            return self.scans_bench
+        if not rel.startswith("pipelinedp_tpu/"):
+            return False
+        return not any(
+            rel == b or rel.startswith(b) for b in self.blessed)
+
+    def check(self, ctx) -> RuleHits:
+        raise NotImplementedError
+
+
+def terminal_name(node: ast.AST) -> Optional[str]:
+    """The rightmost identifier of a Name/Attribute chain
+    (``a.b.c`` -> ``c``; ``f`` -> ``f``)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a pure Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def receiver_terminal(node: ast.AST) -> Optional[str]:
+    """For ``x.y.attr`` the terminal name of the receiver ``x.y``."""
+    if isinstance(node, ast.Attribute):
+        return terminal_name(node.value)
+    return None
+
+
+def subtree_names(node: ast.AST) -> set:
+    """Every identifier mentioned anywhere under ``node`` (Name ids
+    and Attribute attrs) — the 'does this expression touch X at all'
+    primitive."""
+    out = set()
+    for child in ast.walk(node):
+        if isinstance(child, ast.Name):
+            out.add(child.id)
+        elif isinstance(child, ast.Attribute):
+            out.add(child.attr)
+    return out
+
+
+def import_bindings(node: ast.AST) -> List[str]:
+    """The dotted module/member names an import statement binds."""
+    names: List[str] = []
+    if isinstance(node, ast.ImportFrom) and node.module:
+        names.append(node.module)
+        names.extend(f"{node.module}.{a.name}" for a in node.names)
+    elif isinstance(node, ast.Import):
+        names.extend(a.name for a in node.names)
+    return names
+
+
+def walk_with_function(tree: ast.AST
+                       ) -> Iterator[Tuple[ast.AST, str]]:
+    """Yield ``(node, enclosing_function_name)`` pairs;
+    ``<module>`` at top level."""
+
+    def visit(node: ast.AST, func: str):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            func = node.name
+        yield node, func
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child, func)
+
+    yield from visit(tree, "<module>")

@@ -1,0 +1,73 @@
+"""DP-purity of randomness: every noise bit is a pure function of
+(seed, content).
+
+Checkpoint/resume replay, serve warm-reuse, and all 30+ PARITY rows
+assume noise keys derive deterministically from the run seed and the
+data content — an un-keyed ``np.random`` draw or a stray
+``random.random()`` anywhere in the release path silently voids
+bit-identical replay AND the DP guarantee (unseeded noise cannot be
+audited).  This rule confines randomness to the two blessed generator
+modules; every other call site is either a violation to fix or a
+seeded entry seam to bless inline with a written reason — the
+suppression inventory IS the repo's rng audit.
+
+``bench.py`` is out of scope: it owns seeded synthetic *data*
+generation, which is workload, not DP noise.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from pipelinedp_tpu.lint.rules.base import (Rule, dotted_name,
+                                            terminal_name)
+
+#: Modules allowed to draw randomness: the counter-based node-noise
+#: generator and the host/device noise ops.
+BLESSED_MODULES = ("pipelinedp_tpu/ops/counter_rng.py",
+                   "pipelinedp_tpu/ops/noise.py")
+
+#: from-imports that hide rng call sites behind bare names.
+_RNG_FROM_MODULES = frozenset({"random", "numpy.random", "jax.random"})
+
+
+class RngPurityRule(Rule):
+    id = "rng-purity"
+    legacy_target = None
+    invariant = ("noise keys are pure functions of (seed, content): "
+                 "randomness is drawn only in ops/counter_rng.py and "
+                 "ops/noise.py; every other site is a blessed seeded "
+                 "seam with a written reason, or a bug")
+    fix_hint = ("derive keys via ops.counter_rng, sample via "
+                "ops.noise, or bless the seeded seam with "
+                "# lint: disable=rng-purity(reason)")
+    blessed = BLESSED_MODULES
+    scans_bench = False
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod in _RNG_FROM_MODULES:
+                    yield (node.lineno,
+                           f"from-import of {mod} members hides rng "
+                           "call sites behind bare names")
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            dotted = dotted_name(fn) or ""
+            term = terminal_name(fn)
+            if (dotted.startswith("jax.random.")
+                    or dotted.startswith("jrandom.")):
+                yield (node.lineno, f"jax.random call: {dotted}")
+            elif term == "fold_in":
+                yield (node.lineno, "fold_in key derivation outside "
+                       "the blessed generator modules")
+            elif dotted.startswith(("np.random.", "numpy.random.")):
+                yield (node.lineno, f"numpy rng call: {dotted}")
+            elif (isinstance(fn, ast.Attribute)
+                  and isinstance(fn.value, ast.Name)
+                  and fn.value.id == "random"):
+                yield (node.lineno,
+                       f"stdlib random call: random.{fn.attr}")

@@ -510,6 +510,7 @@ class _InstrumentedFunction:
             })
             obs.inc("cost.programs_captured")
             entry = (compiled, table_key)
+            # lint: disable=blocking-under-lock(leaf dict lock; never held around _CAPTURE_LOCK)
             with self._lock:
                 self._compiled[key] = entry
             return entry

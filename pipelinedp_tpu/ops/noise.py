@@ -233,3 +233,10 @@ def jax_laplace(key, shape, scale):
 def jax_gaussian(key, shape, stddev):
     import jax
     return jax.random.normal(key, shape=shape) * stddev
+
+
+def jax_uniform(key, shape):
+    """Batched U[0,1) on device — the truncated-geometric selection's
+    keep draw (compared against the keep-probability table)."""
+    import jax
+    return jax.random.uniform(key, shape=shape)

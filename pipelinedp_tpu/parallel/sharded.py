@@ -107,7 +107,9 @@ def _sharded_kernel(config, num_partitions, mesh, pid, pk, values, valid,
         # stream is folded per shard (each shard needs distinct
         # sampling randomness, and with non-binding caps bounding
         # keeps every row regardless).
+        # lint: disable=rng-purity(root split seam, pure in the run seed)
         k_bound_g, k_sel, k_noise = jax.random.split(key, 3)
+        # lint: disable=rng-purity(per-shard bound key: fold of the shard index)
         k_bound = jax.random.fold_in(k_bound_g, jax.lax.axis_index(axis))
         part, part_nseg, qrows = jax_engine._partials(
             config, num_partitions, pid, pk, values, valid, k_bound,

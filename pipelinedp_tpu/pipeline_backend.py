@@ -290,6 +290,7 @@ def _mp_worker_init():
     would draw identical noise/selection randomness — identical noise
     across partitions cancels in pairwise differences and voids DP."""
     noise_ops.reseed_host_rng_from_entropy()
+    # lint: disable=rng-purity(DP-required entropy reseed of forked workers)
     random.seed()
 
 
@@ -566,6 +567,7 @@ class SparkRDDBackend(PipelineBackend):
         # Same caveat as the reference (:427-430): reduce-side merge-sample
         # is not guaranteed uniform.
         return (self._ensure_rdd(col).mapValues(lambda v: [v]).reduceByKey(
+            # lint: disable=rng-purity(reference-mirror merge-sample, non-jax path)
             lambda a, b: random.sample(a + b, min(n, len(a) + len(b)))))
 
     def count_per_element(self, col, stage_name=None):

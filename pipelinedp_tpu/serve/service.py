@@ -700,6 +700,7 @@ class Service:
             with self._books_lock:
                 store = self._books_stores.get(tenant)
                 if store is None:
+                    # lint: disable=blocking-under-lock(mkdir-only creation; the fsync'd append runs outside)
                     store = LedgerStore(self.books_dir(tenant))
                     self._books_stores[tenant] = store
                 if self._env is None:

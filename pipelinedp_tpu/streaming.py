@@ -419,6 +419,7 @@ def _sharded_partials_kernel(config, num_partitions, mesh, planes, values,
         return _combine_shards(x, axis, dim, multiproc)
 
     def local_fn(planes, values, n_valid, key):
+        # lint: disable=rng-purity(per-shard bound key: fold of the shard index)
         k_bound = jax.random.fold_in(key, jax.lax.axis_index(axis))
         part, nseg, qrows = _chunk_body(config, num_partitions, planes,
                                         values, n_valid[0], k_bound,
@@ -473,6 +474,7 @@ def _sharded_pct_sub_kernel(config, num_partitions, mesh, planes, values,
     blocked = n_block < num_partitions
 
     def local_fn(planes, values, n_valid, key, sub_start, p_offset):
+        # lint: disable=rng-purity(per-shard bound key: fold of the shard index)
         k_bound = jax.random.fold_in(key, jax.lax.axis_index(axis))
         _, _, qrows = _chunk_body(config, num_partitions, planes,
                                   values, n_valid[0], k_bound, fx_bits,
@@ -511,6 +513,7 @@ def _sharded_pct_multi_sub_kernel(config, num_partitions, mesh, planes,
     _, _, _, span = _tree_consts()
 
     def local_fn(planes, values, n_valid, key, sub_starts, p_offsets):
+        # lint: disable=rng-purity(per-shard bound key: fold of the shard index)
         k_bound = jax.random.fold_in(key, jax.lax.axis_index(axis))
         _, _, qrows = _chunk_body(config, num_partitions, planes,
                                   values, n_valid[0], k_bound, fx_bits,
@@ -757,10 +760,12 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
     n_batches = max(1, -(-n // chunk))
     seed = (rng_seed if rng_seed is not None else
             int(noise_ops._host_rng.integers(0, 2**31 - 1)))
+    # lint: disable=rng-purity(seed protocol root key, pure in rng_seed)
     key = jax.random.PRNGKey(seed)
     # Same key topology as the single-batch kernel: one bounding stream
     # (folded per batch, then per shard inside the sharded kernel), one
     # selection stream.
+    # lint: disable=rng-purity(root split seam, pure in the run seed)
     k_bound, k_sel, k_noise = jax.random.split(key, 3)
 
     if config.percentiles:
@@ -1133,6 +1138,7 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
         # Injectable kill point: tests sever the run at chunk b and
         # assert the checkpointed resume is bit-identical.
         faults.check_chunk(b)
+        # lint: disable=rng-purity(per-batch bound key: fold of the batch index)
         kb = jax.random.fold_in(k_bound, b)
         with obs.device_annotation("pdp.stream_partials"):
             if mesh is None:
@@ -1271,6 +1277,7 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
             raise NotImplementedError(
                 "streamed percentiles: a partition holds >= 2^31 kept "
                 "rows — beyond the int32 tree-histogram capacity")
+        # lint: disable=rng-purity(tree key: constant fold of the noise stream)
         k_tree = jax.random.fold_in(k_noise, 0x7ee)
         scale = jnp.float32(np.asarray(scales)[-1])
         with tr.span("walk.top", cat="walk"), \
@@ -1373,6 +1380,7 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                     # (pass A re-uses the plain chunk indices, so a
                     # pass-A fault could never land here).
                     faults.check_pass_b_chunk(b)
+                    # lint: disable=rng-purity(per-batch bound key: fold of the batch index)
                     kb = jax.random.fold_in(k_bound, b)
                     if single_full and as_multi:
                         ss_m = ss_dev[None]

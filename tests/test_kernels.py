@@ -378,36 +378,11 @@ class TestNoPallasLint:
     ``pl.*`` without importing pallas, so banning the import is the
     AST-precise version of the grep)."""
 
-    def _pallas_import_lines(self, path):
-        with open(path, encoding="utf-8") as fh:
-            tree = ast.parse(fh.read())
-        hits = []
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Import):
-                if any("pallas" in a.name for a in node.names):
-                    hits.append(node.lineno)
-            elif isinstance(node, ast.ImportFrom):
-                mod = node.module or ""
-                if "pallas" in mod or any(
-                        "pallas" in a.name for a in node.names):
-                    hits.append(node.lineno)
-        return hits
-
     def test_pallas_imports_confined_to_kernels_package(self):
-        allowed = os.path.join("pipelinedp_tpu", "ops", "kernels")
-        offenders = []
-        targets = [os.path.join(REPO, "bench.py")]
-        for root, _, files in os.walk(os.path.join(REPO,
-                                                   "pipelinedp_tpu")):
-            targets += [os.path.join(root, f) for f in files
-                        if f.endswith(".py")]
-        for path in targets:
-            rel = os.path.relpath(path, REPO)
-            if rel.startswith(allowed):
-                continue
-            for line in self._pallas_import_lines(path):
-                offenders.append(f"{rel}:{line}")
-        assert not offenders, offenders
+        # Delegates to the shared AST engine; `make nopallas` is the
+        # same rule.
+        from pipelinedp_tpu import lint
+        assert lint.check_tree("nopallas") == []
 
     def test_kernels_package_does_import_pallas(self):
         """The lint must be testing something: the kernels package

@@ -266,56 +266,9 @@ class TestNoDirectSleep:
     injectable ``resilience.clock`` so fault tests stay fast and
     deterministic. (``make faultcheck`` runs the same check via grep.)"""
 
-    def test_no_time_sleep_outside_clock(self):
-        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        # No \b before "time": aliases like ``_time.sleep`` must match.
-        pattern = re.compile(r"time\.sleep\s*\(")
-        offenders = []
-        roots = [os.path.join(repo, "pipelinedp_tpu"),
-                 os.path.join(repo, "bench.py")]
-        for root in roots:
-            files = ([root] if root.endswith(".py") else
-                     [os.path.join(dp, f)
-                      for dp, _, fs in os.walk(root)
-                      for f in fs if f.endswith(".py")])
-            for path in files:
-                rel = os.path.relpath(path, repo)
-                if rel.replace(os.sep, "/").endswith(
-                        "resilience/clock.py"):
-                    continue
-                with open(path, encoding="utf-8") as f:
-                    for ln, line in enumerate(f, 1):
-                        if pattern.search(line):
-                            offenders.append(f"{rel}:{ln}: {line.strip()}")
-        assert not offenders, (
-            "direct time.sleep found — route through "
-            "pipelinedp_tpu.resilience.clock:\n" + "\n".join(offenders))
-
-    def test_no_bare_threads_outside_ingest(self):
-        """Worker threads outside ``pipelinedp_tpu/ingest/`` and
-        ``pipelinedp_tpu/resilience/`` are banned: every thread must go
-        through the ingest executor's cancellable lifecycle, or a
-        fault-injected kill could leave orphan threads no drain path
-        can reach. (``make nosleep`` runs the same check via grep.)"""
-        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        pattern = re.compile(r"threading\.Thread\s*\(")
-        offenders = []
-        roots = [os.path.join(repo, "pipelinedp_tpu"),
-                 os.path.join(repo, "bench.py")]
-        allowed = ("pipelinedp_tpu/ingest/", "pipelinedp_tpu/resilience/")
-        for root in roots:
-            files = ([root] if root.endswith(".py") else
-                     [os.path.join(dp, f)
-                      for dp, _, fs in os.walk(root)
-                      for f in fs if f.endswith(".py")])
-            for path in files:
-                rel = os.path.relpath(path, repo).replace(os.sep, "/")
-                if any(rel.startswith(a) for a in allowed):
-                    continue
-                with open(path, encoding="utf-8") as f:
-                    for ln, line in enumerate(f, 1):
-                        if pattern.search(line):
-                            offenders.append(f"{rel}:{ln}: {line.strip()}")
-        assert not offenders, (
-            "bare threading.Thread found — route worker threads through "
-            "the pipelinedp_tpu.ingest executor:\n" + "\n".join(offenders))
+    def test_no_time_sleep_and_no_bare_threads(self):
+        # Both halves (direct time.sleep + bare threading.Thread) are
+        # one rule in the shared AST engine; `make nosleep` is the
+        # same check.
+        from pipelinedp_tpu import lint
+        assert lint.check_tree("nosleep") == []

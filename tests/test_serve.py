@@ -880,68 +880,13 @@ class TestBooksAndHeartbeat:
 # ---------------------------------------------------------------------
 
 
-def _walk_library_files(skip_prefixes=()):
-    root = os.path.join(REPO, "pipelinedp_tpu")
-    for dirpath, _, files in os.walk(root):
-        for fname in files:
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            rel = os.path.relpath(path, REPO).replace(os.sep, "/")
-            if any(rel.startswith(p) for p in skip_prefixes):
-                continue
-            yield path, rel
-
-
 class TestNoServeLint:
 
-    def test_serve_imports_confined_to_serve_package(self):
-        """Batch-engine modules must never import the serve layer:
-        the service depends on the engine, never the reverse — batch
-        mode stays byte-for-byte oblivious to serving."""
-        offenders = []
-        for path, rel in _walk_library_files(
-                skip_prefixes=("pipelinedp_tpu/serve/",)):
-            with open(path, encoding="utf-8") as f:
-                tree = ast.parse(f.read(), filename=rel)
-            for node in ast.walk(tree):
-                names = []
-                if isinstance(node, ast.ImportFrom) and node.module:
-                    names = [node.module] + [
-                        f"{node.module}.{a.name}" for a in node.names]
-                elif isinstance(node, ast.Import):
-                    names = [a.name for a in node.names]
-                if any(n == "pipelinedp_tpu.serve" or
-                       n.startswith("pipelinedp_tpu.serve.")
-                       for n in names):
-                    offenders.append(f"{rel}:{node.lineno}")
-        assert not offenders, (
-            "serve import outside pipelinedp_tpu/serve/ — the batch "
-            "engine must not depend on the service layer:\n" +
-            "\n".join(offenders))
-
-    def test_budget_ledger_writes_confined(self):
-        """Durable budget-ledger state has exactly one writer stack:
-        ``serve/`` (plus the accountant module it lifts state from).
-        Constructing the ledger — or reaching for its atomic-write
-        helper with a budget file — anywhere else would scatter
-        spend-tracking across the tree."""
-        allowed = ("pipelinedp_tpu/serve/",)
-        offenders = []
-        for path, rel in _walk_library_files(skip_prefixes=allowed):
-            if rel == "pipelinedp_tpu/budget_accounting.py":
-                continue
-            with open(path, encoding="utf-8") as f:
-                tree = ast.parse(f.read(), filename=rel)
-            for node in ast.walk(tree):
-                if not isinstance(node, ast.Call):
-                    continue
-                fn = node.func
-                name = (fn.id if isinstance(fn, ast.Name)
-                        else fn.attr if isinstance(fn, ast.Attribute)
-                        else None)
-                if name == "TenantBudgetLedger":
-                    offenders.append(f"{rel}:{node.lineno}")
-        assert not offenders, (
-            "budget-ledger construction outside pipelinedp_tpu/serve/ "
-            "+ budget_accounting.py:\n" + "\n".join(offenders))
+    def test_serve_confinement(self):
+        """Both halves — no serve imports outside serve/ (the service
+        depends on the engine, never the reverse) and
+        TenantBudgetLedger construction confined to serve/ +
+        budget_accounting.py — are one rule in the shared AST engine;
+        `make noserve` is the same rule."""
+        from pipelinedp_tpu import lint
+        assert lint.check_tree("noserve") == []
