@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast bench smoke multichip lint lintcheck dev clean faultcheck nosleep perfcheck nofoldin obscheck noperf nostager ledgercheck noartifacts watchcheck costcheck nocost plancheck noknobs kernelcheck nopallas servecheck noserve
+.PHONY: test test-fast bench smoke multichip lint lintcheck dev clean faultcheck nosleep perfcheck nofoldin obscheck noperf nostager ledgercheck noartifacts watchcheck costcheck nocost plancheck noknobs kernelcheck nopallas servecheck noserve fusecheck fusionmask
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -54,14 +54,29 @@ kernelcheck: nopallas
 # Resident-service acceptance suite: durable per-tenant budget
 # ledgers (exactly-once debits, overdraw refused before compute,
 # kill-and-restart replay), admission control (malformed / queue-full
-# / per-tenant in-flight refusals as structured responses, graceful
-# drain with zero orphan pdp-serve threads), warm engine/program
-# reuse (second same-signature request captures no new
+# / per-tenant in-flight / quota refusals as structured responses,
+# graceful drain with zero orphan pdp-serve threads), warm
+# engine/program reuse (second same-signature request captures no new
 # compile.program span), serve-vs-direct bit-parity (PARITY row 34),
 # per-tenant books, the run-namespaced multi-request heartbeat, and
-# the per-directory report-cursor regression.
-servecheck: noserve
+# the per-directory report-cursor regression — plus the request-fusion
+# suite (fusecheck).
+servecheck: noserve fusecheck
 	$(PYTHON) -m pytest tests/test_serve.py tests/test_ledger.py -q
+
+# Request-fusion acceptance suite: fused-vs-solo bit-parity across a
+# pow2 bucket boundary (PARITY row 35 — released values AND kept
+# sets, budget debits/audit records unchanged), padding invariance of
+# the solo kernel (the pad-mask contract), kill-mid-batch lease
+# resolution (every fused request resolves exactly once), zero new
+# compile.program captures on the second same-bucket batch, quota
+# refusals, and heartbeat bucket occupancy — plus the fusion-masking
+# confinement lint.
+fusecheck: fusionmask
+	$(PYTHON) -m pytest tests/test_fusion.py -q
+
+fusionmask:
+	$(PYTHON) -m pipelinedp_tpu.lint --rule fusion-masking
 
 # Observability acceptance suite: tracer thread-safety under a live
 # overlapped-ingest run, no-op-mode zero emission, bench-field parity

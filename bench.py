@@ -961,11 +961,160 @@ def bench_serve_latency(n_rows, smoke=False):
         "concurrent_p50_s": round(conc_p50, 4),
         "warm_hits": int(counters.get("serve.warm_hits", 0)),
         "cold_builds": int(counters.get("serve.cold_builds", 0)),
+        # Execution mode, for --compare's cross-mode refusal: this
+        # record always measures the solo (per-request-program) path.
+        "fusion": False,
     }
     log(f"## serve_request_latency [{n_rows} rows x {parts} parts x "
         f"{len(tenants)} tenants]: cold {cold_s:.3f}s, warm p50 "
         f"{p50 * 1000:.1f}ms / p99 {p99 * 1000:.1f}ms, "
         f"{seq_rps:.1f} seq req/s, {conc_rps:.1f} concurrent req/s")
+    emit(rec)
+    return rec
+
+
+def bench_serve_fused_throughput(n_rows, smoke=False):
+    """``serve_fused_throughput`` record: the SAME 3-tenant workload as
+    ``serve_request_latency``, served twice in one process — solo
+    (fusion off: one compiled program per request) and fused (fusion
+    on: the whole concurrent burst through ONE batched program per
+    shape bucket) — with a same-seed bit-parity cross-check between
+    the modes (PARITY row 35). The headline value is the FUSED
+    concurrent requests/s (unit ``req/s`` so ``--compare`` gates it);
+    the record carries the solo rate and the speedup, and is stamped
+    ``fusion: true`` so cross-mode gating is refused (the
+    plan_hash/kernel_backend refusals' twin)."""
+    import shutil
+    import tempfile
+
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu import obs, serve
+    from pipelinedp_tpu.ingest.executor import _CaptureThread
+
+    n_conc = 8
+    rounds = 2 if smoke else 3
+    parts = 200 if smoke else 2_000
+    rng = np.random.default_rng(23)
+    ds = pdp.ArrayDataset(
+        privacy_ids=rng.integers(0, max(n_rows // 8, 1_000), n_rows),
+        partition_keys=(rng.zipf(1.3, n_rows) % parts).astype(np.int64),
+        values=rng.uniform(0.0, 10.0, n_rows))
+    params = pdp.AggregateParams(
+        metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM, pdp.Metrics.MEAN],
+        noise_kind=pdp.NoiseKind.LAPLACE,
+        max_partitions_contributed=4, max_contributions_per_partition=2,
+        min_value=0.0, max_value=10.0)
+    tenants = {f"bench-t{i}": (1e6, 1e-3) for i in range(3)}
+
+    def req(i, seed):
+        # A FRESH ArrayDataset per request (same column arrays, its
+        # own cache): real traffic carries distinct per-request
+        # payloads, so neither mode may ride another request's cached
+        # encode or device placement — solo pays encode+ship per
+        # request, fused pays encode per request and ONE ship per
+        # batch, which is exactly the trade being measured.
+        payload = pdp.ArrayDataset(privacy_ids=ds.privacy_ids,
+                                   partition_keys=ds.partition_keys,
+                                   values=ds.values)
+        return serve.ServeRequest(tenant=f"bench-t{i % 3}",
+                                  params=params, dataset=payload,
+                                  epsilon=0.5, delta=1e-8,
+                                  rng_seed=seed)
+
+    def burst(svc, seed0):
+        """One concurrent burst of n_conc submits; returns (wall_s,
+        responses in submit order)."""
+        outs = [None] * n_conc
+
+        def one(i):
+            def body():
+                outs[i] = svc.submit(req(i, seed0 + i))
+            return _CaptureThread(body, f"pdp-serve-bench-{i}")
+
+        with tracer().span("bench.serve_burst", cat="bench") as sp:
+            threads = [one(i) for i in range(n_conc)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        for t in threads:
+            if t.exc is not None:
+                raise t.exc
+        for out in outs:
+            assert out.ok, f"serve refused: {out}"
+        return sp.duration, outs
+
+    def run_mode(fusion, seed0):
+        state_dir = tempfile.mkdtemp(prefix="pdp_serve_fuse_bench_")
+        try:
+            with serve.Service(state_dir, tenants=tenants,
+                               max_queue=max(n_conc * 2, 16),
+                               max_inflight_per_tenant=n_conc,
+                               workers=4, fusion=fusion,
+                               fuse_window_ms=250,
+                               fuse_max_batch=n_conc) as svc:
+                # Warm-up burst: compiles the per-request programs
+                # (solo) or the bucket's batched program (fused) — so
+                # cold XLA compile stays out of the timed rounds — and
+                # doubles as the parity cross-check: the SAME seeds run
+                # through both modes, and in fused mode this burst
+                # genuinely batches (n_conc concurrent same-bucket
+                # submits flush as one fused batch), so the comparison
+                # exercises the batched kernel, not a solo fallback.
+                _, warm_outs = burst(svc, seed0)
+                best = None
+                for r in range(rounds):
+                    wall, _ = burst(svc, seed0 + 100 * (r + 1))
+                    best = wall if best is None else min(best, wall)
+        finally:
+            shutil.rmtree(state_dir, ignore_errors=True)
+        return (n_conc / max(best, 1e-9),
+                [dict(out.results) for out in warm_outs])
+
+    def counter_delta(before, after, name):
+        return int(after.get(name, 0)) - int(before.get(name, 0))
+
+    # Counter DELTAS around each mode (never obs.reset(): the shared
+    # ledger carries every earlier bench's spans for the final report).
+    before = obs.ledger().snapshot()["counters"]
+    solo_rps, solo_parity = run_mode(False, seed0=1_000)
+    mid = obs.ledger().snapshot()["counters"]
+    fused_rps, fused_parity = run_mode(True, seed0=1_000)
+    after = obs.ledger().snapshot()["counters"]
+    # The cross-check must not be vacuous: the seeded workload is
+    # sized so selection keeps partitions.
+    assert any(solo_parity), "parity burst released no partitions"
+    parity_ok = all(
+        set(s) == set(f) and all(tuple(s[k]) == tuple(f[k]) for k in s)
+        for s, f in zip(solo_parity, fused_parity))
+    rec = {
+        "metric": "serve_fused_throughput",
+        "value": round(fused_rps, 2),
+        "unit": "req/s",
+        "fusion": True,
+        "rows_per_request": n_rows,
+        "tenants": len(tenants),
+        "concurrent_requests": n_conc,
+        "rounds": rounds,
+        "solo_req_per_s": round(solo_rps, 2),
+        "speedup_vs_solo": round(fused_rps / max(solo_rps, 1e-9), 3),
+        "parity_ok": bool(parity_ok),
+        "fused_batches": counter_delta(mid, after,
+                                       "serve.fused_batches"),
+        "fused_requests": counter_delta(mid, after,
+                                        "serve.fused_requests"),
+        "fusion_fallbacks": counter_delta(mid, after,
+                                          "serve.fusion_fallbacks"),
+        "solo_requests_served": counter_delta(before, mid,
+                                              "serve.requests_served"),
+    }
+    log(f"## serve_fused_throughput [{n_rows} rows x {parts} parts x "
+        f"{n_conc} concurrent]: fused {fused_rps:.1f} req/s vs solo "
+        f"{solo_rps:.1f} req/s ({rec['speedup_vs_solo']:.2f}x), "
+        f"parity_ok={parity_ok}")
+    assert parity_ok, (
+        "fused-vs-solo same-seed outputs diverged — PARITY row 35 is "
+        "broken; refusing to emit a throughput record for wrong bits")
     emit(rec)
     return rec
 
@@ -1410,6 +1559,7 @@ def compare_to_baseline(records=None, run_report=None, threshold=0.10):
     skipped_degraded = 0
     plan_mismatches = 0
     backend_mismatches = 0
+    fusion_mismatches = 0
     cur_plan = plan_provenance()
     cur_backend = kernel_backend_in_force()
     # One comparison per metric, at its BEST value this run — the same
@@ -1505,6 +1655,27 @@ def compare_to_baseline(records=None, run_report=None, threshold=0.10):
                 f"{rec_backend}) — not gated")
             rates.append(entry)
             continue
+        # Fusion-mode gate (the kernel_backend refusal's twin, for the
+        # serving records): a fused req/s rate gated against a solo
+        # baseline (or vice versa) compares two execution modes — one
+        # program per request vs one program per batch. Absent fields
+        # on old records read as solo (the pre-fusion behavior), so
+        # solo-vs-old keeps gating exactly as before.
+        base_fused = bool(base_rec.get("fusion", False))
+        rec_fused = bool(rec.get("fusion", False))
+        if base_fused != rec_fused:
+            fusion_mismatches += 1
+            entry["fusion_mismatch"] = True
+            entry["baseline_fusion"] = base_fused
+            obs.inc("bench.compare_fusion_mismatch")
+            obs.event("bench.compare_fusion_mismatch",
+                      metric=rec["metric"], baseline_fusion=base_fused,
+                      current_fusion=rec_fused)
+            log(f"## compare: fusion-mode mismatch on {rec['metric']} "
+                f"(baseline fusion={base_fused}, this run "
+                f"fusion={rec_fused}) — not gated")
+            rates.append(entry)
+            continue
         if value < (1.0 - threshold) * base_val:
             entry["regressed"] = True
             regressed.append(rec["metric"])
@@ -1529,6 +1700,7 @@ def compare_to_baseline(records=None, run_report=None, threshold=0.10):
             "skipped_degraded_baselines": skipped_degraded,
             "plan_mismatches": plan_mismatches,
             "kernel_backend_mismatches": backend_mismatches,
+            "fusion_mismatches": fusion_mismatches,
             "kernel_backend": cur_backend,
             "plan": cur_plan,
             "regressed": regressed}
@@ -1558,10 +1730,17 @@ def compare_verdict_line(regressions):
                 f"{regressions.get('kernel_backend')} against a "
                 "baseline from the other backend; re-baseline with "
                 "matching backends before gating")
+    if regressions.get("fusion_mismatches"):
+        return (f"COMPARE: fusion-mode mismatch — "
+                f"{regressions['fusion_mismatches']} rate(s) not "
+                "gated: this run's serve records ran the other "
+                "fusion mode than their baseline; re-baseline with "
+                "matching modes before gating")
     n_based = sum(1 for r in regressions["rates"]
                   if r.get("baseline") is not None and
                   not r.get("plan_mismatch") and
-                  not r.get("kernel_backend_mismatch"))
+                  not r.get("kernel_backend_mismatch") and
+                  not r.get("fusion_mismatch"))
     if n_based == 0:
         # Nothing was actually gated — say so, instead of an "on pace"
         # that reads as a passing verdict on a first run or a fresh
@@ -1783,6 +1962,11 @@ def main():
         # requests/s through a warm multi-tenant serve.Service.
         bench_serve_latency(30_000 if args.smoke else 500_000,
                             smoke=args.smoke)
+
+        # Request fusion A/B at the acceptance shape (8 concurrent
+        # 20k-row same-signature requests): solo vs fused in one
+        # process, same-seed bit-parity cross-checked.
+        bench_serve_fused_throughput(20_000, smoke=args.smoke)
 
         # Config 5: the analysis epsilon-sweep.
         bench_analysis_sweep(a_rows, max(1000, a_rows // 25),

@@ -636,6 +636,29 @@ def encode(rows, data_extractors, vector_size: Optional[int],
 # ---------------------------------------------------------------------------
 
 
+def _fused_kernel_body(config: FusedConfig, num_partitions: int, pid, pk,
+                       values, valid, noise_scales, keep_table,
+                       sel_threshold, sel_scale, sel_min_count,
+                       sel_rows_per_uid, key, fx_bits: int,
+                       kernel_backend: str):
+    """The un-jitted aggregation body: shared verbatim by the solo
+    kernel and the serve-fusion batched kernel (one vmapped request
+    axis over this exact graph), so a fused request's arithmetic IS the
+    solo request's arithmetic."""
+    # Seeded entry seam: the ONE root split into the bounding /
+    # selection / noise streams, pure in the caller's key.
+    # lint: disable=rng-purity(root split seam, pure in caller's key)
+    k_bound, k_sel, k_noise = jax.random.split(key, 3)
+    part, part_nseg, qrows = _partials(config, num_partitions, pid, pk,
+                                       values, valid, k_bound, fx_bits,
+                                       kernel_backend=kernel_backend)
+    return _selection_and_metrics(config, num_partitions, part, part_nseg,
+                                  noise_scales, keep_table, sel_threshold,
+                                  sel_scale, sel_min_count,
+                                  sel_rows_per_uid, k_sel, k_noise,
+                                  qrows=qrows)
+
+
 @instrumented_jit(phase="engine", static_argnames=("config",
                                                    "num_partitions",
                                                    "fx_bits",
@@ -656,18 +679,49 @@ def fused_aggregate_kernel(config: FusedConfig, num_partitions: int, pid,
       sel_threshold/sel_scale: f32 scalars for thresholding strategies;
       key: PRNG key.
     """
-    # Seeded entry seam: the ONE root split into the bounding /
-    # selection / noise streams, pure in the caller's key.
-    # lint: disable=rng-purity(root split seam, pure in caller's key)
-    k_bound, k_sel, k_noise = jax.random.split(key, 3)
-    part, part_nseg, qrows = _partials(config, num_partitions, pid, pk,
-                                       values, valid, k_bound, fx_bits,
-                                       kernel_backend=kernel_backend)
-    return _selection_and_metrics(config, num_partitions, part, part_nseg,
-                                  noise_scales, keep_table, sel_threshold,
-                                  sel_scale, sel_min_count,
-                                  sel_rows_per_uid, k_sel, k_noise,
-                                  qrows=qrows)
+    return _fused_kernel_body(config, num_partitions, pid, pk, values,
+                              valid, noise_scales, keep_table,
+                              sel_threshold, sel_scale, sel_min_count,
+                              sel_rows_per_uid, key, fx_bits,
+                              kernel_backend)
+
+
+@instrumented_jit(phase="serve_fused", static_argnames=("config",
+                                                        "num_partitions",
+                                                        "fx_bits",
+                                                        "kernel_backend"))
+def fused_aggregate_batch_kernel(config: FusedConfig,
+                                 num_partitions: int, pid, pk, values,
+                                 valid, noise_scales, keep_table,
+                                 sel_threshold, sel_scale, sel_min_count,
+                                 sel_rows_per_uid, keys,
+                                 fx_bits: int = 7,
+                                 kernel_backend: str = "xla"):
+    """One compiled program serving a whole BATCH of requests: every
+    runtime input gains a leading request axis (``pid``: int32[B, N],
+    ``keys``: [B] PRNG keys, scalar selection inputs become f32[B], ...)
+    and the solo kernel body vmaps over it. Request b's slice computes
+    bit-identically to a solo ``fused_aggregate_kernel`` call with the
+    same inputs (PARITY row 35): the body is shared, per-request noise
+    keys keep the streams pure (counter RNG is keyed by content), and
+    the per-request ``valid`` row masks plus the padding-invariant
+    tie-breaks (``counter_rng.row_bits``) guarantee bucket padding can
+    never leak into released values. Dispatched ONLY from the blessed
+    serve-fusion seam (``serve/fusion.py``; the ``fusion-masking``
+    lint) — batch mode and the streaming planes never see it. The
+    distinct program name keys the cost observatory's ``device_costs``
+    signatures apart from solo programs, so roofline verdicts stay
+    per-program."""
+    def one(pid, pk, values, valid, scales, table, thr, s_scale,
+            min_count, rows_per_uid, key):
+        return _fused_kernel_body(config, num_partitions, pid, pk,
+                                  values, valid, scales, table, thr,
+                                  s_scale, min_count, rows_per_uid, key,
+                                  fx_bits, kernel_backend)
+
+    return jax.vmap(one)(pid, pk, values, valid, noise_scales,
+                         keep_table, sel_threshold, sel_scale,
+                         sel_min_count, sel_rows_per_uid, keys)
 
 
 def _partials(config: FusedConfig, num_partitions: int, pid, pk, values,
@@ -717,14 +771,23 @@ def _partials(config: FusedConfig, num_partitions: int, pid, pk, values,
         part_nseg = part["count"]
         return part, part_nseg, qrows
 
+    from pipelinedp_tpu.ops import counter_rng
+
     # Blessed seam: tie-break/salt/sample bits for contribution
-    # bounding, all derived from the bounding stream's key.
+    # bounding, all derived from the bounding stream's key. Row-space
+    # tie-breaks come from the counter generator keyed by ROW POSITION
+    # (``counter_rng.row_bits``), not ``jax.random.bits`` — the
+    # latter's counter pairing depends on the padded length, which
+    # would couple the sampled contribution subsets to how far the row
+    # axis is padded. Content-keyed bits make every released value a
+    # pure function of (key, real rows): padding the same request to a
+    # larger pow2 fusion bucket is bit-identical to its solo padding
+    # (PARITY row 35, asserted in tests/test_fusion.py).
     # lint: disable=rng-purity(bounding tie-break bits, keyed by k_bound)
     k_tie, k_salt, k_m = jax.random.split(key, 3)
     # lint: disable=rng-purity(per-run salt from the bounding stream)
     salt = jax.random.bits(k_salt, (), dtype=jnp.uint32)
-    # lint: disable=rng-purity(sort tie-break bits from the bounding stream)
-    tiebreak = jax.random.bits(k_tie, (n,), dtype=jnp.uint32)
+    tiebreak = counter_rng.row_bits(k_tie, n)
     big_pid = jnp.where(valid, pid, seg_ops.PAD_ID)
     big_pk = jnp.where(valid, pk, seg_ops.PAD_ID)
     # Sampling priority of segment (pid, pk): an independent uniform
@@ -755,8 +818,7 @@ def _partials(config: FusedConfig, num_partitions: int, pid, pk, values,
         # uniform over the unit's ROWS, not follow the hpk segment order,
         # so rank rows by an independent random key in a second sort and
         # carry the keep bits back through the permutations.
-        # lint: disable=rng-purity(total-cap sample bits from the bounding stream)
-        tie_m = jax.random.bits(k_m, (n,), dtype=jnp.uint32)
+        tie_m = counter_rng.row_bits(k_m, n)
         order_m = jnp.lexsort((tie_m, big_pid))
         mpid = big_pid[order_m]
         new_pid_m = (idx == 0) | (mpid != jnp.roll(mpid, 1))
@@ -2051,6 +2113,51 @@ def _maybe_append_run_ledger(name: str = "engine.aggregate",
     obs.store.maybe_append_run_report(name, mesh=mesh)
 
 
+def fused_fx_bits(config: FusedConfig, padded_rows: int) -> int:
+    """The fixed-point lane width for a fused bucket, sized from the
+    bucket's PADDED row edge — an upper bound on every member's real
+    rows, so the batched kernel's static capacity guard holds for the
+    whole bucket. A solo request sizes from its real row count instead
+    and may pick wider lanes; both encodings are exact integer
+    decompositions of the same quantized per-row values, so the folded
+    float64 release is bit-identical either way (the lane plan is a
+    capacity choice, never a precision choice)."""
+    if _fixedpoint_layout(config):
+        return _fx_plan(max(int(padded_rows), 1))[0]
+    return 12
+
+
+@dataclasses.dataclass
+class FusionPrep:
+    """One request's host-side preparation for a fused batch: exactly
+    the runtime inputs a solo dispatch would feed the kernel, before
+    any bucket padding. Built only by ``LazyFusedResult.prepare_fused``
+    (after ``compute_budgets()``); consumed by the serve-fusion layer
+    (``serve/fusion.py``), which pads members to the bucket edge and
+    stacks them along the leading request axis."""
+    lazy: "LazyFusedResult"
+    encoded: EncodedData
+    P: int
+    P_pad: int
+    scales: np.ndarray
+    keep_table: np.ndarray
+    thr: float
+    s_scale: float
+    min_count: float
+    rows_per_uid: float
+    key: Any
+
+    def stack_signature(self) -> Tuple:
+        """Aux-input shapes that must agree for requests to stack:
+        bucketing already fixed (rows, partitions, fx_bits), but the
+        selection keep-table length varies with the request's
+        (eps, delta) and the scales vector with the metric set — the
+        executor sub-groups a bucket's batch on this, so a mismatch
+        splits the batch instead of failing it."""
+        return (self.scales.shape, self.keep_table.shape,
+                int(np.asarray(self.encoded.values).ndim))
+
+
 class LazyFusedResult:
     """Iterable of (partition_key, MetricsTuple); runs the fused kernel on
     first iteration — after ``compute_budgets()``, honoring the two-phase
@@ -2074,6 +2181,12 @@ class LazyFusedResult:
         self._mesh = mesh
         self._checkpoint = checkpoint
         self._cache = None
+        #: Serve-fusion seam: an EncodedData a fusion offer already
+        #: built for exactly these rows/extractors — _execute consumes
+        #: it instead of re-encoding, so a fused request that falls
+        #: back to solo execution (singleton window, unfusable prep)
+        #: never pays the O(rows) host encode twice.
+        self._encoded_hint: Optional[EncodedData] = None
         #: host/device timing split of the last _execute, for bench.py.
         self.timings: Optional[Dict[str, float]] = None
 
@@ -2096,9 +2209,12 @@ class LazyFusedResult:
         # now views over the run tracer's "engine.*" span totals.
         tr = obs.run_tracer()
         with tr.span("engine.encode", cat="engine"):
-            encoded = encode(self._rows, self._extractors,
-                             config.vector_size, self._public,
-                             require_pid=not config.bounds_already_enforced)
+            encoded = (self._encoded_hint if self._encoded_hint
+                       is not None else
+                       encode(self._rows, self._extractors,
+                              config.vector_size, self._public,
+                              require_pid=not
+                              config.bounds_already_enforced))
         self.timings = {"host_encode_s": tr.total("engine.encode"),
                         "device_s": 0.0, "host_decode_s": 0.0}
         P = len(encoded.pk_vocab)
@@ -2286,11 +2402,36 @@ class LazyFusedResult:
             _record_selection_audit(config.selection, P, len(kept_idx),
                                     "single_batch")
 
-        # The scalar DP release, in float64 via the shared mechanisms.
-        # Integer columns stay integral: the hardened noise path
-        # dispatches on dtype (discrete Laplace for counts — no float
-        # noise bits), exactly like the generic combiners' int
-        # accumulators.
+        # Only materialize kept partitions (with private selection
+        # the kept fraction can be tiny — never walk the full pk
+        # axis in Python). In compact mode the released arrays
+        # already hold only kept rows.
+        if self._public is not None:
+            rel_sel = vocab_idx = np.arange(P)
+        elif compact:
+            rel_sel = np.arange(n_rel)
+            vocab_idx = kept_idx
+        else:
+            rel_sel = vocab_idx = kept_idx
+        return self._finish_release(encoded, P, fetched, fx_bits,
+                                    rel_sel, vocab_idx)
+
+    def _finish_release(self, encoded: EncodedData, P: int, fetched,
+                        fx_bits: int, rel_sel, vocab_idx):
+        """The scalar DP release tail, in float64 via the shared
+        mechanisms — ONE implementation for the solo single-batch path
+        and the serve-fusion batch path (bit-identity between them is
+        the PARITY row 35 contract, so they must share this code, not
+        mirror it). Integer columns stay integral: the hardened noise
+        path dispatches on dtype (discrete Laplace for counts — no
+        float noise bits), exactly like the generic combiners' int
+        accumulators. ``fetched`` holds host copies of the device
+        outputs already restricted to the rows ``rel_sel`` releases
+        (kept rows in compact mode, the full [:P] axis otherwise)."""
+        from pipelinedp_tpu import obs
+
+        config = self._config
+        tr = obs.run_tracer()
         with tr.span("engine.release", cat="engine"):
             part64 = {
                 k: (v.astype(np.int64) if v.dtype.kind in "iu" else
@@ -2306,23 +2447,105 @@ class LazyFusedResult:
                                           rng)
             for name in _percentile_field_names(config.percentiles):
                 metric_arrays[name] = fetched[name]
-
-            # Only materialize kept partitions (with private selection
-            # the kept fraction can be tiny — never walk the full pk
-            # axis in Python). In compact mode the released arrays
-            # already hold only kept rows.
-            if self._public is not None:
-                rel_sel = vocab_idx = np.arange(P)
-            elif compact:
-                rel_sel = np.arange(n_rel)
-                vocab_idx = kept_idx
-            else:
-                rel_sel = vocab_idx = kept_idx
             out = _assemble_output(config, encoded.pk_vocab,
                                    metric_arrays, rel_sel, vocab_idx)
-        self.timings["host_decode_s"] = tr.total("engine.release")
+        if self.timings is not None:
+            self.timings["host_decode_s"] = tr.total("engine.release")
         _audit_expected_errors(config, self._specs, metric_arrays, rel_sel)
         _maybe_append_run_ledger(mesh=self._mesh)
+        return out
+
+    # --- serve-fusion seams (phase 1 / phase 2 of a fused execution) ---
+
+    def prepare_fused(self, encoded: Optional[EncodedData] = None
+                      ) -> Optional["FusionPrep"]:
+        """Serve-fusion seam, phase 1: the host-side preparation a solo
+        ``_execute`` would do before device dispatch — encode, noise
+        scales, selection inputs, the per-request PRNG key — WITHOUT
+        dispatching. Must run after ``compute_budgets()`` (the two-phase
+        protocol), exactly like iteration. Returns None when this
+        request cannot join a fused batch (sharded backend, streamed
+        scale, empty vocabulary): the fusion layer then falls back to
+        solo execution, visibly."""
+        from pipelinedp_tpu import obs
+        from pipelinedp_tpu.ops import noise as noise_ops
+
+        config = self._config
+        if self._mesh is not None:
+            return None
+        tr = obs.run_tracer()
+        with tr.span("engine.encode", cat="engine"):
+            if encoded is None:
+                encoded = encode(
+                    self._rows, self._extractors, config.vector_size,
+                    self._public,
+                    require_pid=not config.bounds_already_enforced)
+        P = len(encoded.pk_vocab)
+        if P == 0:
+            return None
+        from pipelinedp_tpu import streaming
+        if streaming.should_stream(config, encoded.n_rows, self._mesh):
+            return None
+        self.timings = {"host_encode_s": tr.total("engine.encode"),
+                        "device_s": 0.0, "host_decode_s": 0.0,
+                        "fused": True}
+        scales = _noise_scales(config, self._specs)
+        if config.bounds_already_enforced:
+            rows_per_uid = float(
+                self._params.max_contributions or
+                self._params.max_contributions_per_partition)
+        else:
+            rows_per_uid = 1.0
+        if self._selection_spec is not None:
+            keep_table, thr, s_scale, min_count = selection_inputs(
+                config, self._selection_spec.eps,
+                self._selection_spec.delta, self._params.pre_threshold)
+        else:
+            keep_table, thr, s_scale, min_count = selection_inputs(
+                config, 1.0, 1e-9, None)
+        seed = (self._rng_seed if self._rng_seed is not None else
+                int(noise_ops._host_rng.integers(0, 2**31 - 1)))
+        # lint: disable=rng-purity(seed protocol root key, pure in rng_seed)
+        key = jax.random.PRNGKey(seed)
+        return FusionPrep(
+            lazy=self, encoded=encoded, P=P, P_pad=_pad_pow2(P),
+            scales=np.asarray(scales), keep_table=np.asarray(keep_table),
+            thr=float(thr), s_scale=float(s_scale),
+            min_count=float(min_count), rows_per_uid=float(rows_per_uid),
+            key=key)
+
+    def finish_from_fused(self, prep: "FusionPrep", keep_np, raw_np,
+                          fx_bits: int):
+        """Serve-fusion seam, phase 2: finish THIS request from its
+        slice of the batched kernel's outputs. Replicates the solo
+        fetch decisions — the compact-vs-full release choice changes
+        which rows consume a seeded host rng's draws, so it is part of
+        the bit-identity contract — then runs the shared release tail
+        and installs the result as the lazy cache (iteration returns
+        it without dispatching a solo program)."""
+        config = self._config
+        P = prep.P
+        keep = np.asarray(keep_np)[:P]
+        kept_idx = np.flatnonzero(keep > 0)
+        if self._public is not None:
+            fetched = {k: np.asarray(v)[:P] for k, v in raw_np.items()}
+            rel_sel = vocab_idx = np.arange(P)
+        elif len(kept_idx) <= min(P, _COMPACT_FETCH_CAP):
+            # The solo path's packed compact fetch: release ONLY the
+            # kept rows, ascending pk order.
+            fetched = {k: np.asarray(v)[:P][kept_idx]
+                       for k, v in raw_np.items()}
+            rel_sel = np.arange(len(kept_idx))
+            vocab_idx = kept_idx
+        else:
+            fetched = {k: np.asarray(v)[:P] for k, v in raw_np.items()}
+            rel_sel = vocab_idx = kept_idx
+        if config.selection is not None:
+            _record_selection_audit(config.selection, P, len(kept_idx),
+                                    "fused_batch")
+        out = self._finish_release(prep.encoded, P, fetched, fx_bits,
+                                   rel_sel, vocab_idx)
+        self._cache = out
         return out
 
 

@@ -12,13 +12,15 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Sequence
 
 from pipelinedp_tpu.lint.rules.base import Rule
-from pipelinedp_tpu.lint.rules.confinement import PORTED_RULES
+from pipelinedp_tpu.lint.rules.confinement import (FusionMaskingRule,
+                                                   PORTED_RULES)
 from pipelinedp_tpu.lint.rules.jit_static import JitStaticnessRule
 from pipelinedp_tpu.lint.rules.locks import BlockingUnderLockRule
 from pipelinedp_tpu.lint.rules.rng_purity import RngPurityRule
 
 ALL_RULE_CLASSES = tuple(PORTED_RULES) + (
-    RngPurityRule, BlockingUnderLockRule, JitStaticnessRule)
+    RngPurityRule, BlockingUnderLockRule, JitStaticnessRule,
+    FusionMaskingRule)
 
 _REGISTRY: Dict[str, Rule] = {}
 for _cls in ALL_RULE_CLASSES:

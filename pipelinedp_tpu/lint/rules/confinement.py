@@ -351,6 +351,45 @@ class NoServeRule(Rule):
                        "serve/ + budget_accounting.py")
 
 
+class FusionMaskingRule(Rule):
+    """Fused-batch pad-mask construction is confined to the serve
+    fusion layer + the blessed ``jax_engine`` batched-kernel seam."""
+
+    id = "fusion-masking"
+    legacy_target = None  # born with `make fusecheck`, never a grep
+    invariant = ("request padding for fused batches is built ONLY by "
+                 "serve/fusion.pad_request_to_bucket (the validity "
+                 "mask is constructed alongside the padding) and "
+                 "dispatched ONLY through jax_engine's batched-kernel "
+                 "seam from serve/fusion.py — the engine must never "
+                 "see unmasked padded rows, because only the mask "
+                 "keeps bucket padding out of released values")
+    fix_hint = ("pad through pipelinedp_tpu.serve.fusion."
+                "pad_request_to_bucket and dispatch fused batches "
+                "from serve/fusion.py only")
+    blessed = ("pipelinedp_tpu/serve/fusion.py",)
+    #: jax_engine DEFINES the batched kernel (and may dispatch it from
+    #: its own blessed seam); everywhere else a dispatch site means a
+    #: second pad/mask policy is growing.
+    _KERNEL_EXTRA_BLESSED = ("pipelinedp_tpu/jax_engine.py",)
+
+    def check(self, ctx):
+        kernel_ok = ctx.rel in self._KERNEL_EXTRA_BLESSED
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = terminal_name(node.func)
+            if name == "pad_request_to_bucket":
+                yield (node.lineno,
+                       "fused-batch pad-mask construction outside "
+                       "serve/fusion.py")
+            elif (name == "fused_aggregate_batch_kernel"
+                  and not kernel_ok):
+                yield (node.lineno,
+                       "batched-kernel dispatch outside the blessed "
+                       "serve-fusion seam")
+
+
 PORTED_RULES = (NoSleepRule, NoFoldinRule, NoStagerRule, NoPerfRule,
                 NoArtifactsRule, NoCostRule, NoKnobsRule,
                 NoPallasRule, NoServeRule)

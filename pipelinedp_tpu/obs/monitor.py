@@ -165,6 +165,32 @@ def reset_requests() -> None:
     """Forget all live-request registrations (tests)."""
     with _REQS_LOCK:
         _LIVE_REQUESTS.clear()
+    update_fusion(None)
+
+
+# The serve-fusion bucket registry: the fusion layer (serve/fusion.py)
+# PUSHES its live bucket occupancy here on every change — the monitor
+# must never import serve/ (the engine-never-imports-serve invariant),
+# so the heartbeat pulls from this registry instead. One document then
+# answers "why is this window not flushing": requests queued per
+# bucket and the seconds left on each window, next to the in-flight
+# request list.
+
+_FUSION_LOCK = threading.Lock()
+_FUSION_STATE: Optional[Dict[str, Any]] = None
+
+
+def update_fusion(snapshot: Optional[Dict[str, Any]]) -> None:
+    """Install (or, with None, clear) the serve-fusion occupancy
+    snapshot the next heartbeat embeds."""
+    global _FUSION_STATE
+    with _FUSION_LOCK:
+        _FUSION_STATE = dict(snapshot) if snapshot is not None else None
+
+
+def fusion_snapshot() -> Optional[Dict[str, Any]]:
+    with _FUSION_LOCK:
+        return dict(_FUSION_STATE) if _FUSION_STATE is not None else None
 
 
 class Monitor:
@@ -422,6 +448,12 @@ class Monitor:
             # resident process (tenant, phase, age) — the multi-tenant
             # answer to "whose work is the current phase".
             hb["requests"] = reqs
+        fusion = fusion_snapshot()
+        if fusion is not None:
+            # The serve section: live fusion-bucket occupancy (queued
+            # requests per bucket + window deadlines), so a stalled
+            # batching window self-diagnoses from the heartbeat alone.
+            hb["serve"] = {"fusion": fusion}
         if stalled:
             hb["stall"] = {"stalled_for_s": round(stalled_for, 3),
                            "deadline_s": self.stall_s,

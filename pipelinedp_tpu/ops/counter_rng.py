@@ -84,6 +84,25 @@ def _uniform_open01(bits):
             np.float32(2.0**-24) + np.float32(2.0**-25))
 
 
+def row_bits(key, n):
+    """Length-invariant uint32 tie-break stream for row-space sampling:
+    element ``i`` is a pure function of ``(key, i)`` — unlike
+    ``jax.random.bits(key, (n,))``, whose counter pairing depends on
+    ``n``, so the SAME row index draws the SAME bits no matter how far
+    the row axis is padded. This is the property request fusion's
+    pow2 shape buckets stand on: a request padded to its solo shape
+    (``_pad_rows``) and the same request padded to a larger bucket edge
+    sample identical contribution subsets, so fused-vs-solo DP outputs
+    are bit-identical (PARITY row 35). Row position is the counter
+    content here (the draw keys row ``i`` of a FIXED input ordering);
+    ``x1 = 0`` keeps the second cipher lane free for callers that need
+    a second independent stream from the same key."""
+    k0, k1 = _key_lanes(key)
+    idx = jnp.arange(n, dtype=jnp.uint32)
+    bits, _ = threefry2x32(k0, k1, idx, jnp.zeros_like(idx))
+    return bits
+
+
 def laplace(key, x0, x1):
     """Unit-scale Laplace noise keyed by counter content: one batched
     threefry pass over ``(x0, x1)`` + the inverse CDF. Same f32 tail

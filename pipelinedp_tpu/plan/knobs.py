@@ -138,6 +138,41 @@ REGISTRY: Tuple[KnobSpec, ...] = (
         "fall back to 'xla' with a kernel.fallback event.",
         choices=("xla", "pallas")),
     KnobSpec(
+        "serve_fusion", "bool", False,
+        "PIPELINEDP_TPU_SERVE_FUSION", None, True, bool,
+        "Shape-bucketed request fusion in the resident service "
+        "(serve/fusion.py): admitted compatible requests batch through "
+        "ONE warm compiled program per pow2 shape bucket. dp-safe: "
+        "fusion on/off is bit-identical per request (PARITY row 35) — "
+        "per-request noise keys, row validity masks and "
+        "padding-invariant tie-breaks keep every request's stream its "
+        "own. Default off; the serve knobs carry no module seam so "
+        "resolving them never imports serve/ into batch mode "
+        "(Service constructor args are the injection point)."),
+    KnobSpec(
+        "serve_fuse_window_ms", "milliseconds", 8,
+        "PIPELINEDP_TPU_SERVE_FUSE_WINDOW_MS", None, True, int,
+        "Bounded wait window of an open fusion bucket: the first "
+        "request in a bucket waits at most this long for companions "
+        "before the batch flushes. A latency<->throughput trade only "
+        "(dp-safe; outputs are window-invariant)."),
+    KnobSpec(
+        "serve_fuse_batch", "requests per fused batch", 8,
+        "PIPELINEDP_TPU_SERVE_FUSE_BATCH", None, True, int,
+        "Max requests one fused batch carries; a full bucket flushes "
+        "immediately, before its window expires. dp-safe (batch "
+        "membership never reaches the per-request noise streams)."),
+    KnobSpec(
+        "serve_fuse_rows_floor", "rows (pow2 bucket floor)", 8192,
+        "PIPELINEDP_TPU_SERVE_FUSE_ROWS_FLOOR", None, True, int,
+        "Smallest row-bucket edge: requests bucket at "
+        "max(floor, solo row shape) — the 8192-row-tile edges the "
+        "solo compile cache already uses, so a fused member's row "
+        "plane is exactly its solo size. Raising the floor merges "
+        "small-request buckets (fewer compiled shapes, more padded "
+        "compute); clamped to >= 8192 (the solo row-padding floor). "
+        "dp-safe: released values are padding-invariant."),
+    KnobSpec(
         "select_units_cap", "privacy units per partition", _I32_MAX,
         None, ("pipelinedp_tpu.streaming", "_SELECT_UNITS_CAP"),
         False, int,

@@ -54,14 +54,23 @@ from pipelinedp_tpu.serve.budget_ledger import (BudgetLease,
 #: Admission-control env knobs (constructor args win; see the README
 #: knob table). Queue depth bounds memory under backpressure; the
 #: per-tenant in-flight cap keeps one tenant from monopolizing the
-#: worker pool.
+#: worker pool; the rows/rate quotas refuse oversized or too-frequent
+#: requests BEFORE any budget reserve or compute (refusal kind
+#: ``quota`` — ROADMAP serve item (b)).
 QUEUE_ENV = "PIPELINEDP_TPU_SERVE_QUEUE"
 INFLIGHT_ENV = "PIPELINEDP_TPU_SERVE_INFLIGHT"
 WORKERS_ENV = "PIPELINEDP_TPU_SERVE_WORKERS"
+ROWS_ENV = "PIPELINEDP_TPU_SERVE_ROWS"
+RATE_ENV = "PIPELINEDP_TPU_SERVE_REQS_PER_S"
 
 DEFAULT_QUEUE_DEPTH = 16
 DEFAULT_INFLIGHT_PER_TENANT = 4
 DEFAULT_WORKERS = 2
+#: 0 = unlimited (the default: quotas are opt-in caps).
+DEFAULT_MAX_ROWS = 0
+DEFAULT_REQS_PER_S = 0
+#: Seconds of admission history the per-tenant rate quota windows over.
+_RATE_WINDOW_S = 1.0
 
 #: Seconds between cancel polls while a worker blocks on the queue
 #: (same beat as the ingest executor).
@@ -107,8 +116,8 @@ class ServeResponse:
 
 #: The closed set of refusal reasons — admission control speaks a
 #: vocabulary, not free text (``detail`` carries the prose).
-REFUSAL_REASONS = ("overdraw", "malformed", "duplicate", "queue_full",
-                  "tenant_busy", "shutdown", "error")
+REFUSAL_REASONS = ("overdraw", "malformed", "duplicate", "quota",
+                   "queue_full", "tenant_busy", "shutdown", "error")
 
 
 @dataclasses.dataclass
@@ -174,6 +183,10 @@ class _Pending:
         self.seq = seq
         self.done = threading.Event()
         self.outcome: Optional[Tuple[str, Any]] = None
+        #: Set by the fusion layer at offer time (serve/fusion.py):
+        #: the request's signature, encoded columns and shape bucket,
+        #: so the batch executor never re-derives them.
+        self.fusion: Optional[Any] = None
         #: Set by the worker that picks this request up: frees the
         #: in-flight slot and live id. Run by ``finish`` BEFORE the
         #: submitter is unblocked — a caller whose submit() returned
@@ -206,6 +219,12 @@ class Service:
                  max_queue: Optional[int] = None,
                  max_inflight_per_tenant: Optional[int] = None,
                  workers: Optional[int] = None,
+                 max_rows_per_request: Optional[int] = None,
+                 max_reqs_per_s: Optional[int] = None,
+                 fusion: Optional[bool] = None,
+                 fuse_window_ms: Optional[int] = None,
+                 fuse_max_batch: Optional[int] = None,
+                 fuse_rows_floor: Optional[int] = None,
                  backend_factory=None,
                  clock=None):
         from pipelinedp_tpu import obs
@@ -221,7 +240,21 @@ class Service:
             else max_inflight_per_tenant)
         n_workers = int(os.environ.get(WORKERS_ENV, DEFAULT_WORKERS)
                         if workers is None else workers)
+        # Service-wide quota defaults (0 = unlimited); register_tenant
+        # may tighten them per tenant.
+        self.max_rows_per_request = int(
+            os.environ.get(ROWS_ENV, DEFAULT_MAX_ROWS)
+            if max_rows_per_request is None else max_rows_per_request)
+        self.max_reqs_per_s = int(
+            os.environ.get(RATE_ENV, DEFAULT_REQS_PER_S)
+            if max_reqs_per_s is None else max_reqs_per_s)
+        self._quotas: Dict[str, Dict[str, int]] = {}
+        self._admit_times: Dict[str, Any] = {}
         self._backend_factory = backend_factory or self._default_backend
+        if clock is None:
+            from pipelinedp_tpu.resilience.clock import SystemClock
+            clock = SystemClock()
+        self._clock = clock
         self._tr = obs.run_tracer(clock=clock)
         self._q: queue.Queue = queue.Queue(maxsize=self.max_queue)
         self._admit = threading.Lock()
@@ -249,11 +282,25 @@ class Service:
             for i in range(max(1, n_workers))]
         for t in self._workers:
             t.start()
+        # Shape-bucketed request fusion (serve/fusion.py): the dp-safe
+        # ``serve_fusion`` knob arms it (constructor arg wins); off by
+        # default, and on/off is DP-bit-identical per request (PARITY
+        # row 35) — the knob is purely a throughput/latency trade.
+        if fusion is None:
+            from pipelinedp_tpu import plan as plan_mod
+            fusion = bool(plan_mod.knob_value("serve_fusion"))
+        self._fuser = None
+        if fusion:
+            from pipelinedp_tpu.serve import fusion as fusion_mod
+            self._fuser = fusion_mod.Fuser(
+                self, clock=self._clock, window_ms=fuse_window_ms,
+                max_batch=fuse_max_batch, rows_floor=fuse_rows_floor)
         for tenant, (eps, delta) in (tenants or {}).items():
             self.register_tenant(tenant, eps, delta)
         obs.event("serve.started", workers=len(self._workers),
                   max_queue=self.max_queue,
                   max_inflight_per_tenant=self.max_inflight_per_tenant,
+                  fusion=bool(self._fuser is not None),
                   ledger_dir=self.ledger_dir)
 
     # --- lifecycle ---
@@ -264,11 +311,27 @@ class Service:
         return JaxBackend(rng_seed=request.rng_seed)
 
     def register_tenant(self, tenant: str, total_epsilon: float,
-                        total_delta: float) -> Budget:
+                        total_delta: float,
+                        max_rows_per_request: Optional[int] = None,
+                        max_reqs_per_s: Optional[int] = None) -> Budget:
         """Open (or re-open) a tenant's durable budget ledger; returns
-        the remaining budget — which a restart replays from disk."""
+        the remaining budget — which a restart replays from disk.
+        ``max_rows_per_request`` / ``max_reqs_per_s`` tighten the
+        service-wide quotas for THIS tenant (0 = unlimited; None keeps
+        the service default): oversized or too-frequent requests are
+        refused as ``quota`` before any budget reserve or compute."""
+        quotas = {}
+        if max_rows_per_request is not None:
+            quotas["rows"] = int(max_rows_per_request)
+        if max_reqs_per_s is not None:
+            quotas["reqs_per_s"] = int(max_reqs_per_s)
+        if quotas:
+            self._quotas[tenant] = quotas
         return self.budgets.open_tenant(tenant, total_epsilon,
                                         total_delta)
+
+    def _tenant_quota(self, tenant: str, kind: str, default: int) -> int:
+        return int(self._quotas.get(tenant, {}).get(kind, default))
 
     def close(self) -> None:
         """Graceful drain: refuse new submissions, serve everything
@@ -280,9 +343,13 @@ class Service:
         post-join sweep below refunds + refuses anything the departed
         workers left behind — no submitter ever blocks forever."""
         from pipelinedp_tpu import obs
-        from pipelinedp_tpu.obs import monitor as obs_monitor
         with self._admit:
             self._closed.set()
+        # Flush every open fusion window BEFORE stopping the workers:
+        # the flushed batches enter the queue and drain normally, so a
+        # graceful close serves everything already admitted.
+        if self._fuser is not None:
+            self._fuser.close()
         self._stop.set()
         for t in self._workers:
             while t.is_alive():
@@ -290,21 +357,35 @@ class Service:
         self._workers = []
         while True:
             try:
-                pending = self._q.get_nowait()
+                item = self._q.get_nowait()
             except queue.Empty:
                 break
-            tenant, rid = pending.lease.tenant, pending.lease.request_id
-            self._release_lease(pending.lease)
-            self._live.discard((tenant, rid))
-            obs_monitor.unregister_request(rid)
-            pending.finish("refusal", self._refuse(
-                rid, tenant, "shutdown",
-                "service closed before a worker picked this request "
-                "up; " + ("the replayed reserve stays spent (the "
-                          "pre-restart attempt may have drawn noise)"
-                          if pending.lease.replayed else
-                          "the reserve was refunded")))
+            pendings = (item.entries if hasattr(item, "entries")
+                        else [item])
+            for pending in pendings:
+                self._refuse_unworked(
+                    pending, "service closed before a worker picked "
+                    "this request up")
         obs.event("serve.closed")
+
+    def _refuse_unworked(self, pending: "_Pending",
+                         detail: str) -> None:
+        """Refuse a pending no worker will ever serve (the close()
+        sweep, a fused batch stranded by a closing queue): refund the
+        reserve unless replayed, free the live id, finish the
+        submitter exactly once."""
+        from pipelinedp_tpu.obs import monitor as obs_monitor
+        tenant, rid = pending.lease.tenant, pending.lease.request_id
+        self._release_lease(pending.lease)
+        with self._admit:
+            self._live.discard((tenant, rid))
+        obs_monitor.unregister_request(rid)
+        pending.finish("refusal", self._refuse(
+            rid, tenant, "shutdown",
+            detail + "; " + ("the replayed reserve stays spent (the "
+                             "pre-restart attempt may have drawn noise)"
+                             if pending.lease.replayed else
+                             "the reserve was refunded")))
 
     def __enter__(self) -> "Service":
         return self
@@ -376,6 +457,21 @@ class Service:
                 rid, tenant, "malformed",
                 f"tenant '{tenant}' has no ledger under "
                 f"{self.budgets.directory}; register_tenant first")
+        # Row quota: stateless, so it refuses before any shared state
+        # is touched — an oversized request never costs a slot, a
+        # reserve, or any compute.
+        rows_cap = self._tenant_quota(tenant, "rows",
+                                      self.max_rows_per_request)
+        if rows_cap > 0:
+            try:
+                n_rows = len(request.dataset)
+            except TypeError:  # _validate vouched it is sized
+                n_rows = 0
+            if n_rows > rows_cap:
+                return self._refuse(
+                    rid, tenant, "quota",
+                    f"request carries {n_rows} rows, over tenant "
+                    f"'{tenant}'s per-request row quota of {rows_cap}")
         full_detail = (f"request queue is full ({self.max_queue} "
                        "deep); back off and resubmit")
         verdict: Optional[Tuple[str, str]] = None
@@ -390,8 +486,14 @@ class Service:
                     "charge can never release two noisy views — wait "
                     "for the original to finish or use a fresh id")
             else:
+                rate_cap = self._tenant_quota(tenant, "reqs_per_s",
+                                              self.max_reqs_per_s)
+                rate_verdict = (self._check_rate(tenant, rate_cap)
+                                if rate_cap > 0 else None)
                 inflight = self._inflight.get(tenant, 0)
-                if inflight >= self.max_inflight_per_tenant:
+                if rate_verdict is not None:
+                    verdict = rate_verdict
+                elif inflight >= self.max_inflight_per_tenant:
                     verdict = (
                         "tenant_busy",
                         f"tenant '{tenant}' already has {inflight} "
@@ -407,6 +509,9 @@ class Service:
                     # admission.
                     self._inflight[tenant] = inflight + 1
                     self._live.add((tenant, rid))
+                    if rate_cap > 0:
+                        self._admit_times.setdefault(
+                            tenant, []).append(self._clock.monotonic())
         if verdict is not None:
             return self._refuse(rid, tenant, *verdict)
         try:
@@ -437,6 +542,7 @@ class Service:
         # must always follow the registration, or a fast completion
         # would leave a phantom live request in every later heartbeat.
         obs_monitor.register_request(rid, tenant=tenant, phase="queued")
+        routed = False
         with self._admit:
             if self._closed.is_set():  # raced close()
                 verdict = ("shutdown",
@@ -444,10 +550,27 @@ class Service:
             else:
                 pending = _Pending(request, lease, self._seq)
                 self._seq += 1
-                try:
-                    self._q.put_nowait(pending)
-                except queue.Full:  # raced another admitter
-                    verdict = ("queue_full", full_detail)
+        if verdict is None and self._fuser is not None:
+            # The fusion layer sits between admission and the workers:
+            # a fusable request joins its shape bucket here (the
+            # host-side encode runs on THIS caller's thread, so it
+            # parallelizes across tenants); everything else falls
+            # through to the solo queue, including anything offered
+            # while the fuser is closing.
+            try:
+                routed = self._fuser.offer(pending)
+            except Exception:
+                routed = False
+        if verdict is None and not routed:
+            with self._admit:
+                if self._closed.is_set():  # raced close()
+                    verdict = ("shutdown",
+                               "service is draining; submit refused")
+                else:
+                    try:
+                        self._q.put_nowait(pending)
+                    except queue.Full:  # raced another admitter
+                        verdict = ("queue_full", full_detail)
         if verdict is not None:
             # Release BEFORE the rollback drops the id from _live —
             # see _release_lease for the dedup race this order closes.
@@ -462,13 +585,41 @@ class Service:
             raise value
         return value
 
+    def _check_rate(self, tenant: str,
+                    cap: int) -> Optional[Tuple[str, str]]:
+        """Per-tenant admission-rate quota, evaluated (and recorded)
+        under the admission lock: a sliding one-second window of prior
+        admissions on the injectable clock. Refused attempts do not
+        count toward the window — a refused client retrying is not
+        admitted traffic."""
+        now = self._clock.monotonic()
+        times = self._admit_times.get(tenant)
+        if times:
+            cutoff = now - _RATE_WINDOW_S
+            while times and times[0] <= cutoff:
+                times.pop(0)
+            if len(times) >= cap:
+                return ("quota",
+                        f"tenant '{tenant}' exceeded its rate quota "
+                        f"of {cap} request(s)/s; back off and "
+                        "resubmit")
+        return None
+
     def _rollback_admission(self, tenant: str, rid: str) -> None:
-        """Undo a tentative admission: give back the in-flight slot
-        and the live request id."""
+        """Undo a tentative admission: give back the in-flight slot,
+        the live request id AND the rate-window slot — a request later
+        refused (overdraw, queue race, shutdown race) was never
+        admitted traffic, so it must not eat into the tenant's rate
+        quota (the _check_rate contract)."""
         with self._admit:
             self._inflight[tenant] = max(
                 0, self._inflight.get(tenant, 0) - 1)
             self._live.discard((tenant, rid))
+            if self._tenant_quota(tenant, "reqs_per_s",
+                                  self.max_reqs_per_s) > 0:
+                times = self._admit_times.get(tenant)
+                if times:
+                    times.pop()
 
     def _release_lease(self, lease: BudgetLease) -> None:
         """Refund a reserve that failed cleanly before any DP output
@@ -507,38 +658,52 @@ class Service:
 
     # --- the workers ---
 
+    def _make_teardown(self, pending: "_Pending"):
+        def _teardown():
+            with self._admit:
+                tenant = pending.request.tenant
+                self._inflight[tenant] = max(
+                    0, self._inflight.get(tenant, 0) - 1)
+                self._live.discard((tenant,
+                                    pending.lease.request_id))
+        return _teardown
+
     def _worker_loop(self) -> None:
         while True:
             try:
-                pending = self._q.get(timeout=_POLL_S)
+                item = self._q.get(timeout=_POLL_S)
             except queue.Empty:
                 if self._stop.is_set():
                     return
                 continue
-            def _teardown(pending=pending):
-                with self._admit:
-                    tenant = pending.request.tenant
-                    self._inflight[tenant] = max(
-                        0, self._inflight.get(tenant, 0) - 1)
-                    self._live.discard((tenant,
-                                        pending.lease.request_id))
-
-            pending.teardown = _teardown
+            # A queue item is one pending OR a whole fused batch
+            # (serve/fusion.FusedBatch): the worker serves either as a
+            # unit, but every member keeps its own teardown/finish —
+            # leases resolve exactly once per request, batch or not.
+            fused = hasattr(item, "entries")
+            pendings = item.entries if fused else [item]
+            for pending in pendings:
+                pending.teardown = self._make_teardown(pending)
             try:
-                self._execute(pending)
+                if fused:
+                    self._fuser.execute(item)
+                else:
+                    self._execute(item)
             except BaseException as e:  # safety net: a worker must
                 # never die holding an unfinished pending — the
                 # submitter would block forever and the pool would
                 # shrink. Surface the failure on the caller instead.
-                if not pending.done.is_set():
-                    pending.finish("raise", e)
+                for pending in pendings:
+                    if not pending.done.is_set():
+                        pending.finish("raise", e)
             finally:
                 # finish() ran the teardown before unblocking the
-                # submitter; this residual only fires if _execute
-                # somehow exited without ever finishing the pending.
-                teardown, pending.teardown = pending.teardown, None
-                if teardown is not None:
-                    teardown()
+                # submitter; this residual only fires if the execution
+                # somehow exited without ever finishing a pending.
+                for pending in pendings:
+                    teardown, pending.teardown = pending.teardown, None
+                    if teardown is not None:
+                        teardown()
 
     def _warm_entry(self, request: ServeRequest,
                     signature: str) -> Tuple[_WarmEntry, bool]:
@@ -646,11 +811,23 @@ class Service:
                 rid, tenant, "error",
                 f"{type(e).__name__}: {e}"))
             return
+        self._commit_and_respond(pending, accountant, results, warm,
+                                 signature, sp.duration)
+
+    def _commit_and_respond(self, pending: "_Pending", accountant,
+                            results, warm: bool, signature: str,
+                            wall_s: float, fused: bool = False) -> None:
+        """The post-compute tail shared by the solo worker and the
+        fused-batch executor: commit the durable debit, read the
+        remaining budget, snapshot the audit record, append the books
+        entry, unblock the submitter. The DP output exists by now, so
+        a bookkeeping failure surfaces on the CALLER with the reserve
+        left standing — refunding would be the unsafe direction."""
+        from pipelinedp_tpu import obs
+        from pipelinedp_tpu.obs import monitor as obs_monitor
+        lease = pending.lease
+        rid, tenant = lease.request_id, lease.tenant
         try:
-            # The DP output exists past this point; a bookkeeping
-            # failure (commit I/O, audit build) must surface on the
-            # CALLER, with the reserve left standing — the output was
-            # computed, so refunding would be the unsafe direction.
             self.budgets.commit(tenant, rid)
             remaining = self.budgets.remaining(tenant)
             audit_record = accountant.audit_record()
@@ -660,24 +837,27 @@ class Service:
             obs_monitor.unregister_request(rid)
             pending.finish("raise", e)
             return
-        self._append_books(tenant, "serve.request", {
+        books = {
             "request_id": rid,
             "signature": signature,
             "warm": warm,
-            "wall_s": round(sp.duration, 6),
+            "wall_s": round(wall_s, 6),
             "partitions_released": len(results),
             "epsilon": lease.epsilon,
             "delta": lease.delta,
             "remaining_epsilon": remaining.epsilon,
             "remaining_delta": remaining.delta,
             "audit": audit_record,
-        })
+        }
+        if fused:
+            books["fused"] = True
+        self._append_books(tenant, "serve.request", books)
         obs.inc("serve.requests_served")
         obs_monitor.unregister_request(rid)
         pending.finish("response", ServeResponse(
             request_id=rid, tenant=tenant, results=results,
             remaining=remaining, warm=warm, signature=signature,
-            wall_s=sp.duration, audit=audit_record))
+            wall_s=wall_s, audit=audit_record))
 
     # --- per-tenant books ---
 

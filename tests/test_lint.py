@@ -35,7 +35,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LEGACY_MAKE_LINTS = {"nosleep", "nofoldin", "nostager", "noperf",
                      "noartifacts", "nocost", "noknobs", "nopallas",
                      "noserve"}
-NEW_ANALYSES = {"rng-purity", "blocking-under-lock", "jit-staticness"}
+NEW_ANALYSES = {"rng-purity", "blocking-under-lock", "jit-staticness",
+                "fusion-masking"}
 
 
 def findings_for(rule_id, source, rel):
@@ -175,6 +176,29 @@ FIXTURES = {
                   "        atomic_write_json('p.json', snap)\n",
                   "pipelinedp_tpu/serve/budget_ledger.py"),
     },
+    "fusion-masking": {
+        # A second pad/mask policy growing outside serve/fusion.py:
+        # padding request arrays to a bucket shape (or dispatching the
+        # batched kernel) anywhere else risks the engine seeing padded
+        # rows without their validity mask.
+        "bad": ("from pipelinedp_tpu.serve.fusion import (\n"
+                "    pad_request_to_bucket)\n"
+                "from pipelinedp_tpu import jax_engine as je\n\n"
+                "def run_batch(encoded, rows, config, args):\n"
+                "    padded = pad_request_to_bucket(encoded, rows,\n"
+                "                                   True)\n"
+                "    return je.fused_aggregate_batch_kernel(\n"
+                "        config, 8, *args)\n",
+                "pipelinedp_tpu/streaming.py"),
+        # The blessed seam itself never scans (serve/fusion.py is the
+        # rule's blessed module); the clean fixture shows the legal
+        # shape elsewhere — consuming fusion RESULTS without building
+        # padding.
+        "clean": ("def summarize(batch_result):\n"
+                  "    # mentions pad_request_to_bucket only in prose\n"
+                  "    return len(batch_result)\n",
+                  "pipelinedp_tpu/serve/service.py"),
+    },
     "jit-staticness": {
         # PR 9's shape-blind knob-read bug class: ambient reads frozen
         # at trace time.
@@ -205,7 +229,7 @@ class TestRegistry:
         owned = set(rules_mod.legacy_targets())
         assert owned == LEGACY_MAKE_LINTS
 
-    def test_registry_is_exactly_the_twelve_rules(self):
+    def test_registry_is_exactly_the_known_rules(self):
         assert set(rules_mod.rule_ids()) == (
             LEGACY_MAKE_LINTS | NEW_ANALYSES)
 

@@ -67,6 +67,10 @@ HARDCODED_DEFAULTS = {
     "ingest_executor": True,
     "q_chunk": 0,
     "kernel_backend": "xla",
+    "serve_fusion": False,
+    "serve_fuse_window_ms": 8,
+    "serve_fuse_batch": 8,
+    "serve_fuse_rows_floor": 8192,
     "select_units_cap": int(np.iinfo(np.int32).max),
     "tree_rows_cap": int(np.iinfo(np.int32).max),
 }
@@ -81,6 +85,10 @@ def fresh_plan_state(monkeypatch):
                 "PIPELINEDP_TPU_Q_CHUNK", "PIPELINEDP_TPU_STREAM_CHUNK",
                 "PIPELINEDP_TPU_STREAM_CACHE",
                 "PIPELINEDP_TPU_INGEST_EXECUTOR",
+                "PIPELINEDP_TPU_SERVE_FUSION",
+                "PIPELINEDP_TPU_SERVE_FUSE_WINDOW_MS",
+                "PIPELINEDP_TPU_SERVE_FUSE_BATCH",
+                "PIPELINEDP_TPU_SERVE_FUSE_ROWS_FLOOR",
                 "PIPELINEDP_TPU_COMPILE_CACHE"):
         monkeypatch.delenv(var, raising=False)
     obs.reset()
