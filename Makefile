@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast bench smoke multichip lint lintcheck dev clean faultcheck nosleep perfcheck nofoldin obscheck noperf nostager ledgercheck noartifacts watchcheck costcheck nocost plancheck noknobs kernelcheck nopallas servecheck noserve fusecheck fusionmask
+.PHONY: test test-fast bench smoke multichip lint lintcheck dev clean faultcheck nosleep perfcheck nofoldin obscheck noperf nostager ledgercheck noartifacts watchcheck costcheck nocost plancheck noknobs kernelcheck nopallas servecheck noserve fusecheck fusionmask sketchcheck nosketchhash
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -31,10 +31,12 @@ faultcheck: nosleep
 # fault-kill drain (no orphan threads), O(n) assignment, id-narrowing
 # tiers, sweep checkpoint/resume, the kill/resume fault tests — plus
 # the quantile-walk suite (counter-noise generator, three-way walk
-# bit-parity, partition-block chunking, guard-cliff boundaries) and
-# the pass-B sweep suite (planner invariants, multi-tile-vs-per-tile
-# bit-parity, hybrid prefix cache, pass-B fault drain).
-perfcheck:
+# bit-parity, partition-block chunking, guard-cliff boundaries), the
+# pass-B sweep suite (planner invariants, multi-tile-vs-per-tile
+# bit-parity, hybrid prefix cache, pass-B fault drain) and the
+# sketch-first suite (sketchcheck: the ingest ring's third consumer,
+# with its own kill-mid-stream drain proof).
+perfcheck: sketchcheck
 	$(PYTHON) -m pipelinedp_tpu.lint --rule nosleep --rule nofoldin \
 	  --rule nostager --rule nopallas
 	$(PYTHON) -m pytest tests/test_ingest.py tests/test_faults.py \
@@ -77,6 +79,21 @@ fusecheck: fusionmask
 
 fusionmask:
 	$(PYTHON) -m pipelinedp_tpu.lint --rule fusion-masking
+
+# Sketch-first / DP heavy-hitters acceptance suite: seeded stable-hash
+# round-trips at collision-prone widths, matmul-vs-scatter sketch
+# bit-parity (PARITY row 36), per-user pre-sketch bounding invariance,
+# sketch-vs-exact candidate recall on a power-law key space, the
+# cap>=universe bit-parity with the dense path (PARITY row 37, single
+# device + 8-device mesh), the phase-1 budget audit record, the
+# schema-v5 report sketch section, kill-mid-sketch drain (zero orphan
+# pdp-* threads) — plus the sketch-confinement lint (hashing +
+# candidate tables confined to sketch/, raw hash() banned on keys).
+sketchcheck: nosketchhash
+	$(PYTHON) -m pytest tests/test_sketch.py -q
+
+nosketchhash:
+	$(PYTHON) -m pipelinedp_tpu.lint --rule sketch-confinement
 
 # Observability acceptance suite: tracer thread-safety under a live
 # overlapped-ingest run, no-op-mode zero emission, bench-field parity

@@ -1119,6 +1119,101 @@ def bench_serve_fused_throughput(n_rows, smoke=False):
     return rec
 
 
+def bench_dp_heavy_hitters(n_rows, smoke=False):
+    """DP heavy hitters over an unbounded STRING key space — the
+    sketch-first two-phase path (``pipelinedp_tpu/sketch``): power-law
+    synthetic URL-shaped keys (~n_rows/10 distinct strings, zipf mass)
+    stream through a device counting sketch, DP bucket selection picks
+    candidate heavy buckets, and the exact dense engine runs over only
+    the candidates. The record carries the phase split (hash / bound /
+    accumulate / select vs the exact pass), the candidate funnel
+    (universe → selected buckets → candidates → released) and a
+    top-50 recall diagnostic vs the true distinct-user ranking —
+    stamped with fingerprint/plan/kernel-backend like every record so
+    ``--compare`` gates it."""
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu.backends import JaxBackend
+
+    distinct = max(n_rows // 10, 1_000)
+    n_users = max(n_rows // 20, 1_000)
+    rng = np.random.default_rng(23)
+    raw = (rng.zipf(1.2, n_rows) % distinct).astype(np.int64)
+    keys = np.char.add("url/", raw.astype("U12"))
+    pids = rng.integers(0, n_users, n_rows)
+    vals = rng.uniform(0.0, 10.0, n_rows)
+    params = pdp.AggregateParams(
+        metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+        noise_kind=pdp.NoiseKind.LAPLACE,
+        max_partitions_contributed=4,
+        max_contributions_per_partition=2,
+        min_value=0.0, max_value=10.0)
+    sketch = pdp.SketchParams(
+        eps=2.0, delta=1e-7,
+        width=(1 << 12) if smoke else (1 << 16), depth=2,
+        candidate_cap=256 if smoke else 2048)
+
+    def one(seed):
+        ds = pdp.ArrayDataset(privacy_ids=pids, partition_keys=keys,
+                              values=vals)
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                        total_delta=1e-6)
+        engine = pdp.DPEngine(acc, JaxBackend(rng_seed=seed))
+        res = engine.aggregate(ds, params, pdp.DataExtractors(),
+                               sketch_first=sketch)
+        acc.compute_budgets()
+        with tracer().span("bench.dp_heavy_hitters", cat="bench") as sp:
+            out = dict(res)
+        return out, sp.duration, (res.timings or {})
+
+    out, cold_dt, cold_timings = one(31)  # cold: XLA compiles inside
+    best = (out, cold_dt, cold_timings)
+    for r in range(2):
+        trial = one(31 + r)
+        if trial[1] < best[1]:
+            best = trial
+    out, warm_dt, timings = best
+
+    # True top-50 keys by distinct-user count (the utility target).
+    pair = np.unique(pids.astype(np.int64) * distinct + raw)
+    users_per_key = np.bincount((pair % distinct).astype(np.int64),
+                                minlength=distinct)
+    top50 = np.argsort(-users_per_key, kind="stable")[:50]
+    top50_keys = {f"url/{k}" for k in top50.tolist()}
+    recall = (sum(1 for k in top50_keys if k in out) /
+              max(len(top50_keys), 1))
+
+    rec = {
+        "metric": "dp_heavy_hitters_rows_per_sec",
+        "value": round(n_rows / warm_dt),
+        "unit": "rows/s",
+        "rows": n_rows,
+        "distinct_keys": int(len(np.unique(raw))),
+        "sketch_width": sketch.resolved_width(),
+        "sketch_depth": sketch.resolved_depth(),
+        "candidate_cap": sketch.resolved_candidate_cap(),
+        "sketch_backend": sketch.resolved_backend(),
+        "candidates": timings.get("sketch_candidates"),
+        "released_partitions": len(out),
+        "top50_recall": round(recall, 3),
+        "warm_s": round(warm_dt, 3),
+        "cold_s": round(cold_dt, 3),
+        "sketch_hash_s": round(timings.get("sketch_hash_s", 0.0), 3),
+        "sketch_bound_s": round(timings.get("sketch_bound_s", 0.0), 3),
+        "sketch_accumulate_s": round(
+            timings.get("sketch_accumulate_s", 0.0), 3),
+        "sketch_select_s": round(
+            timings.get("sketch_select_s", 0.0), 3),
+        "exact_pass_device_s": round(timings.get("device_s", 0.0), 3),
+    }
+    log(f"## dp_heavy_hitters: {n_rows} rows x "
+        f"{rec['distinct_keys']} distinct strings -> "
+        f"{rec['candidates']} candidates -> {len(out)} released in "
+        f"{warm_dt:.2f}s warm ({rec['value']} rows/s), top50 recall "
+        f"{recall:.2f}")
+    emit(rec)
+    return rec
+
+
 def run_autotune(args):
     """``bench.py --autotune``: the bounded knob sweep that closes the
     measure→decide loop. Runs the streamed-percentile workload once per
@@ -1150,6 +1245,38 @@ def run_autotune(args):
         noise_kind=pdp.NoiseKind.LAPLACE,
         max_partitions_contributed=4, max_contributions_per_partition=2,
         min_value=0.0, max_value=10.0)
+
+    # Sketch-first twin workload: the sketch_backend knob is only a
+    # MEASURED choice if the sweep actually dispatches the sketch
+    # binner — every trial runs the same small sketch-first request
+    # inside its timed span, with the trial vector's backend, so the
+    # base-vs-deviation argmin compares real matmul-vs-scatter work
+    # (not timing noise) and every other deviation pays the identical
+    # sketch cost.
+    hh_rng = np.random.default_rng(29)
+    hh_n = 8_000
+    hh_keys = np.char.add("k/",
+                          (hh_rng.zipf(1.3, hh_n) % 1000).astype("U6"))
+    hh_pids = hh_rng.integers(0, 1000, hh_n)
+    hh_params = pdp.AggregateParams(
+        metrics=[pdp.Metrics.COUNT], noise_kind=pdp.NoiseKind.LAPLACE,
+        max_partitions_contributed=4,
+        max_contributions_per_partition=2)
+
+    def sketch_probe(vec):
+        hh_acc = pdp.NaiveBudgetAccountant(total_epsilon=2.0,
+                                           total_delta=1e-6)
+        hh_engine = pdp.DPEngine(hh_acc, JaxBackend(rng_seed=0))
+        hh_res = hh_engine.aggregate(
+            pdp.ArrayDataset(privacy_ids=hh_pids,
+                             partition_keys=hh_keys, values=None),
+            hh_params, pdp.DataExtractors(),
+            sketch_first=pdp.SketchParams(
+                eps=2.0, delta=1e-7, width=2048, depth=2,
+                candidate_cap=512,
+                backend=str(vec.get("sketch_backend", "xla"))))
+        hh_acc.compute_budgets()
+        dict(hh_res)
 
     led = _bench_ledger()
     # Pre-sweep end offset of the ledger file: the post-sweep fit reads
@@ -1208,6 +1335,7 @@ def run_autotune(args):
                                                "xla")):
             with tracer().span("autotune.trial", cat="autotune") as sp:
                 dict(result)
+                sketch_probe(vec)
         return sp.duration, result.timings or {}
 
     try:
@@ -1967,6 +2095,12 @@ def main():
         # 20k-row same-signature requests): solo vs fused in one
         # process, same-seed bit-parity cross-checked.
         bench_serve_fused_throughput(20_000, smoke=args.smoke)
+
+        # DP heavy hitters over an unbounded string key space: the
+        # sketch-first two-phase path at ~1e7 rows over ~1e6 distinct
+        # power-law keys (smoke: 200k over 20k).
+        bench_dp_heavy_hitters(200_000 if args.smoke else 10_000_000,
+                               smoke=args.smoke)
 
         # Config 5: the analysis epsilon-sweep.
         bench_analysis_sweep(a_rows, max(1000, a_rows // 25),

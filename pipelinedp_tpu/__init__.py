@@ -43,6 +43,7 @@ from pipelinedp_tpu.pipeline_backend import (
     register_annotator,
 )
 from pipelinedp_tpu.jax_engine import ArrayDataset
+from pipelinedp_tpu.sketch import SketchParams
 from pipelinedp_tpu.private_collection import (PrivateCollection,
                                                make_private)
 from pipelinedp_tpu.report_generator import ExplainComputationReport

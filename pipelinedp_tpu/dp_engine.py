@@ -170,14 +170,28 @@ class DPEngine:
                   data_extractors: DataExtractors,
                   public_partitions=None,
                   out_explain_computation_report: Optional[
-                      report_generator.ExplainComputationReport] = None):
+                      report_generator.ExplainComputationReport] = None,
+                  sketch_first=None):
         """Computes DP metrics per partition key.
 
         Returns a collection of (partition_key, MetricsTuple). The graph is
         lazy: execution happens when the backend's runner pulls it, after
         ``budget_accountant.compute_budgets()``.
+
+        ``sketch_first`` (a ``pipelinedp_tpu.sketch.SketchParams``)
+        routes through the two-phase unbounded-key path: a device
+        counting sketch over hashed keys + DP candidate selection
+        (funded by the SketchParams' own (eps, delta)), then this
+        engine's exact dense pass over only the selected candidates —
+        the partition axis is discovered, never materialized densely.
+        Requires the fused JAX backend, privacy ids, fusable metrics
+        and private partition selection (no public partitions).
         """
         self._check_aggregate_params(col, params, data_extractors)
+        if sketch_first is not None:
+            return self._aggregate_sketch_first(
+                col, params, data_extractors, public_partitions,
+                sketch_first, out_explain_computation_report)
         self._record_aggregation_audit("aggregate", params,
                                        public_partitions)
         # Live telemetry (PIPELINEDP_TPU_HEARTBEAT): arm the heartbeat/
@@ -196,6 +210,65 @@ class DPEngine:
                     self._current_report_generator)
             col = self._aggregate(col, params, data_extractors,
                                   public_partitions)
+            budget = self._budget_accountant._compute_budget_for_aggregation(
+                params.budget_weight)
+            return self._backend.annotate(col, "annotation", params=params,
+                                          budget=budget)
+
+    def _aggregate_sketch_first(self, col, params, data_extractors,
+                                public_partitions, sketch_params,
+                                out_explain_computation_report):
+        """The two-phase sketch-first path (``pipelinedp_tpu/sketch``):
+        validates the entry contract, then delegates graph building to
+        ``sketch.engine.build_sketch_first_aggregation`` inside the
+        same scope/report scaffolding as a dense aggregate."""
+        from pipelinedp_tpu import jax_engine
+        from pipelinedp_tpu.sketch import SketchParams
+        from pipelinedp_tpu.sketch import engine as sketch_engine
+
+        if not isinstance(sketch_params, SketchParams):
+            raise TypeError("sketch_first must be a "
+                            "pipelinedp_tpu.sketch.SketchParams")
+        if public_partitions is not None:
+            raise ValueError(
+                "sketch_first discovers the partition axis — it cannot "
+                "be combined with public_partitions (a public axis IS "
+                "the dense path)")
+        if params.contribution_bounds_already_enforced:
+            raise NotImplementedError(
+                "sketch_first needs privacy ids for the phase-1 "
+                "per-user sketch bounding; "
+                "contribution_bounds_already_enforced mode has none")
+        fused, rng_seed, mesh, checkpoint, ingest_executor, \
+            stream_cache = self._fused_backend_options()
+        if not fused:
+            raise NotImplementedError(
+                "sketch_first requires the fused JAX backend "
+                "(JaxBackend) — host backends never stream an "
+                "unbounded key axis")
+        if not jax_engine.params_are_fusable(params):
+            raise NotImplementedError(
+                "sketch_first supports only fused-plane metrics "
+                "(COUNT / PRIVACY_ID_COUNT / SUM / MEAN / VARIANCE / "
+                "VECTOR_SUM / PERCENTILE)")
+        self._record_aggregation_audit("aggregate_sketch_first", params,
+                                       None)
+        from pipelinedp_tpu import obs
+        obs.monitor.maybe_start()
+        with self._budget_accountant.scope(weight=params.budget_weight):
+            self._report_generators.append(
+                report_generator.ReportGenerator(
+                    params, "aggregate_sketch_first", False))
+            if out_explain_computation_report is not None:
+                out_explain_computation_report._set_report_generator(
+                    self._current_report_generator)
+            col = sketch_engine.build_sketch_first_aggregation(
+                col, params, data_extractors, sketch_params,
+                self._budget_accountant,
+                self._current_report_generator,
+                rng_seed=rng_seed, mesh=mesh, checkpoint=checkpoint,
+                ingest_executor=ingest_executor,
+                stream_cache=stream_cache)
             budget = self._budget_accountant._compute_budget_for_aggregation(
                 params.budget_weight)
             return self._backend.annotate(col, "annotation", params=params,

@@ -2199,6 +2199,20 @@ class LazyFusedResult:
             self._cache = self._execute()
         yield from self._cache
 
+    def rebind_rows(self, rows) -> None:
+        """Sketch-first seam (``sketch/engine.py``): phase 2 of the
+        two-phase unbounded-key path replaces the full input with the
+        candidate-filtered rows before first iteration — budgets were
+        registered against the ORIGINAL graph build, which is exactly
+        the two-phase protocol's contract (specs are lazy; only the
+        rows narrow). Refuses after execution: the cache would already
+        embody the old rows."""
+        if self._cache is not None:
+            raise RuntimeError(
+                "cannot rebind rows after the fused result executed")
+        self._rows = rows
+        self._encoded_hint = None
+
     def _execute(self):
         from pipelinedp_tpu import obs
 

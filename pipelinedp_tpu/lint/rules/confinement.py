@@ -97,21 +97,28 @@ class NoFoldinRule(Rule):
 
 
 class NoStagerRule(Rule):
-    """``BackgroundStager`` construction is confined, and
-    ``streaming.py`` keeps exactly its two blessed sites."""
+    """``BackgroundStager`` construction is confined, and the two
+    consumer modules keep exactly their blessed sites."""
 
     id = "nostager"
     legacy_target = "nostager"
     invariant = ("pass-B restreaming flows through the sweep planner's "
-                 "ONE stream source; stray stager constructions "
+                 "ONE stream source (and the sketch phase through its "
+                 "one accumulation loop); stray stager constructions "
                  "silently reintroduce per-tile restreaming")
-    fix_hint = ("stream through streaming.run_sweep / the ingest "
+    fix_hint = ("stream through streaming.run_sweep / "
+                "sketch.engine._accumulate_stream / the ingest "
                 "package; do not construct BackgroundStager directly")
     blessed = ("pipelinedp_tpu/ingest/",)
-    _STREAMING = "pipelinedp_tpu/streaming.py"
-    _ALLOWED_FUNCS = frozenset({"stream_partials_and_select",
-                                "run_sweep"})
-    _MAX_STREAMING_SITES = 2
+    #: consumer module -> (allowed enclosing functions, max sites).
+    _CONSUMERS = {
+        "pipelinedp_tpu/streaming.py": (
+            frozenset({"stream_partials_and_select", "run_sweep"}), 2,
+            "pass A's overlapped loop and run_sweep"),
+        "pipelinedp_tpu/sketch/engine.py": (
+            frozenset({"_accumulate_stream"}), 1,
+            "the sketch accumulation loop"),
+    }
 
     def check(self, ctx):
         sites = []
@@ -119,23 +126,23 @@ class NoStagerRule(Rule):
             if (isinstance(node, ast.Call)
                     and terminal_name(node.func) == "BackgroundStager"):
                 sites.append((node.lineno, func))
-        if ctx.rel != self._STREAMING:
+        consumer = self._CONSUMERS.get(ctx.rel)
+        if consumer is None:
             for line, _ in sites:
                 yield (line, "direct BackgroundStager construction "
-                       "outside ingest/ and streaming.py")
+                       "outside ingest/ and the blessed consumers")
             return
+        allowed, max_sites, prose = consumer
         for line, func in sites:
-            if func not in self._ALLOWED_FUNCS:
+            if func not in allowed:
                 yield (line,
                        f"BackgroundStager site in '{func}' — only "
-                       "pass A's overlapped loop and run_sweep may "
-                       "build stagers")
-        if len(sites) > self._MAX_STREAMING_SITES:
-            for line, _ in sites[self._MAX_STREAMING_SITES:]:
+                       f"{prose} may build stagers here")
+        if len(sites) > max_sites:
+            for line, _ in sites[max_sites:]:
                 yield (line,
-                       f"{len(sites)} stager sites in streaming.py "
-                       f"(max {self._MAX_STREAMING_SITES}: pass A + "
-                       "the sweep planner's run_sweep)")
+                       f"{len(sites)} stager sites in {ctx.rel} "
+                       f"(max {max_sites}: {prose})")
 
 
 class NoPerfRule(Rule):
@@ -388,6 +395,53 @@ class FusionMaskingRule(Rule):
                 yield (node.lineno,
                        "batched-kernel dispatch outside the blessed "
                        "serve-fusion seam")
+
+
+class SketchConfinementRule(Rule):
+    """Key hashing and candidate-table construction are confined to
+    ``sketch/``; raw builtin ``hash()`` is banned on keys everywhere.
+
+    Python's builtin hash is salted per process (``PYTHONHASHSEED``):
+    a key bucketed with it lands in DIFFERENT buckets across runs,
+    resumes and mesh processes, which silently voids sketch replay
+    and candidate-table stability. The seeded stable hash
+    (``sketch/hashing.py``) is the one blessed key hash for
+    replayable key→bucket maps; ``__hash__`` protocol implementations
+    are exempt (dict/set membership is in-process by definition), and
+    equality-semantic in-process uses stay on builtin hash() via a
+    reasoned suppression."""
+
+    id = "sketch-confinement"
+    legacy_target = None  # born with `make sketchcheck`, never a grep
+    invariant = ("key→bucket maps are pure functions of (key bytes, "
+                 "seed): raw hash() is process-salted and cannot "
+                 "replay; bucket derivation and candidate tables have "
+                 "ONE owner (sketch/) so the DP selection's "
+                 "sensitivity story cannot fork")
+    fix_hint = ("hash keys via pipelinedp_tpu.sketch.hashing "
+                "(stable_hash64 / stable_hash_any); build candidate/"
+                "bucket tables only inside sketch/")
+    blessed = ("pipelinedp_tpu/sketch/",)
+    #: Construction confined to sketch/: deriving bucket rows and
+    #: building the key→candidate-id table ARE the sketch mechanism —
+    #: a second site means a second sensitivity story.
+    _CONFINED_CALLS = frozenset({"bucket_ids", "build_candidate_table"})
+
+    def check(self, ctx):
+        for node, func in walk_with_function(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if (isinstance(fn, ast.Name) and fn.id == "hash"
+                    and func != "__hash__"):
+                yield (node.lineno,
+                       "raw builtin hash() — process-salted, cannot "
+                       "replay; use sketch.hashing.stable_hash_any")
+            elif terminal_name(fn) in self._CONFINED_CALLS:
+                yield (node.lineno,
+                       f"{terminal_name(fn)}() outside sketch/ — "
+                       "bucket/candidate-table construction has one "
+                       "owner")
 
 
 PORTED_RULES = (NoSleepRule, NoFoldinRule, NoStagerRule, NoPerfRule,

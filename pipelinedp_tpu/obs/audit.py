@@ -48,6 +48,7 @@ _lock = threading.Lock()
 _accountants: List[Dict[str, Any]] = []
 _aggregations: List[Dict[str, Any]] = []
 _metric_errors: List[Dict[str, Any]] = []
+_sketches: List[Dict[str, Any]] = []
 _dropped = 0
 
 
@@ -66,6 +67,7 @@ def reset() -> None:
         _accountants.clear()
         _aggregations.clear()
         _metric_errors.clear()
+        _sketches.clear()
         _dropped = 0
 
 
@@ -128,6 +130,16 @@ def record_metric_error(record: Dict[str, Any]) -> None:
     _append(_metric_errors, record)
 
 
+def record_sketch(record: Dict[str, Any]) -> None:
+    """One sketch-first phase-1 run's shape and outcome: width/depth/
+    cap/backend, the selection budget and threshold, bucket pre/post
+    counts and candidate counts (``sketch/engine.py`` pushes it; the
+    run report's schema-v5 ``sketch`` section reads it). Counts are
+    data-dependent diagnostics, same tier as the selection pre/post
+    counters — the record never carries key material."""
+    _append(_sketches, record)
+
+
 def cursor() -> Dict[str, int]:
     """Current registry lengths — pass back as ``since`` to
     :func:`build_privacy_section` for a delta view (the per-request
@@ -136,7 +148,20 @@ def cursor() -> Dict[str, int]:
     with _lock:
         return {"accountants": len(_accountants),
                 "aggregations": len(_aggregations),
-                "expected_errors": len(_metric_errors)}
+                "expected_errors": len(_metric_errors),
+                "sketches": len(_sketches)}
+
+
+def build_sketch_section(since: Optional[Dict[str, int]] = None
+                         ) -> List[Dict[str, Any]]:
+    """The run report's ``sketch`` section body: every sketch-first
+    phase-1 record since ``since`` (a :func:`cursor` value), oldest
+    first. Empty list when no sketch ran — the report then omits the
+    section (the v1–v4-compatible reading)."""
+    since = since or {}
+    with _lock:
+        start = min(int(since.get("sketches", 0)), len(_sketches))
+        return [dict(r) for r in _sketches[start:]]
 
 
 def build_privacy_section(

@@ -416,12 +416,14 @@ class _InstrumentedFunction:
             avals = tuple(str(shaped_abstractify(leaf))
                           for leaf in leaves)
             key = (tuple(statics), treedef, avals)
+            # lint: disable=sketch-confinement(in-process hashability probe of a jit signature tuple, not a data key)
             hash(key)
         except TypeError:
             return None  # unhashable static: let jax handle it
         return key, dyn_args, dyn_kwargs
 
     def _table_key(self, key) -> str:
+        # lint: disable=sketch-confinement(in-process program-table digest of a jit signature, not a data key; never persisted)
         return f"{self._fn.__name__}#{abs(hash(key)) % (16 ** 8):08x}"
 
     def _signature_label(self, key) -> str:

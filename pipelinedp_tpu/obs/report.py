@@ -41,7 +41,12 @@ from pipelinedp_tpu.obs import costs as _costs
 #: file hash, predicted vs observed seconds — ``pipelinedp_tpu.plan``);
 #: absent in v1–v3 reports AND in v4 runs that resolved no knobs,
 #: which readers treat as "default knobs, no plan in force".
-SCHEMA_VERSION = 4
+#: v5 (sketch-first PR): adds the ``sketch`` section (per sketch-first
+#: phase-1 run: width/depth/cap/backend, selection budget + threshold,
+#: bucket pre/post and candidate counts — ``obs.audit.record_sketch``);
+#: absent in v1–v4 reports AND in v5 runs with no sketch phase, which
+#: readers treat as "no sketch-first request ran".
+SCHEMA_VERSION = 5
 
 _git_probe_cache: Optional[Tuple[str, bool]] = None
 
@@ -160,6 +165,12 @@ def build_run_report(snapshot: Dict[str, Any], mesh=None,
                     "events": snapshot.get("dropped_events", 0),
                     "samples": snapshot.get("dropped_samples", 0)},
     }
+    # v5: the sketch-first phase-1 records — included whenever a
+    # sketch ran this run (absent = no sketch, the v1–v4-compatible
+    # reading).
+    sketch_runs = _audit.build_sketch_section()
+    if sketch_runs:
+        report["sketch"] = {"runs": sketch_runs}
     # v3: the device-cost observatory — included whenever programs were
     # captured (absent = not captured, the v1/v2-compatible reading).
     device_costs = _costs.TABLE.snapshot()

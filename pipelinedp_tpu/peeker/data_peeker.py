@@ -2,7 +2,14 @@
 aggregates for interactive utility analysis (capability parity with the
 reference's ``utility_analysis/data_peeker.py``; its stale
 ``pipeline_dp.accumulator`` dependency in ``sketch`` is replaced by the
-live combiner layer, SURVEY.md §2.8)."""
+live combiner layer, SURVEY.md §2.8).
+
+The non-private sketch plumbing itself now lives in
+``pipelinedp_tpu.sketch.peek`` (one canonical implementation — the
+sketch subsystem owns all sketching); :meth:`DataPeeker.sketch` is a
+thin shim over it. These outputs carry RAW values and are not
+releasable; the genuinely DP sketch path is
+``DPEngine.aggregate(..., sketch_first=...)``."""
 
 from __future__ import annotations
 
@@ -10,7 +17,7 @@ import dataclasses
 import functools
 from typing import List, Optional
 
-from pipelinedp_tpu.aggregate_params import Metric, Metrics
+from pipelinedp_tpu.aggregate_params import Metric
 from pipelinedp_tpu.dp_engine import DataExtractors
 from pipelinedp_tpu.peeker import non_private_combiners
 
@@ -36,67 +43,19 @@ class DataPeeker:
 
     def _sample_partitions(self, col, n_partitions):
         """(pk, value) -> same, keeping only n sampled partition keys."""
-        col = self._be.group_by_key(col, "Group by pk")
-        col = self._be.map_tuple(col, lambda pk, vs: (1, (pk, vs)),
-                                 "Rekey to (1, (pk, values))")
-        col = self._be.sample_fixed_per_key(col, n_partitions,
-                                            "Sample partitions")
-        return self._be.flat_map(col, lambda one_and_list: one_and_list[1],
-                                 "Extract sampled (pk, values)")
+        from pipelinedp_tpu.sketch import peek
+        return peek.sample_partitions(self._be, col, n_partitions)
 
     def sketch(self, input_data, params: SampleParams,
                data_extractors: DataExtractors):
         """Sketches: one row (partition_key, aggregated_value,
         partition_count) per unique (pk, privacy_id), over a sample of
-        partitions (reference :77-183)."""
-        if params.metrics is None:
-            raise ValueError("Must provide aggregation metrics for sketch.")
-        if len(params.metrics) != 1 or params.metrics[0] not in (
-                Metrics.SUM, Metrics.COUNT):
-            raise ValueError("Sketch only supports a single aggregation "
-                             "and it must be COUNT or SUM.")
-        combiner = non_private_combiners.create_compound_combiner(
-            params.metrics)
-
-        col = self._be.map(input_data,
-                           functools.partial(_extract_fn, data_extractors),
-                           "Extract (privacy_id, partition_key, value)")
-        col = self._be.map_tuple(col, lambda pid, pk, v: (pk, (pid, v)),
-                                 "Rekey to (pk, (pid, value))")
-        col = self._sample_partitions(
-            col, params.number_of_sampled_partitions)
-
-        def flatten_sampled(pk_and_pid_values):
-            pk, pid_values = pk_and_pid_values
-            return [((pk, pid), v) for pid, v in pid_values]
-
-        col = self._be.flat_map(col, flatten_sampled,
-                                "Flatten to ((pk, pid), value)")
-        col = self._be.group_by_key(col, "Group by (pk, pid)")
-        col = self._be.map_values(col, combiner.create_accumulator,
-                                  "Aggregate per (pk, pid)")
-        # ((pk, pid), compound_accumulator)
-        col = self._be.map_tuple(
-            col, lambda pk_pid, acc: (pk_pid[1], (pk_pid[0], acc)),
-            "Rekey to (pid, (pk, accumulator))")
-        col = self._be.group_by_key(col, "Group by privacy id")
-
-        def attach_partition_count(pk_acc_list):
-            partition_count = len(set(pk for pk, _ in pk_acc_list))
-            return partition_count, pk_acc_list
-
-        col = self._be.map_values(col, attach_partition_count,
-                                  "Compute partition count")
-
-        def flatten_results(pid_and_rest):
-            _, (pcount, pk_acc_list) = pid_and_rest
-            # Compound accumulator = (row_count, (child_acc,)); the single
-            # raw child accumulator IS the aggregated value.
-            return [(pk, acc[1][0], pcount) for pk, acc in pk_acc_list]
-
-        return self._be.flat_map(
-            col, flatten_results,
-            "Flatten to (pk, aggregated_value, partition_count)")
+        partitions (reference :77-183). Thin shim over the sketch
+        subsystem's non-private peek path — RAW values, not
+        releasable."""
+        from pipelinedp_tpu.sketch import peek
+        return peek.non_private_sketch(self._be, input_data, params,
+                                       data_extractors)
 
     def sample(self, input_data, params: SampleParams,
                data_extractors: DataExtractors):

@@ -424,8 +424,17 @@ class MultiProcLocalBackend(PipelineBackend):
             lambda: self._apply_chunked(col, fn, "filter"))
 
     def _shard_by_key(self, col):
+        # Builtin hash() is CORRECT here and the stable key hash is
+        # not: shard assignment must agree with key EQUALITY (custom
+        # __eq__/__hash__ objects, 1 == 1.0) or one key's rows split
+        # across shards and group_by_key silently emits duplicate
+        # groups. It runs only in the parent process (workers receive
+        # already-built shards) and is never persisted, so process-
+        # salting is irrelevant — this is load balancing, not a
+        # replayable key→bucket map.
         shards = [[] for _ in range(self._n_jobs)]
         for kv in col:
+            # lint: disable=sketch-confinement(in-process shard balancing must follow object equality (__hash__); parent-process only, never persisted or replayed)
             shards[hash(kv[0]) % self._n_jobs].append(kv)
         return shards
 

@@ -173,6 +173,38 @@ REGISTRY: Tuple[KnobSpec, ...] = (
         "compute); clamped to >= 8192 (the solo row-padding floor). "
         "dp-safe: released values are padding-invariant."),
     KnobSpec(
+        "sketch_width", "hash buckets (row-0 selection axis)", 1 << 16,
+        "PIPELINEDP_TPU_SKETCH_WIDTH", None, False, int,
+        "Buckets per counting-sketch row in the sketch-first path "
+        "(sketch/). NOT dp-safe: the bucket grid decides which keys "
+        "become candidates, so a plan never changes it — env override, "
+        "explicit SketchParams and default only. Rounded up to a "
+        "multiple of 256 on device (the matmul binner's radix width)."),
+    KnobSpec(
+        "sketch_depth", "sketch rows (hash remixes)", 2,
+        "PIPELINEDP_TPU_SKETCH_DEPTH", None, False, int,
+        "Counting-sketch depth: row 0 selects candidate buckets, rows "
+        "1+ refine the count-min mass estimate in the run report. NOT "
+        "dp-safe (part of the selection mechanism's shape)."),
+    KnobSpec(
+        "sketch_candidate_cap", "selected buckets (DP top-K cap)", 4096,
+        "PIPELINEDP_TPU_SKETCH_CANDIDATE_CAP", None, False, int,
+        "Max buckets phase-1 selection keeps (the DP top-K cap over "
+        "noisy sketch mass — the cap lives INSIDE the DP mechanism, on "
+        "buckets, never on data-derived key lists). NOT dp-safe: it "
+        "changes the releasable candidate set."),
+    KnobSpec(
+        "sketch_backend", "matmul | xla", "matmul",
+        "PIPELINEDP_TPU_SKETCH_BACKEND", None, True, str,
+        "Device formulation of the sketch binner: 'matmul' (radix "
+        "one-hot MXU contraction, sketch/device.py — the default) or "
+        "'xla' (the scatter-add reference). dp-safe: both are exact "
+        "integer arithmetic and bit-identical (PARITY row 36), so the "
+        "autotune sweep may measure either. Like the serve knobs, no "
+        "module seam — SketchParams.backend is the injection point, so "
+        "resolving the registry never imports sketch/ into non-sketch "
+        "runs.", choices=("matmul", "xla")),
+    KnobSpec(
         "select_units_cap", "privacy units per partition", _I32_MAX,
         None, ("pipelinedp_tpu.streaming", "_SELECT_UNITS_CAP"),
         False, int,

@@ -423,6 +423,13 @@ def autotune_candidates() -> list:
             # knob, so a device where it loses (CPU interpret mode)
             # self-selects "xla" from the trial argmin.
             {"kernel_backend": "pallas"},
+            # The sketch binner's scatter reference: dp-safe (PARITY
+            # row 36) so it sweeps with the rest. Every autotune trial
+            # dispatches a small sketch-first request with its
+            # vector's backend (bench.run_autotune's sketch_probe), so
+            # this deviation's argmin is a measured matmul-vs-scatter
+            # comparison, not timing noise.
+            {"sketch_backend": "xla"},
     ):
         vec = dict(base)
         vec.update(deviation)

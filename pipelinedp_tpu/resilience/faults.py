@@ -68,6 +68,10 @@ class FaultPlan:
     fail_pass_b_chunks: Tuple[int, ...] = ()
     #: first N coordinator connections raise ``CoordinatorTimeout``.
     coordinator_timeouts: int = 0
+    #: sketch-accumulation chunk indices whose dispatch raises
+    #: ``ChunkFailure`` (kills a sketch-first phase 1 mid-stream; the
+    #: ingest stager must drain to zero orphan ``pdp-*`` threads).
+    fail_sketch_chunks: Tuple[int, ...] = ()
     #: serve-request admission indices (0-based, in admission order)
     #: whose compute raises ``ServeKill`` mid-request — AFTER the
     #: durable budget reserve, BEFORE commit/release. The resident
@@ -99,6 +103,9 @@ class FaultPlan:
         if self.fail_pass_b_chunks:
             parts.append("fail_pass_b_chunks=" +
                          ":".join(str(c) for c in self.fail_pass_b_chunks))
+        if self.fail_sketch_chunks:
+            parts.append("fail_sketch_chunks=" +
+                         ":".join(str(c) for c in self.fail_sketch_chunks))
         if self.coordinator_timeouts:
             parts.append(f"coordinator_timeouts={self.coordinator_timeouts}")
         if self.fail_serve_requests:
@@ -120,7 +127,8 @@ def plan_from_env(spec: str) -> FaultPlan:
             continue
         k, _, v = item.partition("=")
         if k in ("fail_chunks", "fail_pass_b_chunks",
-                 "hold_fetch_batches", "fail_serve_requests"):
+                 "fail_sketch_chunks", "hold_fetch_batches",
+                 "fail_serve_requests"):
             kw[k] = tuple(int(c) for c in v.split(":") if c)
         elif k == "wedged_hold":
             kw[k] = bool(int(v))
@@ -249,6 +257,19 @@ def check_serve_request(index: int) -> None:
         raise ServeKill(
             f"injected hard kill at serve request {index} (reserved "
             "budget debit must survive the restart)")
+
+
+def check_sketch_chunk(index: int) -> None:
+    """Raise :class:`ChunkFailure` when the active plan kills sketch
+    chunk ``index`` (the sketch-first phase-1 accumulation stream) —
+    the kill lands on the dispatch thread between the stager's handoff
+    and the device binner, so the drain proof covers the ingest ring
+    mid-sketch."""
+    plan = active()
+    if plan is not None and index in plan.fail_sketch_chunks:
+        _record("sketch_chunk_failure", index=int(index))
+        raise ChunkFailure(
+            f"injected failure at sketch chunk {index}")
 
 
 def check_pass_b_chunk(index: int) -> None:

@@ -1,0 +1,41 @@
+"""Sketch-first ingest + DP heavy hitters: the unbounded-key path.
+
+Removes the last dense-key-universe assumption: when the partition
+axis is URLs / queries / user-generated strings (billions of
+candidates, power-law mass), the key space is **discovered** through
+a two-phase path instead of materialized in HBM:
+
+* phase 1 — a device-resident ``[depth, width]`` counting sketch over
+  seeded stable hashes of the keys (one-hot-matmul binning, fed in
+  chunks through the ingest ring; per-user contribution bounded
+  BEFORE accumulation), then DP candidate selection over the bucket
+  masses (Laplace noise via the counter-based generator, budget drawn
+  through ``budget_accounting`` with a proper audit record);
+* phase 2 — the existing exact dense engine over ONLY the selected
+  candidates, via a host-side key→candidate-id table; private
+  partition selection and noise run exactly as a dense run.
+
+Entry point: ``DPEngine.aggregate(col, params, extractors,
+sketch_first=SketchParams(eps=..., delta=...))``.
+
+Import discipline: this ``__init__`` stays light (hashing + params
+only — numpy, no jax) so the blessed stable hash is importable from
+anywhere without pulling the engine. The ``sketch-confinement`` lint confines hashing and
+candidate-table construction to this package and bans raw ``hash()``
+on keys everywhere else.
+"""
+
+from pipelinedp_tpu.sketch import hashing
+from pipelinedp_tpu.sketch.hashing import (DEFAULT_SEED, bucket_ids,
+                                           stable_hash64,
+                                           stable_hash_any)
+from pipelinedp_tpu.sketch.params import SketchParams
+
+__all__ = [
+    "DEFAULT_SEED",
+    "SketchParams",
+    "bucket_ids",
+    "hashing",
+    "stable_hash64",
+    "stable_hash_any",
+]

@@ -2,11 +2,11 @@
 
 Covers, per the PR-13 acceptance criteria:
 
-* one bad-fixture + one clean-fixture per rule (12 rules x 2) — the
+* one bad-fixture + one clean-fixture per rule (14 rules x 2) — the
   bad fixture proves the rule FIRES, the clean one proves the blessed
   location/shape passes;
 * the registry meta-test: every legacy Makefile grep lint name is
-  owned by a rule, the three born-AST analyses exist, and every
+  owned by a rule, the five born-AST analyses exist, and every
   registered rule has a fixture pair here;
 * the seeded regressions from the issue: a ``time.sleep`` "in"
   ``streaming.py``, an ``atomic_write_json`` inside a
@@ -36,7 +36,7 @@ LEGACY_MAKE_LINTS = {"nosleep", "nofoldin", "nostager", "noperf",
                      "noartifacts", "nocost", "noknobs", "nopallas",
                      "noserve"}
 NEW_ANALYSES = {"rng-purity", "blocking-under-lock", "jit-staticness",
-                "fusion-masking"}
+                "fusion-masking", "sketch-confinement"}
 
 
 def findings_for(rule_id, source, rel):
@@ -198,6 +198,24 @@ FIXTURES = {
                   "    # mentions pad_request_to_bucket only in prose\n"
                   "    return len(batch_result)\n",
                   "pipelinedp_tpu/serve/service.py"),
+    },
+    "sketch-confinement": {
+        # Raw builtin hash() on a key: process-salted, cannot replay —
+        # bucket/candidate derivation must use the seeded stable hash.
+        "bad": ("def shard_of(key, n):\n"
+                "    return hash(key) % n\n",
+                "pipelinedp_tpu/streaming.py"),
+        # __hash__ protocol implementations are exempt (in-process
+        # dict/set membership, not key bucketing), and calling the
+        # blessed stable hash is the legal shape everywhere.
+        "clean": ("from pipelinedp_tpu.sketch.hashing import (\n"
+                  "    stable_hash_any)\n\n\n"
+                  "class Metric:\n"
+                  "    def __hash__(self):\n"
+                  "        return hash((self.name, self.param))\n\n\n"
+                  "def shard_of(key, n):\n"
+                  "    return stable_hash_any(key) % n\n",
+                  "pipelinedp_tpu/streaming.py"),
     },
     "jit-staticness": {
         # PR 9's shape-blind knob-read bug class: ambient reads frozen

@@ -71,6 +71,10 @@ HARDCODED_DEFAULTS = {
     "serve_fuse_window_ms": 8,
     "serve_fuse_batch": 8,
     "serve_fuse_rows_floor": 8192,
+    "sketch_width": 1 << 16,
+    "sketch_depth": 2,
+    "sketch_candidate_cap": 4096,
+    "sketch_backend": "matmul",
     "select_units_cap": int(np.iinfo(np.int32).max),
     "tree_rows_cap": int(np.iinfo(np.int32).max),
 }
@@ -192,7 +196,7 @@ class TestPlanFile:
         assert applied["stream_chunk_rows"]["source"] == "default"
         # ... and the run report grows the schema-v4 plan section.
         report = obs.build_run_report()
-        assert report["schema_version"] == 4
+        assert report["schema_version"] == 5
         assert report["plan"]["knobs"]["subhist_byte_cap"] == {
             "value": 12345678, "source": "plan"}
         assert report["plan"]["plan_hash"] == resolved.plan_hash
