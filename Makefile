@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast bench smoke multichip lint lintcheck dev clean faultcheck nosleep perfcheck nofoldin obscheck noperf nostager ledgercheck noartifacts watchcheck costcheck nocost plancheck noknobs kernelcheck nopallas servecheck noserve fusecheck fusionmask sketchcheck nosketchhash
+.PHONY: test test-fast bench smoke multichip lint lintcheck dev clean faultcheck chaoscheck nosleep perfcheck nofoldin obscheck noperf nostager ledgercheck noartifacts watchcheck costcheck nocost plancheck noknobs kernelcheck nopallas servecheck noserve fusecheck fusionmask sketchcheck nosketchhash
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -23,9 +23,20 @@ multichip:
 	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
 # Fault-injection suite (includes the end-to-end degraded-bench run)
-# + the no-direct-sleep invariant.
-faultcheck: nosleep
-	$(PYTHON) -m pytest tests/test_resilience.py tests/test_faults.py -q
+# + the no-direct-sleep invariant + the seeded chaos campaign.
+faultcheck: nosleep chaoscheck
+	$(PYTHON) -m pytest tests/test_resilience.py tests/test_faults.py \
+	  tests/test_chaos.py -q
+
+# Seeded chaos campaign: 20 deterministic episodes across EVERY
+# FaultPlan seam (stream/pass-B/sketch kills, device loss with elastic
+# mesh re-form, wedged probe on a FakeClock, serve kill with
+# exactly-once lease replay, torn ledger + fsck), with per-episode
+# recovery invariants. CPU mesh, zero real sleeps — tier-1-safe. Set
+# PIPELINEDP_TPU_CHAOS_SEED to replay a specific campaign; a failing
+# episode prints its exact reproduction command.
+chaoscheck:
+	$(PYTHON) -m pipelinedp_tpu.resilience.chaos --schedules 20
 
 # Performance-path acceptance suite: overlapped-ingest bit-parity,
 # fault-kill drain (no orphan threads), O(n) assignment, id-narrowing

@@ -554,6 +554,10 @@ def bench_streaming(n_rows):
         "overlap_frac": round(
             (timings or {}).get("stream_overlap_frac", 0.0), 3),
         "executor": (timings or {}).get("stream_executor"),
+        # Elastic recovery provenance: 0 on a healthy run; nonzero
+        # means the mesh shrank mid-stream and this throughput number
+        # covers a re-form + checkpoint resume, not a clean pass.
+        "mesh_reshards": (timings or {}).get("stream_mesh_reshards", 0),
     }
     log(f"## streaming ingest: {n_rows} rows ({rec['stream_batches']} "
         f"batches) in {total:.1f}s ({rps:.0f} rows/s, cold incl. "

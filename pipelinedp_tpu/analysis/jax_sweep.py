@@ -1026,12 +1026,18 @@ class LazySweepResult:
             # would re-broadcast to every device on each chunk iteration.
             from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as PSpec
+
+            from pipelinedp_tpu.parallel import sharded as _psh
             repl_sharding = NamedSharding(self._mesh, PSpec())
+            # put_global, NOT device_put: on a multi-process mesh a raw
+            # device_put here would dispatch a hidden equality-check
+            # collective per array that races with the sweep kernel's
+            # all_gathers (see parallel/sharded.py:put_global).
             (marker, pk_safe, count_u, sum_u, npart_u, users_in, dlog_rs,
-             dt_table) = jax.device_put(
+             dt_table) = _psh.put_global(
                  (marker, pk_safe, count_u, sum_u, npart_u, users_in,
                   log_rs, t_table), repl_sharding)
-            cfg = jax.device_put(host_cfg, repl_sharding)
+            cfg = _psh.put_global(host_cfg, repl_sharding)
         else:
             dlog_rs, dt_table = jax.device_put((log_rs, t_table))
             cfg = jax.device_put(host_cfg)

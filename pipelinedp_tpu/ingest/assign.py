@@ -35,6 +35,33 @@ from typing import Tuple
 import numpy as np
 
 
+def regroup_cells(counts: np.ndarray, n_dev_new: int) -> np.ndarray:
+    """Regroup a saved ``[n_batches, n_dev_old]`` per-cell row-count
+    table onto a SMALLER mesh whose size divides the old one: new shard
+    ``d`` takes the ``g = n_dev_old // n_dev_new`` contiguous old cells
+    ``[d*g, (d+1)*g)`` of each batch, so the staging loop's consecutive
+    ``order`` slices keep every old cell's rows — and therefore every
+    privacy unit's rows — contiguous inside one new shard. Used by the
+    elastic resume: the ROW ORDER of the original assignment is reused
+    verbatim, only the cell boundaries coarsen.
+
+    (The grouping is contiguous, not the ``fmix32(pid) % n_dev_new``
+    placement a fresh run at the new shape would compute. With
+    non-binding contribution caps that is output-irrelevant: per-shard
+    partials combine by an additive ``psum``, so WHICH surviving shard
+    a row lands on never reaches the released values — the same
+    replay caveat ``parallel/sharded.py`` documents for binding caps.)
+    """
+    counts = np.asarray(counts)
+    n_batches, n_dev_old = counts.shape
+    if n_dev_old % n_dev_new:
+        raise ValueError(
+            f"cannot regroup {n_dev_old} shard cells onto {n_dev_new} "
+            "devices: the new mesh size must divide the old one")
+    g = n_dev_old // n_dev_new
+    return counts.reshape(n_batches, n_dev_new, g).sum(axis=2)
+
+
 def group_rows_by_cell(cell_of_row: np.ndarray,
                        n_cells: int) -> Tuple[np.ndarray, np.ndarray]:
     """Stable O(n) grouping of row indices by cell id.

@@ -1071,11 +1071,16 @@ def _reduce_per_pk(config: FusedConfig, pk_safe, masked, keep_row,
     return part, nseg
 
 
-def _fold_fixedpoint(config: FusedConfig, part64, fx_bits: int) -> None:
-    """Reassembles the fixed-point lane columns into float64 values
-    (mutates ``part64``): value = (sum of lanes * 2^(bits*k) - entries *
-    offset) / scale. ``entries`` (the per-partition count of contributing
-    rows/segments) is exact int, so the offset removal is exact."""
+def _fold_fx_steps(config: FusedConfig, part64, fx_bits: int) -> None:
+    """Reassembles the fixed-point lane columns into EXACT step totals
+    (mutates ``part64``): steps = sum of lanes * 2^(bits*k) - entries *
+    offset. Every term is an integer below 2^53, so the float64 result
+    is exact — which is what lets the streaming fold accumulate these
+    across chunks and divide by the (non-power-of-two) scale ONCE at
+    release: a per-chunk division would round per chunk, making the
+    released low bits a function of the batch boundaries (and therefore
+    of the mesh size — the elastic reshard-resume parity would only
+    hold by luck)."""
     n_lanes = -(-_FX_PAYLOAD_BITS // fx_bits)
     for spec in _fixedpoint_layout(config):
         total = np.zeros_like(part64[spec.count_col], dtype=np.float64)
@@ -1084,7 +1089,17 @@ def _fold_fixedpoint(config: FusedConfig, part64, fx_bits: int) -> None:
                 np.float64) * float(1 << (k * fx_bits))
         if spec.signed:
             total -= part64[spec.count_col].astype(np.float64) * _FX_OFFSET
-        part64[spec.name] = total / spec.scale
+        part64[spec.name] = total
+
+
+def _fold_fixedpoint(config: FusedConfig, part64, fx_bits: int) -> None:
+    """Reassembles the fixed-point lane columns into float64 values
+    (mutates ``part64``): value = (sum of lanes * 2^(bits*k) - entries *
+    offset) / scale. ``entries`` (the per-partition count of contributing
+    rows/segments) is exact int, so the offset removal is exact."""
+    _fold_fx_steps(config, part64, fx_bits)
+    for spec in _fixedpoint_layout(config):
+        part64[spec.name] = part64[spec.name] / spec.scale
 
 
 def _qrows(config: FusedConfig, pk, values, kept):
@@ -2272,6 +2287,15 @@ class LazyFusedResult:
                     stream_stats["resumed_from_batch"])
                 self.timings["stream_checkpoint_saves"] = (
                     stream_stats["checkpoint_saves"])
+            # Elastic recovery trail: reshard count + history reach the
+            # run report/bench record, so a run that survived a device
+            # loss says so (and at which chunk) instead of
+            # masquerading as an uneventful capture.
+            if stream_stats.get("mesh_reshards"):
+                self.timings["stream_mesh_reshards"] = (
+                    stream_stats["mesh_reshards"])
+                self.timings["stream_reshard_history"] = (
+                    stream_stats["reshard_history"])
             # Transfer/compute split: staging+enqueue wall vs the time
             # blocked waiting for kernel results (the overlap evidence).
             self.timings["stream_stage_s"] = stream_stats["stage_s"]

@@ -113,8 +113,9 @@ class NoStagerRule(Rule):
     #: consumer module -> (allowed enclosing functions, max sites).
     _CONSUMERS = {
         "pipelinedp_tpu/streaming.py": (
-            frozenset({"stream_partials_and_select", "run_sweep"}), 2,
-            "pass A's overlapped loop and run_sweep"),
+            frozenset({"_stream_impl", "run_sweep"}), 2,
+            "pass A's overlapped loop (inside the elastic wrapper's "
+            "_stream_impl) and run_sweep"),
         "pipelinedp_tpu/sketch/engine.py": (
             frozenset({"_accumulate_stream"}), 1,
             "the sketch accumulation loop"),

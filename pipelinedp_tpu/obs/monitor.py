@@ -166,6 +166,8 @@ def reset_requests() -> None:
     with _REQS_LOCK:
         _LIVE_REQUESTS.clear()
     update_fusion(None)
+    update_mesh(None)
+    update_serve_health(None)
 
 
 # The serve-fusion bucket registry: the fusion layer (serve/fusion.py)
@@ -191,6 +193,53 @@ def update_fusion(snapshot: Optional[Dict[str, Any]]) -> None:
 def fusion_snapshot() -> Optional[Dict[str, Any]]:
     with _FUSION_LOCK:
         return dict(_FUSION_STATE) if _FUSION_STATE is not None else None
+
+
+# The mesh-recovery registry: the elastic streaming wrapper PUSHES the
+# mesh's recovery state here on every reshard (old shape -> new shape,
+# reason, reshard count) — same push-registry pattern as the fusion
+# occupancy above, for the same reason: the monitor must never import
+# the layers it observes. The heartbeat grows a "mesh" section while a
+# snapshot is installed, so a run that survived a device loss says so
+# live, not only in the post-hoc report.
+
+_MESH_LOCK = threading.Lock()
+_MESH_STATE: Optional[Dict[str, Any]] = None
+
+# The serve-health registry: the resident service pushes its degraded
+# state (reason + detail) so the heartbeat's serve section reports WHY
+# submits are being refused while the device is wedged.
+
+_SERVE_HEALTH_LOCK = threading.Lock()
+_SERVE_HEALTH: Optional[Dict[str, Any]] = None
+
+
+def update_mesh(snapshot: Optional[Dict[str, Any]]) -> None:
+    """Install (or, with None, clear) the elastic-mesh recovery
+    snapshot the next heartbeat embeds."""
+    global _MESH_STATE
+    with _MESH_LOCK:
+        _MESH_STATE = dict(snapshot) if snapshot is not None else None
+
+
+def mesh_snapshot() -> Optional[Dict[str, Any]]:
+    with _MESH_LOCK:
+        return dict(_MESH_STATE) if _MESH_STATE is not None else None
+
+
+def update_serve_health(snapshot: Optional[Dict[str, Any]]) -> None:
+    """Install (or, with None, clear) the resident service's degraded
+    state for the heartbeat's serve section."""
+    global _SERVE_HEALTH
+    with _SERVE_HEALTH_LOCK:
+        _SERVE_HEALTH = (dict(snapshot) if snapshot is not None
+                         else None)
+
+
+def serve_health_snapshot() -> Optional[Dict[str, Any]]:
+    with _SERVE_HEALTH_LOCK:
+        return (dict(_SERVE_HEALTH) if _SERVE_HEALTH is not None
+                else None)
 
 
 class Monitor:
@@ -454,6 +503,17 @@ class Monitor:
             # requests per bucket + window deadlines), so a stalled
             # batching window self-diagnoses from the heartbeat alone.
             hb["serve"] = {"fusion": fusion}
+        serve_health = serve_health_snapshot()
+        if serve_health is not None:
+            # Degraded serve state: submits are being refused (the
+            # structured "degraded" refusal) — the heartbeat says WHY
+            # next to the live request list.
+            hb.setdefault("serve", {})["health"] = serve_health
+        mesh = mesh_snapshot()
+        if mesh is not None:
+            # Elastic-recovery trail: the mesh re-formed mid-run
+            # (old shape -> new shape, reason, reshard count).
+            hb["mesh"] = mesh
         if stalled:
             hb["stall"] = {"stalled_for_s": round(stalled_for, 3),
                            "deadline_s": self.stall_s,

@@ -503,6 +503,130 @@ def write_csv(summary: Dict[str, Dict[str, Any]], out) -> None:
                          for k, v in row.items()})
 
 
+def fsck(directory: str, repair: bool = True) -> Dict[str, Any]:
+    """Crash-consistency check over a ledger tree: every ``*.jsonl``
+    run ledger and ``*.json`` budget/heartbeat document under
+    ``directory``, recursively.
+
+    The contract mirrors what the readers already tolerate:
+
+    * an UNTERMINATED ``.jsonl`` tail (a writer died mid-line) is
+      repaired by appending the line terminator — exactly the repair
+      the next :meth:`LedgerStore.append` would make; the tail then
+      parses as a record or joins the skipped-line count;
+    * interior corrupt ``.jsonl`` lines are REPORTED, never rewritten
+      — the tolerant reader skips and counts them, and rewriting
+      history is not fsck's call;
+    * leftover ``*.tmp`` files from a crashed atomic writer are
+      removed (no reader ever opens them);
+    * a corrupt ``*.json`` document is DAMAGE: :func:`atomic_write_json`
+      can never produce one, readers raise on it (for a budget ledger,
+      silently starting fresh would forget spent budget), so fsck
+      reports it and leaves it byte-for-byte intact.
+
+    Returns a summary dict; ``summary["clean"]`` is True when nothing
+    unrepairable remains.
+    """
+    repaired: List[Dict[str, Any]] = []
+    tolerated: List[Dict[str, Any]] = []
+    damaged: List[Dict[str, Any]] = []
+    files_scanned = 0
+    for root, _dirs, names in sorted(os.walk(directory)):
+        for fname in sorted(names):
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, directory)
+            if fname.endswith(".tmp"):
+                files_scanned += 1
+                if repair:
+                    try:
+                        os.unlink(path)
+                        repaired.append({"path": rel,
+                                         "action": "removed orphan "
+                                         "temp file"})
+                    except OSError as exc:
+                        damaged.append({"path": rel,
+                                        "problem": f"orphan temp file "
+                                        f"not removable: {exc}"})
+                else:
+                    tolerated.append({"path": rel,
+                                      "problem": "orphan temp file"})
+                continue
+            if fname.endswith(".jsonl"):
+                files_scanned += 1
+                try:
+                    with open(path, "rb") as f:
+                        data = f.read()
+                except OSError as exc:
+                    damaged.append({"path": rel,
+                                    "problem": f"unreadable: {exc}"})
+                    continue
+                if data and not data.endswith(b"\n"):
+                    if repair:
+                        with open(path, "ab") as f:
+                            f.write(b"\n")
+                            f.flush()
+                            os.fsync(f.fileno())
+                        data += b"\n"
+                        repaired.append({"path": rel,
+                                         "action": "terminated torn "
+                                         "trailing line"})
+                    else:
+                        tolerated.append({"path": rel,
+                                          "problem": "unterminated "
+                                          "trailing line"})
+                corrupt = 0
+                entries = 0
+                for raw in data.split(b"\n"):
+                    if not raw.strip():
+                        continue
+                    try:
+                        entry = json.loads(raw.decode("utf-8"))
+                    except (ValueError, UnicodeDecodeError):
+                        corrupt += 1
+                        continue
+                    if isinstance(entry, dict):
+                        entries += 1
+                    else:
+                        corrupt += 1
+                if corrupt:
+                    tolerated.append({"path": rel,
+                                      "problem": f"{corrupt} corrupt "
+                                      "line(s) the tolerant reader "
+                                      "skips; left intact",
+                                      "entries": entries})
+                continue
+            if fname.endswith(".json"):
+                files_scanned += 1
+                try:
+                    with open(path, encoding="utf-8") as f:
+                        json.loads(f.read())
+                except (OSError, ValueError, UnicodeDecodeError) as exc:
+                    damaged.append({"path": rel,
+                                    "problem": "corrupt document "
+                                    "(atomic_write_json can never "
+                                    f"produce this): {exc}"})
+    return {"directory": directory,
+            "files_scanned": files_scanned,
+            "repaired": repaired,
+            "tolerated": tolerated,
+            "damaged": damaged,
+            "clean": not damaged}
+
+
+def _print_fsck(summary: Dict[str, Any]) -> None:
+    print(f"fsck: {summary['directory']} "
+          f"({summary['files_scanned']} file(s) scanned)")
+    for rec in summary["repaired"]:
+        print(f"  repaired   {rec['path']}: {rec['action']}")
+    for rec in summary["tolerated"]:
+        print(f"  tolerated  {rec['path']}: {rec['problem']}")
+    for rec in summary["damaged"]:
+        print(f"  DAMAGED    {rec['path']}: {rec['problem']}")
+    print("clean" if summary["clean"] else
+          "damage found: corrupt documents left byte-for-byte intact "
+          "— repair needs an operator decision")
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     """``python -m pipelinedp_tpu.obs.store --summarize [--dir D]
     [--fingerprint FP] [--json | --csv]`` — print per-(fingerprint,
@@ -534,13 +658,29 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--csv", action="store_true", dest="as_csv",
                         help="flat CSV table (phases, metrics, program "
                         "cost/roofline columns) for spreadsheets")
+    parser.add_argument("--fsck", action="store_true",
+                        help="crash-consistency check over the ledger "
+                        "tree: repair torn .jsonl tails and orphan "
+                        ".tmp files, report (never rewrite) corrupt "
+                        "lines and documents; rc 2 when unrepairable "
+                        "damage remains")
+    parser.add_argument("--no-repair", action="store_true",
+                        dest="no_repair",
+                        help="with --fsck: report only, change nothing")
     args = parser.parse_args(argv)
-    if not args.summarize:
-        parser.error("nothing to do: pass --summarize")
+    if not (args.summarize or args.fsck):
+        parser.error("nothing to do: pass --summarize or --fsck")
     if args.as_json and args.as_csv:
         parser.error("--json and --csv are mutually exclusive")
     directory = args.dir or ledger_dir(
         default=os.path.join(os.getcwd(), ".pdp_ledger"))
+    if args.fsck:
+        summary = fsck(directory, repair=not args.no_repair)
+        if args.as_json:
+            print(json.dumps(summary))
+        else:
+            _print_fsck(summary)
+        return 0 if summary["clean"] else 2
     s = LedgerStore(directory)
     entries = s.entries()
     if args.since_run_id:

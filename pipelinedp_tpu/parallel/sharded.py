@@ -86,6 +86,76 @@ def make_mesh(n_devices: Optional[int] = None, axis_name: str = "data"
     return Mesh(np.asarray(devices), (axis_name,))
 
 
+def reform_mesh(mesh: Mesh, axis_name: Optional[str] = None
+                ) -> Optional[Mesh]:
+    """Re-form ``mesh`` after a participant loss: return a smaller mesh
+    over the surviving devices, or None when nothing survives to shrink
+    to (a 1-device mesh has no survivors to re-form from — the caller
+    re-raises the loss).
+
+    Policy — the new size always DIVIDES the old size, which is what
+    lets the elastic resume regroup the saved per-(batch, shard) row
+    counts by summing contiguous cell groups (``ingest.assign.
+    regroup_cells``) instead of recomputing the assignment:
+
+    * multi-process mesh (a ``jax.distributed`` peer died): fall back
+      to THIS process's local devices. The survivor's local mesh is
+      single-process, so the streaming kernels switch back to the
+      owner-block ``psum_scatter`` exchange and no collective ever
+      waits on the dead peer again.
+    * single-controller mesh (a device dropped): keep the largest
+      proper-divisor prefix of the device list — half, for the
+      power-of-two meshes the replay guarantee already assumes.
+    """
+    from pipelinedp_tpu import obs
+    axis_name = axis_name or mesh.axis_names[0]
+    old_n = int(mesh.devices.size)
+    if getattr(mesh, "is_multi_process", False):
+        devices = list(jax.local_devices())
+    else:
+        if old_n <= 1:
+            return None
+        survivors = int(max(d for d in range(1, old_n)
+                            if old_n % d == 0))
+        devices = list(mesh.devices.reshape(-1)[:survivors])
+    if not devices or len(devices) >= old_n:
+        return None
+    new = Mesh(np.asarray(devices), (axis_name,))
+    obs.inc("mesh.reformed")
+    obs.event("mesh.reformed", old_devices=old_n,
+              new_devices=int(new.devices.size), axis_name=axis_name,
+              platform=devices[0].platform)
+    return new
+
+
+def put_global(host, sharding):
+    """Place ``host`` (one array, or a tuple of arrays) onto
+    ``sharding`` WITHOUT jax's hidden cross-process collective.
+
+    ``jax.device_put`` of an uncommitted array onto a non-fully-
+    addressable sharding first runs ``multihost_utils.assert_equal`` —
+    a broadcast-and-compare that dispatches a full-array psum over the
+    GLOBAL mesh per call. Those hidden collectives (a) ship every
+    staged batch across DCN a second time, and (b) interleave with the
+    kernel's own all-reduces on the asynchronous dispatch stream, where
+    a reordering makes the two processes' gloo pairs exchange
+    mismatched ops (``op.preamble.length <= op.nbytes`` aborts — the
+    historical multihost flake the rendezvous rewrite alone could not
+    close). Every caller here already stages the IDENTICAL host array
+    on every process (the staging layout is a deterministic function of
+    the shared dataset), so the equality check buys nothing: build the
+    global array from each device's own slice instead — zero
+    collectives dispatched.
+    """
+    if isinstance(host, (tuple, list)):
+        return tuple(put_global(a, sharding) for a in host)
+    if getattr(sharding, "is_fully_addressable", True):
+        return jax.device_put(host, sharding)
+    arr = np.asarray(host)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx])
+
+
 @instrumented_jit(phase="engine", static_argnames=(
     "config", "num_partitions", "mesh", "fx_bits", "kernel_backend"))
 def _sharded_kernel(config, num_partitions, mesh, pid, pk, values, valid,
@@ -187,13 +257,13 @@ def sharded_fused_aggregate(mesh: Mesh, config, num_partitions: int,
     valid_s = shard_array(valid, fill=False)
 
     sharding = NamedSharding(mesh, PSpec(mesh.axis_names[0]))
-    dev = functools.partial(jax.device_put, device=sharding)
+    dev = functools.partial(put_global, sharding=sharding)
     if values is None:
         # Config never reads values (COUNT-style / select_partitions):
         # materialize the zeros on device instead of shipping them over
         # the host link.
-        values_dev = jax.device_put(
-            jnp.zeros(n_dev * per_shard, jnp.float32), sharding)
+        values_dev = put_global(
+            np.zeros(n_dev * per_shard, np.float32), sharding)
     else:
         values_dev = dev(shard_array(values))
     return _sharded_kernel(

@@ -32,13 +32,17 @@ from pipelinedp_tpu.resilience.retry import (RetriesExhausted, RetryPolicy,
                                              call_with_retry)
 from pipelinedp_tpu.resilience.faults import (ChunkFailure,
                                               CoordinatorTimeout,
+                                              DeviceLost,
                                               FaultInjected, FaultPlan,
                                               injected_faults)
 from pipelinedp_tpu.resilience.health import (HealthReport,
+                                              MeshParticipantLost,
+                                              MeshSupervisor,
                                               ensure_device_or_degrade,
                                               probe_devices,
                                               resilient_distributed_initialize,
-                                              resilient_make_mesh)
+                                              resilient_make_mesh,
+                                              supervisor_from_env)
 from pipelinedp_tpu.resilience.checkpoint import (CheckpointMismatch,
                                                   CheckpointStore,
                                                   StreamCheckpoint)
@@ -47,8 +51,9 @@ __all__ = [
     "Clock", "FakeClock", "SystemClock",
     "RetryPolicy", "RetriesExhausted", "call_with_retry",
     "FaultPlan", "FaultInjected", "ChunkFailure", "CoordinatorTimeout",
-    "injected_faults",
+    "DeviceLost", "injected_faults",
     "HealthReport", "probe_devices", "ensure_device_or_degrade",
     "resilient_make_mesh", "resilient_distributed_initialize",
+    "MeshParticipantLost", "MeshSupervisor", "supervisor_from_env",
     "CheckpointStore", "StreamCheckpoint", "CheckpointMismatch",
 ]

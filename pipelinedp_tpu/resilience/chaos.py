@@ -1,0 +1,675 @@
+"""Seeded chaos campaign: one seed -> a deterministic schedule of fault
+injections across EVERY ``FaultPlan`` seam, driven against a mixed
+workload (streamed aggregation with percentiles, resident serve
+requests, sketch-first heavy hitters, run-ledger writers), with the
+recovery invariants asserted after every episode:
+
+* zero orphan ``pdp-*`` threads — every kill drains the ingest/serve
+  executors completely;
+* every budget lease resolves exactly once — a killed serve request
+  leaves exactly one ``reserved`` debit that a restart replays, never
+  zero and never two;
+* checkpoint resume is bit-identical — the resumed (or elastically
+  re-formed) run releases the same noisy values as an uninterrupted
+  run, float for float;
+* no silent refusal — every refusal carries a structured reason AND a
+  ``serve.refusal`` ledger event;
+* torn ledger writes are repaired-or-reported by ``fsck``, never
+  silently lost.
+
+The campaign is deterministic end to end: ``random.Random(seed)``
+derives each episode's scenario parameters, the scenario rotation
+guarantees every seam fires in any campaign of >= 8 episodes, and a
+failing episode prints the exact reproduction command
+(``PIPELINEDP_TPU_CHAOS_SEED=<seed> python -m
+pipelinedp_tpu.resilience.chaos --schedules N --only-episode K``).
+
+Tier-1-safe by construction: CPU mesh (host platform device count),
+``FakeClock`` for every wedge/backoff path (zero real sleeps), fixed
+dataset shapes so jitted programs compile once and are reused across
+episodes. ``make chaoscheck`` runs the default 20-episode campaign.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import tempfile
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+CHAOS_SEED_ENV = "PIPELINEDP_TPU_CHAOS_SEED"
+DEFAULT_SCHEDULES = 20
+
+#: Scenario rotation. Order matters only for coverage: a campaign of
+#: ``n >= len(SCENARIOS)`` episodes fires every seam at least once.
+SCENARIO_NAMES = (
+    "stream_kill",      # fail_chunks: kill pass A mid-stream, resume
+    "device_loss",      # lose_device_chunks: elastic mesh re-form
+    "pass_b_kill",      # fail_pass_b_chunks: kill the percentile sweep
+    "hold_wedge",       # hold_fetch_batches: wedged fetch, released
+    "wedged_probe",     # wedged_init (+ wedged_hold): probe degrades
+    "serve_kill",       # fail_serve_requests: reserve survives restart
+    "sketch_kill",      # fail_sketch_chunks: sketch-first drain proof
+    "torn_ledger",      # torn run-ledger tail: fsck repairs it
+)
+
+
+class ChaosViolation(AssertionError):
+    """An episode's recovery invariant did not hold."""
+
+
+def _check(cond: bool, detail: str) -> None:
+    if not cond:
+        raise ChaosViolation(detail)
+
+
+# ---------------------------------------------------------------------
+# shared fixtures: FIXED shapes so episodes reuse warm programs
+# ---------------------------------------------------------------------
+
+
+class _Fixtures:
+    """Datasets and per-shape clean baselines, built once per campaign.
+    Baselines are computed with NO fault plan active and cached by
+    (workload, n_dev) — episode recoveries compare against them."""
+
+    def __init__(self) -> None:
+        self._ds: Dict[str, Any] = {}
+        self._baselines: Dict[Tuple[str, int], Dict[str, Any]] = {}
+
+    def stream_ds(self):
+        import numpy as np
+        import pipelinedp_tpu as pdp
+        if "stream" not in self._ds:
+            # lint: disable=rng-purity(chaos fixture data synthesis, seeded, never a DP draw)
+            rng = np.random.default_rng(8)
+            n = 9_000
+            self._ds["stream"] = pdp.ArrayDataset(
+                privacy_ids=rng.integers(0, 2_000, n),
+                partition_keys=rng.integers(0, 12, n),
+                values=rng.uniform(0.0, 10.0, n))
+        return self._ds["stream"], 12
+
+    def sketch_ds(self):
+        import numpy as np
+        import pipelinedp_tpu as pdp
+        if "sketch" not in self._ds:
+            # lint: disable=rng-purity(chaos fixture data synthesis, seeded, never a DP draw)
+            rng = np.random.default_rng(3)
+            n = 8_000
+            raw = rng.zipf(1.4, n) % 300
+            self._ds["sketch"] = pdp.ArrayDataset(
+                privacy_ids=rng.integers(0, 1_500, n),
+                partition_keys=np.char.add("key/", raw.astype("U6")),
+                values=rng.uniform(0.0, 10.0, n))
+        return self._ds["sketch"]
+
+    def params(self, workload: str):
+        import pipelinedp_tpu as pdp
+        _, parts = self.stream_ds()
+        if workload == "percentile":
+            return pdp.AggregateParams(
+                metrics=[pdp.Metrics.PERCENTILE(50),
+                         pdp.Metrics.COUNT],
+                max_partitions_contributed=parts,
+                max_contributions_per_partition=50,
+                min_value=0.0, max_value=10.0)
+        return pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            max_partitions_contributed=parts,
+            max_contributions_per_partition=50,
+            min_value=0.0, max_value=10.0)
+
+    def public(self, workload: str) -> Optional[list]:
+        # Percentiles stream pass B over the kept set; a public set
+        # keeps the kept universe fixed so the baseline cache is exact.
+        return list(range(12)) if workload == "percentile" else None
+
+    def baseline(self, workload: str, n_dev: int) -> Dict[str, Any]:
+        key = (workload, n_dev)
+        if key not in self._baselines:
+            from pipelinedp_tpu.resilience import faults
+            _check(faults.active() is None,
+                   "baseline computed under an active fault plan")
+            ds, _ = self.stream_ds()
+            mesh = _make_mesh(n_dev) if n_dev else None
+            got, _ = run_streamed(ds, self.params(workload), seed=21,
+                                  public=self.public(workload),
+                                  mesh=mesh)
+            self._baselines[key] = got
+        return self._baselines[key]
+
+
+def _make_mesh(n_dev: int):
+    from pipelinedp_tpu.parallel import make_mesh
+    return make_mesh(n_dev)
+
+
+def run_streamed(ds, params, seed=21, eps=5.0, delta=1e-6, public=None,
+                 checkpoint=None, mesh=None):
+    """One streamed aggregation through the public engine; returns
+    (results dict, timings). Asserts the run actually streamed."""
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu.backends import JaxBackend
+    ds.invalidate_cache()
+    acc = pdp.NaiveBudgetAccountant(total_epsilon=eps,
+                                    total_delta=delta)
+    engine = pdp.DPEngine(acc, JaxBackend(rng_seed=seed, mesh=mesh,
+                                          checkpoint=checkpoint))
+    res = engine.aggregate(ds, params, pdp.DataExtractors(),
+                           public_partitions=public)
+    acc.compute_budgets()
+    got = dict(res)
+    _check(res.timings.get("stream_batches", 0) > 1,
+           "dataset did not stream — the kill seam was not exercised")
+    return got, res.timings
+
+
+def assert_bit_identical(got_a, got_b, context: str) -> None:
+    import numpy as np
+    _check(set(got_a) == set(got_b),
+           f"{context}: kept sets differ "
+           f"({sorted(map(str, set(got_a) ^ set(got_b)))})")
+    for k in got_a:
+        ta, tb = got_a[k], got_b[k]
+        _check(ta._fields == tb._fields, f"{context}: fields differ")
+        for f in ta._fields:
+            va = np.asarray(getattr(ta, f))
+            vb = np.asarray(getattr(tb, f))
+            _check(bool(np.array_equal(va, vb)),
+                   f"{context}: partition {k}.{f} differs "
+                   f"({va!r} vs {vb!r})")
+
+
+# ---------------------------------------------------------------------
+# per-episode invariants
+# ---------------------------------------------------------------------
+
+
+def _pdp_threads() -> List[str]:
+    return [t.name for t in threading.enumerate()
+            if t.name.startswith("pdp-") and t.is_alive()]
+
+
+def _assert_drained(before: List[str], context: str) -> None:
+    """Zero orphan ``pdp-*`` threads beyond what existed before the
+    episode (joins stragglers briefly first — a drain in progress is
+    not an orphan; a drain that never finishes is)."""
+    for t in threading.enumerate():
+        if (t.name.startswith("pdp-") and t.name not in before
+                and t.is_alive()):
+            t.join(timeout=10.0)
+    orphans = [n for n in _pdp_threads() if n not in before]
+    _check(not orphans, f"{context}: orphan threads {orphans}")
+
+
+def _assert_faults_recorded(minimum: int, context: str) -> None:
+    """Every injected fault is in the ledger: synthetic failures must
+    be distinguishable from real ones in any run artifact."""
+    from pipelinedp_tpu import obs
+    snap = obs.ledger().snapshot()
+    counted = snap["counters"].get("faults.injected", 0)
+    events = [e for e in snap["events"] if e["name"] == "fault.injected"]
+    _check(counted >= minimum,
+           f"{context}: faults.injected={counted} < {minimum}")
+    _check(len(events) == counted,
+           f"{context}: {counted} counted vs {len(events)} events")
+
+
+# ---------------------------------------------------------------------
+# the scenarios
+# ---------------------------------------------------------------------
+
+
+def _scenario_stream_kill(rng: random.Random, fx: _Fixtures,
+                          tmp: str) -> None:
+    from pipelinedp_tpu.resilience import (CheckpointStore, FaultPlan,
+                                           injected_faults)
+    from pipelinedp_tpu.resilience.faults import ChunkFailure
+    workload = rng.choice(("count_sum", "percentile"))
+    kill_at = rng.randint(1, 4)
+    ds, _ = fx.stream_ds()
+    params = fx.params(workload)
+    public = fx.public(workload)
+    baseline = fx.baseline(workload, 0)
+    store = CheckpointStore(os.path.join(tmp, "stream.ckpt"))
+    killed = False
+    with injected_faults(FaultPlan(fail_chunks=(kill_at,))):
+        try:
+            run_streamed(ds, params, public=public, checkpoint=store)
+        except ChunkFailure:
+            killed = True
+    _check(killed, f"fail_chunks=({kill_at},) never fired")
+    resumed, timings = run_streamed(ds, params, public=public,
+                                    checkpoint=store)
+    assert_bit_identical(baseline, resumed,
+                         f"stream_kill@{kill_at}/{workload}")
+    _check(not store.exists(), "success did not clear the checkpoint")
+    _check(timings.get("stream_resumed_from", -1) >= 0,
+           "resume did not report a restore point")
+
+
+def _scenario_device_loss(rng: random.Random, fx: _Fixtures,
+                          tmp: str) -> None:
+    from pipelinedp_tpu import obs
+    from pipelinedp_tpu.resilience import (CheckpointStore, FaultPlan,
+                                           injected_faults)
+    double = rng.random() < 0.5
+    losses = (1, 3) if double else (rng.randint(1, 2),)
+    surviving = 1 if double else 2
+    ds, _ = fx.stream_ds()
+    params = fx.params("count_sum")
+    baseline = fx.baseline("count_sum", surviving)
+    store = CheckpointStore(os.path.join(tmp, "elastic.ckpt"))
+    with injected_faults(FaultPlan(lose_device_chunks=losses)):
+        survived, timings = run_streamed(ds, params, mesh=_make_mesh(4),
+                                         checkpoint=store)
+    _check(timings.get("stream_mesh_reshards") == len(losses),
+           f"expected {len(losses)} reshard(s), got "
+           f"{timings.get('stream_mesh_reshards')}")
+    events = [e for e in obs.ledger().snapshot()["events"]
+              if e["name"] == "mesh.reshard"]
+    _check(len(events) == len(losses),
+           f"mesh.reshard events: {len(events)} != {len(losses)}")
+    _check(events[-1]["new_devices"] == surviving,
+           f"final mesh {events[-1]['new_devices']} != {surviving}")
+    assert_bit_identical(baseline, survived,
+                         f"device_loss@{losses}")
+
+
+def _scenario_pass_b_kill(rng: random.Random, fx: _Fixtures,
+                          tmp: str) -> None:
+    from pipelinedp_tpu.resilience import (CheckpointStore, FaultPlan,
+                                           injected_faults)
+    from pipelinedp_tpu.resilience.faults import ChunkFailure
+    kill_at = rng.randint(0, 1)
+    ds, _ = fx.stream_ds()
+    params = fx.params("percentile")
+    public = fx.public("percentile")
+    baseline = fx.baseline("percentile", 0)
+    store = CheckpointStore(os.path.join(tmp, "passb.ckpt"))
+    killed = False
+    with injected_faults(FaultPlan(fail_pass_b_chunks=(kill_at,))):
+        try:
+            run_streamed(ds, params, public=public, checkpoint=store)
+        except ChunkFailure:
+            killed = True
+    _check(killed, f"fail_pass_b_chunks=({kill_at},) never fired")
+    resumed, timings = run_streamed(ds, params, public=public,
+                                    checkpoint=store)
+    if timings.get("stream_resumed_from", 0) >= 1:
+        _check(timings.get("stream_pass_b") == "reship",
+               "resumed percentile run kept a partial pass-B cache")
+    assert_bit_identical(baseline, resumed, f"pass_b_kill@{kill_at}")
+
+
+def _scenario_hold_wedge(rng: random.Random, fx: _Fixtures,
+                         tmp: str) -> None:
+    from pipelinedp_tpu.resilience import FaultPlan, injected_faults
+    from pipelinedp_tpu.resilience import faults
+    hold_at = rng.randint(1, 2)
+    ds, _ = fx.stream_ds()
+    params = fx.params("count_sum")
+    baseline = fx.baseline("count_sum", 0)
+    results: Dict[str, Any] = {}
+    errors: List[BaseException] = []
+
+    def run() -> None:
+        try:
+            results["out"] = run_streamed(ds, params)[0]
+        except BaseException as exc:  # surfaced below, never swallowed
+            errors.append(exc)
+
+    with injected_faults(FaultPlan(hold_fetch_batches=(hold_at,))):
+        t = threading.Thread(target=run, name="chaos-hold-driver")
+        t.start()
+        try:
+            _check(faults.hold_started().wait(60.0),
+                   f"hold_fetch_batches=({hold_at},) never engaged")
+        finally:
+            faults.release_holds()
+            t.join(timeout=120.0)
+    _check(not t.is_alive(), "held run never completed after release")
+    _check(not errors, f"held run raised: {errors}")
+    assert_bit_identical(baseline, results["out"],
+                         f"hold_wedge@{hold_at}")
+
+
+def _scenario_wedged_probe(rng: random.Random, fx: _Fixtures,
+                           tmp: str) -> None:
+    from pipelinedp_tpu.resilience import (FakeClock, FaultPlan,
+                                           RetryPolicy, injected_faults)
+    from pipelinedp_tpu.resilience import health
+    attempts = rng.randint(2, 3)
+    hold = rng.random() < 0.5
+    policy = RetryPolicy(max_attempts=attempts, base_delay_s=2.0,
+                         multiplier=2.0, max_delay_s=60.0, jitter=0.1,
+                         seed=rng.randint(0, 1_000))
+    clock = FakeClock()
+    env: Dict[str, str] = {}
+    with injected_faults(FaultPlan(wedged_init=99, wedged_hold=hold)):
+        report = health.ensure_device_or_degrade(
+            policy=policy, clock=clock, timeout_s=300.0, env=env)
+    _check(report.degraded and not report.healthy,
+           "wedged probe did not degrade")
+    _check(report.attempts == attempts,
+           f"attempts {report.attempts} != {attempts}")
+    _check(clock.sleeps[-len(policy.delays()):] == policy.delays(),
+           "backoff schedule not honored on the fake clock")
+    _check(env.get("JAX_PLATFORMS") == "cpu",
+           "degradation did not steer to CPU")
+    _check(env.get(health.DEGRADED_ENV) == "1",
+           "degradation marker not set")
+
+
+def _scenario_serve_kill(rng: random.Random, fx: _Fixtures,
+                         tmp: str) -> None:
+    import numpy as np
+    import pipelinedp_tpu as pdp
+    # lint: disable=noserve(the chaos harness exercises the serve seam by design; serve loads lazily, only in this episode)
+    from pipelinedp_tpu import obs, serve
+    from pipelinedp_tpu.resilience import FaultPlan, injected_faults
+    from pipelinedp_tpu.resilience import faults
+    # lint: disable=noserve(the chaos harness exercises the serve seam by design; serve loads lazily, only in this episode)
+    from pipelinedp_tpu.serve.budget_ledger import TenantBudgetLedger
+    n_requests = 3
+    kill = rng.randint(0, n_requests - 1)
+    # lint: disable=rng-purity(chaos fixture data synthesis, seeded, never a DP draw)
+    d_rng = np.random.default_rng(5)
+    n = 1_000
+    ds = pdp.ArrayDataset(privacy_ids=d_rng.integers(0, 300, n),
+                          partition_keys=d_rng.integers(0, 4, n),
+                          values=d_rng.uniform(0.0, 10.0, n))
+    params = pdp.AggregateParams(
+        metrics=[pdp.Metrics.COUNT],
+        max_partitions_contributed=4,
+        max_contributions_per_partition=20)
+    ledger_dir = os.path.join(tmp, "svc")
+    total_eps = 10.0
+    with injected_faults(FaultPlan(fail_serve_requests=(kill,))):
+        with serve.Service(ledger_dir,
+                           tenants={"t": (total_eps, 1e-6)}) as svc:
+            for i in range(n_requests):
+                ds.invalidate_cache()
+                req = serve.ServeRequest(
+                    tenant="t", params=params, dataset=ds,
+                    epsilon=1.0, delta=1e-8, rng_seed=7,
+                    request_id=f"req-{i}")
+                try:
+                    out = svc.submit(req)
+                    _check(i != kill,
+                           f"request {kill} was not killed")
+                    _check(out.ok, f"request {i} refused: {out}")
+                except faults.ServeKill:
+                    _check(i == kill,
+                           f"request {i} killed, planned {kill}")
+            # No silent refusal: an overdraw refuses with a reason AND
+            # a serve.refusal ledger event.
+            ds.invalidate_cache()
+            big = svc.submit(serve.ServeRequest(
+                tenant="t", params=params, dataset=ds,
+                epsilon=100.0, delta=1e-8, rng_seed=7))
+            _check((not big.ok) and big.reason == "overdraw",
+                   f"expected structured overdraw, got {big}")
+    refusal_events = [e for e in obs.ledger().snapshot()["events"]
+                      if e["name"] == "serve.refusal"]
+    _check(any(e["reason"] == "overdraw" for e in refusal_events),
+           "refusal happened with no serve.refusal event (silent)")
+    # Every lease resolved exactly once: the killed id's reserve stands
+    # (DP-conservative — noise may have been drawn), the others
+    # committed, and no id has more than one debit.
+    # lint: disable=noserve(exactly-once lease audit reads the episode's own ledger directory)
+    led = TenantBudgetLedger(os.path.join(ledger_dir, "budgets"))
+    debits = led.debits("t")
+    _check(len(debits) == n_requests,
+           f"{len(debits)} debits for {n_requests} admitted requests")
+    for i in range(n_requests):
+        state = debits[f"req-{i}"]["state"]
+        want = "reserved" if i == kill else "committed"
+        _check(state == want, f"req-{i}: {state} != {want}")
+    _check(abs(led.remaining("t").epsilon
+               - (total_eps - n_requests)) < 1e-9,
+           "remaining budget drifted from exactly-once accounting")
+    # A restarted service replays the same books: the dead request's
+    # retry dedupes onto the existing debit, never double-spends.
+    with serve.Service(ledger_dir, tenants={"t": (total_eps,
+                                                  1e-6)}) as svc2:
+        lease = svc2.budgets.reserve("t", f"req-{kill}", 1.0, 1e-8)
+        _check(lease.replayed, "killed id's reserve did not dedup")
+        _check(len(svc2.budgets.debits("t")) == n_requests,
+               "retry of the killed id grew a second debit")
+
+
+def _scenario_sketch_kill(rng: random.Random, fx: _Fixtures,
+                          tmp: str) -> None:
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu.backends import JaxBackend
+    from pipelinedp_tpu.resilience import FaultPlan, injected_faults
+    from pipelinedp_tpu.resilience.faults import ChunkFailure
+    from pipelinedp_tpu.sketch import SketchParams
+    kill_at = rng.randint(1, 2)
+    ds = fx.sketch_ds()
+    params = pdp.AggregateParams(
+        metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+        max_partitions_contributed=3,
+        max_contributions_per_partition=2,
+        min_value=0.0, max_value=10.0)
+    sk = SketchParams(eps=1e6, delta=1e-6, width=2048, depth=2,
+                      candidate_cap=2048, threshold=0.5,
+                      chunk_rows=512)
+
+    def run(sketch):
+        ds.invalidate_cache()
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                        total_delta=1e-6)
+        engine = pdp.DPEngine(acc, JaxBackend(rng_seed=0))
+        res = engine.aggregate(ds, params, pdp.DataExtractors(),
+                               sketch_first=sketch)
+        acc.compute_budgets()
+        return dict(res)
+
+    killed = False
+    with injected_faults(FaultPlan(fail_sketch_chunks=(kill_at,))):
+        try:
+            run(sk)
+        except ChunkFailure:
+            killed = True
+    _check(killed, f"fail_sketch_chunks=({kill_at},) never fired")
+    # The same process serves a healthy sketch-first run afterwards —
+    # the kill left no wedged stager behind.
+    out = run(sk)
+    _check(len(out) > 0, "post-kill sketch run released nothing")
+
+
+def _scenario_torn_ledger(rng: random.Random, fx: _Fixtures,
+                          tmp: str) -> None:
+    from pipelinedp_tpu.obs import store as obs_store
+    d = os.path.join(tmp, "ledger")
+    s = obs_store.LedgerStore(d)
+    for i in range(3):
+        s.append("run.report", {"phase_s": {"a": float(i)}},
+                 env={"k": "v"})
+    with open(s.path, "rb") as f:
+        data = f.read()
+    cut = rng.randint(1, len(data) - 1)
+    with open(s.path, "wb") as f:
+        f.write(data[:cut])
+    summary = obs_store.fsck(d)
+    _check(summary["clean"], f"fsck reported damage: {summary}")
+    committed = data[:cut].count(b"\n")
+    entries = obs_store.LedgerStore(d).entries()
+    _check(len(entries) >= committed,
+           f"fsck lost committed entries ({len(entries)} < {committed})")
+    again = obs_store.fsck(d)
+    _check(again["repaired"] == [] and again["clean"],
+           f"fsck not idempotent: {again}")
+
+
+_SCENARIOS: Dict[str, Callable[[random.Random, _Fixtures, str], None]] = {
+    "stream_kill": _scenario_stream_kill,
+    "device_loss": _scenario_device_loss,
+    "pass_b_kill": _scenario_pass_b_kill,
+    "hold_wedge": _scenario_hold_wedge,
+    "wedged_probe": _scenario_wedged_probe,
+    "serve_kill": _scenario_serve_kill,
+    "sketch_kill": _scenario_sketch_kill,
+    "torn_ledger": _scenario_torn_ledger,
+}
+
+#: Scenarios whose plan is guaranteed to fire at least one fault (the
+#: hold/wedge scenarios record holds/wedges instead of raising).
+_EXPECT_INJECTED = {"stream_kill", "device_loss", "pass_b_kill",
+                    "hold_wedge", "wedged_probe", "serve_kill",
+                    "sketch_kill"}
+
+
+def schedule_for(seed: int, n_schedules: int) -> List[Dict[str, Any]]:
+    """The deterministic episode list one campaign seed expands to:
+    ``[{episode, scenario, episode_seed}, ...]``. Pure — two calls with
+    the same arguments return the same schedule, which is the whole
+    reproducibility contract."""
+    return [{"episode": i,
+             "scenario": SCENARIO_NAMES[i % len(SCENARIO_NAMES)],
+             "episode_seed": f"{seed}:{i}"}
+            for i in range(n_schedules)]
+
+
+def run_episode(seed: int, episode: int,
+                fx: Optional[_Fixtures] = None) -> Dict[str, Any]:
+    """Run ONE episode of campaign ``seed`` (for reproducing a failure
+    in isolation); returns its record. Raises :class:`ChaosViolation`
+    on an invariant breach."""
+    from pipelinedp_tpu import obs
+    spec = schedule_for(seed, episode + 1)[episode]
+    fx = fx or _Fixtures()
+    # lint: disable=rng-purity(episode schedule derivation, pure in the campaign seed)
+    rng = random.Random(spec["episode_seed"])
+    before = _pdp_threads()
+    obs.reset()
+    context = f"episode {episode} ({spec['scenario']})"
+    with tempfile.TemporaryDirectory(prefix="pdp-chaos-") as tmp:
+        _SCENARIOS[spec["scenario"]](rng, fx, tmp)
+        if spec["scenario"] in _EXPECT_INJECTED:
+            _assert_faults_recorded(1, context)
+        _assert_drained(before, context)
+    return spec
+
+
+def run_campaign(seed: int,
+                 n_schedules: int = DEFAULT_SCHEDULES,
+                 out: Callable[[str], None] = print) -> Dict[str, Any]:
+    """Run the full campaign: ``n_schedules`` seeded episodes, every
+    FaultPlan seam covered, invariants asserted per episode. Returns
+    ``{"seed", "episodes", "passed", "failures"}``; a failure record
+    carries the exact reproduction command."""
+    fx = _Fixtures()
+    failures: List[Dict[str, Any]] = []
+    old_chunk = os.environ.get("PIPELINEDP_TPU_STREAM_CHUNK")
+    os.environ["PIPELINEDP_TPU_STREAM_CHUNK"] = "997"
+    try:
+        for spec in schedule_for(seed, n_schedules):
+            i = spec["episode"]
+            try:
+                run_episode(seed, i, fx)
+                out(f"chaos episode {i:>3} {spec['scenario']:<13} ok")
+            except Exception as exc:
+                repro = (f"{CHAOS_SEED_ENV}={seed} python -m "
+                         f"pipelinedp_tpu.resilience.chaos "
+                         f"--schedules {n_schedules} --only-episode {i}")
+                failures.append({**spec, "error": f"{exc}",
+                                 "repro": repro})
+                out(f"chaos episode {i:>3} {spec['scenario']:<13} "
+                    f"FAILED: {exc}\n  reproduce with: {repro}")
+    finally:
+        if old_chunk is None:
+            os.environ.pop("PIPELINEDP_TPU_STREAM_CHUNK", None)
+        else:
+            os.environ["PIPELINEDP_TPU_STREAM_CHUNK"] = old_chunk
+    return {"seed": seed, "episodes": n_schedules,
+            "passed": n_schedules - len(failures),
+            "failures": failures}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``python -m pipelinedp_tpu.resilience.chaos [--seed S]
+    [--schedules N] [--only-episode K] [--json]`` — the seeded chaos
+    campaign behind ``make chaoscheck``. The seed defaults to
+    ``PIPELINEDP_TPU_CHAOS_SEED`` (else 0), so a failure's printed
+    reproduction command replays the identical schedule."""
+    # Every env key this entry point touches is RESTORED on the way
+    # out: tests (and anything else embedding the CLI) call main()
+    # in-process, and a leaked PIPELINEDP_TPU_STREAM_CHUNK would
+    # silently re-chunk every later streaming run in the process.
+    saved = {k: os.environ.get(k)
+             for k in ("JAX_PLATFORMS", "XLA_FLAGS",
+                       "PIPELINEDP_TPU_STREAM_CHUNK")}
+    try:
+        return _main_inner(argv)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _main_inner(argv: Optional[List[str]]) -> int:
+    import argparse
+    # CPU mesh with enough host devices for the elastic scenarios —
+    # set BEFORE jax initializes (harmless when already configured).
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    parser = argparse.ArgumentParser(
+        prog="python -m pipelinedp_tpu.resilience.chaos",
+        description="Seeded chaos campaign across every FaultPlan "
+                    "seam with per-episode recovery invariants.")
+    parser.add_argument("--seed", type=int,
+                        default=int(os.environ.get(CHAOS_SEED_ENV,
+                                                   "0")),
+                        help=f"campaign seed (default: "
+                             f"${CHAOS_SEED_ENV}, else 0)")
+    parser.add_argument("--schedules", type=int,
+                        default=DEFAULT_SCHEDULES,
+                        help="number of seeded episodes (default "
+                             f"{DEFAULT_SCHEDULES})")
+    parser.add_argument("--only-episode", type=int, default=None,
+                        dest="only_episode",
+                        help="run ONE episode of the schedule (the "
+                             "reproduction path a failure prints)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable summary")
+    args = parser.parse_args(argv)
+    if args.only_episode is not None:
+        spec = schedule_for(args.seed,
+                            args.only_episode + 1)[args.only_episode]
+        try:
+            os.environ.setdefault("PIPELINEDP_TPU_STREAM_CHUNK", "997")
+            run_episode(args.seed, args.only_episode)
+        except Exception as exc:
+            print(f"chaos episode {args.only_episode} "
+                  f"({spec['scenario']}) FAILED: {exc}")
+            return 1
+        print(f"chaos episode {args.only_episode} "
+              f"({spec['scenario']}) ok")
+        return 0
+    summary = run_campaign(args.seed, args.schedules)
+    if args.as_json:
+        print(json.dumps(summary))
+    else:
+        print(f"chaos campaign seed={summary['seed']}: "
+              f"{summary['passed']}/{summary['episodes']} episodes "
+              "passed")
+        for f in summary["failures"]:
+            print(f"  FAILED episode {f['episode']} ({f['scenario']}): "
+                  f"{f['error']}")
+            print(f"    reproduce with: {f['repro']}")
+    return 0 if not summary["failures"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

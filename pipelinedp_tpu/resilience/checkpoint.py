@@ -87,6 +87,12 @@ def run_fingerprint(config, n_rows: int, n_batches: int, seed: int,
         "n_dev": int(n_dev),
         "fx_bits": int(fx_bits),
         "data": data,
+        # Accumulator semantic version: v2 checkpoints carry exact
+        # fixed-point STEP totals in the val: columns (the scale
+        # division moved to release). A v1 checkpoint's quotients would
+        # silently misread as steps, so the version salts the
+        # fingerprint and v1 saves are refused like any foreign run's.
+        "fold": "fx-steps-v2",
     }, sort_keys=True)
     return hashlib.sha256(blob.encode()).hexdigest()
 
@@ -125,6 +131,18 @@ class StreamCheckpoint:
     #: host accumulator arrays, keyed ``acc:<name>`` / ``val:<name>`` /
     #: ``vec`` / ``mid`` (all numpy; device state is host-fetched).
     arrays: Dict[str, np.ndarray]
+    #: the ORIGINAL run's batch-assignment shape —
+    #: ``{"n_batches", "n_dev", "num_partitions", "fx_bits"}`` — kept
+    #: verbatim across elastic reshards so a run resumed on a SMALLER
+    #: mesh can adopt the saved assignment (same batch order, same
+    #: ``fold_in(k_bound, b)`` keys) instead of refusing on a
+    #: shape-changed fingerprint. None on checkpoints written before
+    #: this field existed (those never resume elastically).
+    assign: Optional[Dict] = None
+    #: structured ``mesh.reshard`` history: one record per elastic
+    #: mesh re-formation ({"old_devices", "new_devices", "reason",
+    #: "chunk"}), in order — the run report's recovery trail.
+    reshards: list = dataclasses.field(default_factory=list)
 
 
 class CheckpointStore:
@@ -140,10 +158,16 @@ class CheckpointStore:
 
     def save(self, ckpt: StreamCheckpoint) -> None:
         payload = dict(ckpt.arrays)
-        payload["__meta__"] = np.frombuffer(json.dumps({
+        meta = {
             "fingerprint": ckpt.fingerprint,
             "next_batch": int(ckpt.next_batch),
-        }).encode(), dtype=np.uint8)
+        }
+        if ckpt.assign is not None:
+            meta["assign"] = {k: int(v) for k, v in ckpt.assign.items()}
+        if ckpt.reshards:
+            meta["reshards"] = list(ckpt.reshards)
+        payload["__meta__"] = np.frombuffer(
+            json.dumps(meta).encode(), dtype=np.uint8)
         d = os.path.dirname(os.path.abspath(self.path)) or "."
         os.makedirs(d, exist_ok=True)
         fd, tmp = tempfile.mkstemp(dir=d, suffix=".ckpt.tmp")
@@ -168,7 +192,9 @@ class CheckpointStore:
         self.last_event = f"loaded next_batch={meta['next_batch']}"
         return StreamCheckpoint(fingerprint=meta["fingerprint"],
                                 next_batch=int(meta["next_batch"]),
-                                arrays=arrays)
+                                arrays=arrays,
+                                assign=meta.get("assign"),
+                                reshards=list(meta.get("reshards", [])))
 
     def load_for(self, fingerprint: str) -> Optional[StreamCheckpoint]:
         """Load and validate against the current run's fingerprint.

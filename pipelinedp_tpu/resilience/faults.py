@@ -55,6 +55,20 @@ class ServeKill(FaultInjected):
     (between the durable budget reserve and its commit/release)."""
 
 
+class DeviceLost(FaultInjected):
+    """Injected loss of a mesh participant mid-stream (a device or a
+    whole ``jax.distributed`` process dropping out). Unlike
+    :class:`ChunkFailure` — which models a transient kill the SAME mesh
+    can resume from — this one means the mesh shape itself is gone: the
+    elastic wrapper in ``streaming.py`` catches it, re-forms the mesh
+    from the survivors and resumes from the last checkpoint at the new
+    shape."""
+
+    def __init__(self, msg: str, index: int = -1):
+        super().__init__(msg)
+        self.index = int(index)
+
+
 @dataclasses.dataclass(frozen=True)
 class FaultPlan:
     #: first N device-probe / mesh-init attempts wedge (per site).
@@ -92,6 +106,14 @@ class FaultPlan:
     #: instead of returning instantly — the real blocked window the
     #: r05 capture sat through, reproducible in bounded time.
     wedged_hold: bool = False
+    #: GLOBAL device-loss ordinals (the Nth ``check_device_loss`` call
+    #: across the whole run, counted ACROSS elastic retries) at which a
+    #: mesh participant drops out — ``DeviceLost`` raises and the
+    #: elastic wrapper re-forms the mesh from the survivors. A global
+    #: ordinal (not a per-attempt chunk index) lets one plan compose a
+    #: multi-loss schedule: ``(1, 3)`` kills the original mesh at its
+    #: 2nd dispatch AND the re-formed mesh two dispatches later.
+    lose_device_chunks: Tuple[int, ...] = ()
 
     def to_env(self) -> str:
         parts = []
@@ -116,6 +138,9 @@ class FaultPlan:
                          ":".join(str(c) for c in self.hold_fetch_batches))
         if self.wedged_hold:
             parts.append("wedged_hold=1")
+        if self.lose_device_chunks:
+            parts.append("lose_device_chunks=" +
+                         ":".join(str(c) for c in self.lose_device_chunks))
         return ",".join(parts)
 
 
@@ -128,7 +153,7 @@ def plan_from_env(spec: str) -> FaultPlan:
         k, _, v = item.partition("=")
         if k in ("fail_chunks", "fail_pass_b_chunks",
                  "fail_sketch_chunks", "hold_fetch_batches",
-                 "fail_serve_requests"):
+                 "fail_serve_requests", "lose_device_chunks"):
             kw[k] = tuple(int(c) for c in v.split(":") if c)
         elif k == "wedged_hold":
             kw[k] = bool(int(v))
@@ -270,6 +295,23 @@ def check_sketch_chunk(index: int) -> None:
         _record("sketch_chunk_failure", index=int(index))
         raise ChunkFailure(
             f"injected failure at sketch chunk {index}")
+
+
+def check_device_loss() -> None:
+    """Raise :class:`DeviceLost` when the active plan loses a mesh
+    participant at this dispatch. The ordinal is GLOBAL across the run
+    (it keeps counting through elastic retries — ``install`` resets it,
+    a wrapper-level resume does not), so a plan like
+    ``lose_device_chunks=(1, 3)`` exercises repeated shrinkage:
+    8 devices -> re-form at 4 -> re-form at 2."""
+    plan = active()
+    if plan is None or not plan.lose_device_chunks:
+        return
+    n = _consume("device_loss")
+    if n in plan.lose_device_chunks:
+        _record("device_lost", index=n)
+        raise DeviceLost(
+            f"injected mesh participant loss at dispatch {n}", index=n)
 
 
 def check_pass_b_chunk(index: int) -> None:

@@ -157,6 +157,230 @@ class _ProbeFailed(Exception):
     """One probe attempt failed; ``str()`` is the probe detail."""
 
 
+# --- mesh supervision (elastic multi-process recovery) ----------------
+
+#: Directory of per-process liveness beat files. Set (by the harness
+#: that launches the workers) to arm :func:`supervisor_from_env`; unset,
+#: multi-process streaming runs exactly as before — a lost peer wedges
+#: the collective until an outer deadline kills the run.
+MESH_DIR_ENV = "PIPELINEDP_TPU_MESH_DIR"
+#: Seconds a peer's beat may lag before the supervisor declares it lost
+#: (only consulted while its pid is still alive — a dead pid is an
+#: immediate loss verdict).
+MESH_STALL_ENV = "PIPELINEDP_TPU_MESH_STALL_S"
+DEFAULT_MESH_STALL_S = 60.0
+
+#: Poll beat while waiting on peers (rides the injectable clock).
+_MESH_POLL_S = 0.02
+
+
+class MeshParticipantLost(Exception):
+    """A mesh peer process died (or silently stalled) mid-stream. The
+    elastic wrapper in ``streaming.py`` treats this like an injected
+    :class:`faults.DeviceLost`: re-form the mesh from the survivors and
+    resume from the last checkpoint."""
+
+    def __init__(self, msg: str, process_id: int = -1, beat: int = -1,
+                 reason: str = ""):
+        super().__init__(msg)
+        self.process_id = int(process_id)
+        self.beat = int(beat)
+        self.reason = reason
+
+
+class MeshSupervisor:
+    """File-based liveness rendezvous for a multi-process mesh.
+
+    Every participant writes an atomic member file
+    ``mesh-<process_id>.json`` = ``{"process_id", "pid", "beat"}`` into
+    the shared :data:`MESH_DIR_ENV` directory, bumping ``beat`` ONCE
+    per collective dispatch (``gate()``), IMMEDIATELY BEFORE enqueueing
+    the collective. Before dispatching, each participant waits until
+    every peer has reached the same beat — so a peer that died at
+    dispatch ``n`` is detected by the survivors AT dispatch ``n``,
+    before they enqueue the collective that would wedge on it:
+
+    * peer pid no longer alive -> :class:`MeshParticipantLost` NOW;
+    * peer beat stalled past the stall deadline -> the same, with
+      ``reason="stalled"`` (heartbeat silence, not a clean death).
+
+    The wait polls on the injectable clock (never ``time.sleep``), so
+    chaos tests drive the stall verdict on a ``FakeClock``. The beat
+    counter is GLOBAL and monotonic per process — pass A and pass B
+    share it, matching the forced-serial dispatch order every process
+    replays identically."""
+
+    def __init__(self, mesh_dir: str, process_id: int, n_processes: int,
+                 stall_s: Optional[float] = None,
+                 clock: Optional[Clock] = None):
+        from pipelinedp_tpu.resilience import checkpoint as ckpt_mod
+        self._ckpt_mod = ckpt_mod
+        self.mesh_dir = str(mesh_dir)
+        self.process_id = int(process_id)
+        self.n_processes = int(n_processes)
+        self.stall_s = (float(os.environ.get(MESH_STALL_ENV,
+                                             DEFAULT_MESH_STALL_S))
+                        if stall_s is None else float(stall_s))
+        self.clock = clock or SystemClock()
+        self.beat = 0
+        self.state = "forming"
+        os.makedirs(self.mesh_dir, exist_ok=True)
+        self._write()
+        self.state = "formed"
+
+    def _member_path(self, process_id: int) -> str:
+        return os.path.join(self.mesh_dir, f"mesh-{process_id}.json")
+
+    def _write(self) -> None:
+        self._ckpt_mod.atomic_write_json(
+            self._member_path(self.process_id),
+            {"process_id": self.process_id, "pid": os.getpid(),
+             "beat": self.beat})
+
+    @staticmethod
+    def _pid_alive(pid: int) -> bool:
+        try:
+            os.kill(pid, 0)
+        except OSError:
+            return False
+        return True
+
+    def _peer(self, process_id: int):
+        try:
+            return self._ckpt_mod.read_json(self._member_path(process_id))
+        except ValueError:
+            return None  # torn write in flight; next poll re-reads
+
+    def gate(self) -> None:
+        """One collective dispatch: publish my beat, then wait until
+        every peer reached it. Raises :class:`MeshParticipantLost` the
+        moment a peer is provably gone — BEFORE this process enqueues
+        the collective that would wedge on the dead peer."""
+        self.beat += 1
+        self._write()
+        deadline = self.clock.monotonic() + self.stall_s
+        while True:
+            waiting = []
+            for p in range(self.n_processes):
+                if p == self.process_id:
+                    continue
+                doc = self._peer(p)
+                if doc is None:
+                    waiting.append((p, None))
+                    continue
+                if int(doc.get("beat", 0)) >= self.beat:
+                    continue
+                if not self._pid_alive(int(doc.get("pid", -1))):
+                    self._lost(p, "process died",
+                               beat=int(doc.get("beat", 0)))
+                waiting.append((p, doc))
+            if not waiting:
+                return
+            if self.clock.monotonic() >= deadline:
+                p, doc = waiting[0]
+                self._lost(p, "stalled",
+                           beat=int(doc.get("beat", 0)) if doc else -1)
+            self.clock.sleep(_MESH_POLL_S)
+
+    def _lost(self, process_id: int, reason: str, beat: int):
+        from pipelinedp_tpu import obs
+        self.state = "lost"
+        obs.inc("mesh.participant_lost")
+        obs.event("mesh.participant_lost", process_id=int(process_id),
+                  reason=reason, beat=int(beat),
+                  at_beat=int(self.beat))
+        raise MeshParticipantLost(
+            f"mesh participant {process_id} lost at beat {self.beat} "
+            f"({reason})", process_id=process_id, beat=beat,
+            reason=reason)
+
+
+#: Substrings that mark a runtime error as a FAILED CROSS-PROCESS
+#: COLLECTIVE (XLA:CPU gloo transport wording; TPU DCN failures carry
+#: "collective"). Matched case-insensitively against ``str(exc)``.
+_COLLECTIVE_FAILURE_MARKERS = (
+    "gloo", "all-reduce", "allreduce", "all-gather", "allgather",
+    "collective", "connection reset", "connection closed", "preamble")
+
+#: How long a survivor waits for a suspected-dead peer's pid to
+#: actually exit before deciding the collective failure was NOT a
+#: participant loss (a dying peer drains, prints and exits within
+#: milliseconds; transient transport errors never produce a dead pid).
+_COLLECTIVE_LOSS_CONFIRM_S = 10.0
+
+
+def collective_failure_to_loss(exc, mesh,
+                               clock: Optional[Clock] = None
+                               ) -> Optional[MeshParticipantLost]:
+    """Map a runtime error out of a FAILED cross-process collective to
+    :class:`MeshParticipantLost` — only when a peer's member file
+    proves the peer actually died.
+
+    The supervisor's ``gate()`` catches a peer that died BETWEEN
+    collective dispatches; a peer that dies while the survivor is
+    already blocked INSIDE a matching collective surfaces on the
+    survivor as an ``XlaRuntimeError`` from the transport (connection
+    reset / closed) instead. That error alone is ambiguous — a
+    transient network fault must NOT silently shrink the mesh — so
+    this confirms against the beat files: some peer's recorded pid
+    must be gone (polled briefly: the survivor often observes the
+    reset a beat before the dying peer's ``os._exit`` lands). Returns
+    None (caller re-raises) when unarmed, single-process, the error
+    does not read like a collective failure, or every peer is alive.
+    """
+    mesh_dir = os.environ.get(MESH_DIR_ENV)
+    if not mesh_dir or not getattr(mesh, "is_multi_process", False):
+        return None
+    msg = str(exc).lower()
+    if not any(m in msg for m in _COLLECTIVE_FAILURE_MARKERS):
+        return None
+    import jax
+
+    from pipelinedp_tpu import obs
+    from pipelinedp_tpu.resilience import checkpoint as ckpt_mod
+    clock = clock or SystemClock()
+    me = int(jax.process_index())
+    deadline = clock.monotonic() + _COLLECTIVE_LOSS_CONFIRM_S
+    while True:
+        for p in range(int(jax.process_count())):
+            if p == me:
+                continue
+            try:
+                doc = ckpt_mod.read_json(
+                    os.path.join(mesh_dir, f"mesh-{p}.json"))
+            except ValueError:
+                continue  # torn write in flight
+            if doc is None:
+                continue
+            pid = int(doc.get("pid", -1))
+            if pid > 0 and not MeshSupervisor._pid_alive(pid):
+                beat = int(doc.get("beat", 0))
+                obs.inc("mesh.participant_lost")
+                obs.event("mesh.participant_lost", process_id=p,
+                          reason="collective_failure", beat=beat,
+                          at_beat=-1)
+                return MeshParticipantLost(
+                    f"mesh participant {p} died mid-collective "
+                    f"({str(exc)[:300]})", process_id=p, beat=beat,
+                    reason="collective_failure")
+        if clock.monotonic() >= deadline:
+            return None
+        clock.sleep(_MESH_POLL_S)
+
+
+def supervisor_from_env(mesh) -> Optional[MeshSupervisor]:
+    """Build a :class:`MeshSupervisor` for a multi-process ``mesh``
+    when :data:`MESH_DIR_ENV` is armed; None otherwise (including for
+    every single-process mesh — a lost local device surfaces as an
+    injected ``DeviceLost``, not heartbeat silence)."""
+    mesh_dir = os.environ.get(MESH_DIR_ENV)
+    if not mesh_dir or not getattr(mesh, "is_multi_process", False):
+        return None
+    import jax
+    return MeshSupervisor(mesh_dir, jax.process_index(),
+                          jax.process_count())
+
+
 def ensure_device_or_degrade(policy: Optional[RetryPolicy] = None,
                              clock: Optional[Clock] = None,
                              timeout_s: Optional[float] = None,
@@ -292,24 +516,54 @@ def resilient_distributed_initialize(coordinator_address: str,
                                      num_processes: int,
                                      process_id: int,
                                      policy: Optional[RetryPolicy] = None,
-                                     clock: Optional[Clock] = None) -> None:
+                                     clock: Optional[Clock] = None,
+                                     **initialize_kwargs) -> None:
     """``jax.distributed.initialize`` under bounded retry (coordinator
     handshakes lose races on busy hosts). The jitter seed folds in the
     process id so coworker processes do not retry in lockstep. Raises
     ``RetriesExhausted`` when the coordinator never answers — a hard
-    deadline, not a hang."""
+    deadline, not a hang.
+
+    Extra keyword arguments are forwarded to the underlying
+    initializer. The heartbeat tolerances
+    (``service_max_missing_heartbeats`` et al.) matter for elastic
+    recovery: the coordination service's default is to FATALLY
+    terminate every surviving client ~100s after any peer stops
+    heartbeating — exactly the window in which the mesh supervisor is
+    re-forming the mesh and resuming. On jax versions whose public
+    ``jax.distributed.initialize`` does not yet accept them, they are
+    routed through the distributed state object that does."""
+    import inspect
+
     import jax
 
     policy = policy or RetryPolicy(max_attempts=2, base_delay_s=1.0,
                                    multiplier=2.0, max_delay_s=10.0,
                                    jitter=0.25, seed=process_id)
 
+    def _initialize():
+        public = jax.distributed.initialize
+        accepted = inspect.signature(public).parameters
+        if all(k in accepted for k in initialize_kwargs):
+            public(coordinator_address=coordinator_address,
+                   num_processes=num_processes, process_id=process_id,
+                   **initialize_kwargs)
+            return
+        from jax._src import distributed as _dist
+        from jax._src import xla_bridge as _bridge
+        if _bridge.backends_are_initialized():
+            raise RuntimeError(
+                "jax.distributed.initialize() must be called before "
+                "any JAX computations are executed.")
+        _dist.global_state.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id,
+            **initialize_kwargs)
+
     def attempt():
         faults.check_coordinator()
         try:
-            jax.distributed.initialize(
-                coordinator_address=coordinator_address,
-                num_processes=num_processes, process_id=process_id)
+            _initialize()
         except Exception:
             # A timed-out handshake can leave the global distributed
             # client assigned; without a shutdown every retry would

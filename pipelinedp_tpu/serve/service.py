@@ -117,7 +117,8 @@ class ServeResponse:
 #: The closed set of refusal reasons — admission control speaks a
 #: vocabulary, not free text (``detail`` carries the prose).
 REFUSAL_REASONS = ("overdraw", "malformed", "duplicate", "quota",
-                   "queue_full", "tenant_busy", "shutdown", "error")
+                   "queue_full", "tenant_busy", "shutdown", "degraded",
+                   "error")
 
 
 @dataclasses.dataclass
@@ -295,6 +296,19 @@ class Service:
             self._fuser = fusion_mod.Fuser(
                 self, clock=self._clock, window_ms=fuse_window_ms,
                 max_batch=fuse_max_batch, rows_floor=fuse_rows_floor)
+        # Degraded mode: a process whose runtime is wedged (the health
+        # probe degraded it to CPU, a mesh lost its last participant)
+        # refuses EVERY submit with a structured "degraded" refusal
+        # BEFORE any budget reserve — never a silent wrong-shape run,
+        # never a spent charge for work that can't be trusted. Armed
+        # here from resilience.health.DEGRADED_ENV, or at runtime via
+        # set_degraded()/clear_degraded().
+        self._degraded: Optional[str] = None
+        from pipelinedp_tpu.resilience.health import DEGRADED_ENV
+        if os.environ.get(DEGRADED_ENV):
+            self.set_degraded(
+                f"{DEGRADED_ENV} is set: the runtime came up degraded "
+                "(health probe fell back); refusing before reserve")
         for tenant, (eps, delta) in (tenants or {}).items():
             self.register_tenant(tenant, eps, delta)
         obs.event("serve.started", workers=len(self._workers),
@@ -332,6 +346,32 @@ class Service:
 
     def _tenant_quota(self, tenant: str, kind: str, default: int) -> int:
         return int(self._quotas.get(tenant, {}).get(kind, default))
+
+    # --- degraded mode ---
+
+    def set_degraded(self, detail: str) -> None:
+        """Flip the service into degraded mode: every subsequent
+        ``submit`` is refused with reason ``"degraded"`` before any
+        budget reserve. The state is pushed into the heartbeat's
+        ``serve.health`` section so an operator sees WHY traffic is
+        bouncing, not just that it is."""
+        from pipelinedp_tpu import obs
+        from pipelinedp_tpu.obs import monitor as obs_monitor
+        self._degraded = str(detail)
+        obs.inc("serve.degraded_entered")
+        obs.event("serve.degraded", detail=self._degraded)
+        obs_monitor.update_serve_health(
+            {"state": "degraded", "detail": self._degraded})
+
+    def clear_degraded(self) -> None:
+        """Leave degraded mode; submissions are admitted again."""
+        from pipelinedp_tpu import obs
+        from pipelinedp_tpu.obs import monitor as obs_monitor
+        if self._degraded is None:
+            return
+        self._degraded = None
+        obs.event("serve.degraded_cleared")
+        obs_monitor.update_serve_health({"state": "ok"})
 
     def close(self) -> None:
         """Graceful drain: refuse new submissions, serve everything
@@ -446,6 +486,11 @@ class Service:
         if self._closed.is_set():
             return self._refuse(rid, tenant, "shutdown",
                                 "service is draining; submit refused")
+        degraded = self._degraded
+        if degraded is not None:
+            # Refused BEFORE any budget reserve: a degraded process
+            # must not spend a tenant's charge on untrustworthy work.
+            return self._refuse(rid, tenant, "degraded", degraded)
         detail = self._validate(request)
         if detail is not None:
             return self._refuse(rid, tenant, "malformed", detail)

@@ -12,10 +12,12 @@ than one device batch therefore streams through the SAME kernel
   global bounding;
 * each chunk's per-pk partials are fetched (a small [C, P] int32 block)
   and folded into host accumulators: counts in exact int64, fixed-point
-  value lanes folded per chunk (each fold is an integer multiple of the
-  static quantization step, exactly representable) and summed in
-  float64, vector coordinates in float64 — BETTER conditioned than the
-  single-batch float32 vector accumulation;
+  value lanes reassembled per chunk into EXACT integer step totals
+  summed in float64 (the scale division happens ONCE at release, so
+  the released bits are invariant to the batch boundaries — and to the
+  mesh size, which the elastic reshard parity relies on), vector
+  coordinates in float64 — BETTER conditioned than the single-batch
+  float32 vector accumulation;
 * partition selection then runs ONCE on device over the combined
   privacy-id counts (the same batched draw as the single-batch kernel),
   and the scalar DP release goes through the shared float64 host
@@ -653,6 +655,86 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                                executor: Optional[bool] = None,
                                cache_bytes: Optional[int] = None
                                ) -> Tuple[np.ndarray, Dict, Dict]:
+    """Elastic wrapper around the streaming aggregation: device or
+    process loss mid-stream (an injected ``faults.DeviceLost``, or the
+    mesh supervisor's ``MeshParticipantLost`` heartbeat-silence verdict)
+    re-forms the mesh from the survivors (``parallel.sharded.
+    reform_mesh``), records a structured ``mesh.reshard`` event
+    (old shape -> new shape, reason, chunk index) and re-enters the
+    stream at the new shape — resuming from the last checkpoint when a
+    store is attached, restarting cleanly otherwise. Either way the
+    released values are bit-identical to a clean run at the surviving
+    shape (noise keys are pure functions of the run seed; the resumed
+    fold adopts the ORIGINAL batch assignment, regrouped onto the
+    smaller mesh — see ``ingest.assign.regroup_cells`` for the
+    non-binding-caps caveat). Requires a fixed ``rng_seed``: without
+    one the loss re-raises (replay would re-draw noise).
+
+    A third detection channel: a peer that dies while this process is
+    already blocked INSIDE a matching collective surfaces here as a
+    runtime error from the transport, not as a supervisor verdict.
+    ``health.collective_failure_to_loss`` confirms an actual peer
+    death against the beat files before that error is allowed to
+    shrink the mesh; unconfirmed errors re-raise untouched.
+
+    See :func:`_stream_impl` for the streaming contract itself."""
+    from pipelinedp_tpu.parallel import sharded as sharded_mod
+    from pipelinedp_tpu.resilience import faults
+    from pipelinedp_tpu.resilience import health as health_mod
+
+    reshards: list = []
+    while True:
+        try:
+            return _stream_impl(
+                config, encoded, scales, keep_table, sel_threshold,
+                sel_scale, sel_min_count, sel_rows_per_uid, rng_seed,
+                mesh=mesh, checkpoint=checkpoint, executor=executor,
+                cache_bytes=cache_bytes, _reshards=reshards)
+        except (faults.DeviceLost, health_mod.MeshParticipantLost,
+                RuntimeError) as loss:
+            if not isinstance(loss, (faults.DeviceLost,
+                                     health_mod.MeshParticipantLost)):
+                # XlaRuntimeError (a RuntimeError subclass) out of a
+                # collective the dead peer never joined.
+                converted = health_mod.collective_failure_to_loss(
+                    loss, mesh)
+                if converted is None:
+                    raise
+                loss = converted
+            if rng_seed is None or mesh is None:
+                raise
+            new_mesh = sharded_mod.reform_mesh(mesh)
+            if new_mesh is None:
+                raise  # nothing left to shrink to
+            record = {
+                "old_devices": int(mesh.devices.size),
+                "new_devices": int(new_mesh.devices.size),
+                "reason": ("participant_lost"
+                           if isinstance(loss,
+                                         health_mod.MeshParticipantLost)
+                           else "device_lost"),
+                "chunk": int(getattr(loss, "index", -1)),
+                "detail": str(loss),
+            }
+            reshards.append(record)
+            obs.inc("mesh.reshards")
+            obs.event("mesh.reshard", **record)
+            obs.monitor.update_mesh({
+                "state": "reformed", "reshards": len(reshards),
+                "old_devices": record["old_devices"],
+                "new_devices": record["new_devices"],
+                "reason": record["reason"]})
+            mesh = new_mesh
+
+
+def _stream_impl(config, encoded, scales, keep_table,
+                 sel_threshold, sel_scale, sel_min_count,
+                 sel_rows_per_uid, rng_seed: Optional[int],
+                 mesh=None, checkpoint=None,
+                 executor: Optional[bool] = None,
+                 cache_bytes: Optional[int] = None,
+                 _reshards: Optional[list] = None
+                 ) -> Tuple[np.ndarray, Dict, Dict]:
     """Runs the streaming aggregation. Returns ``(keep[P_pad] bool,
     part64, stats)`` where ``part64`` holds the combined float64/int64
     accumulator columns ready for ``jax_engine._host_release``; for
@@ -697,9 +779,11 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
     host fold/selection — proven across a two-process gloo mesh by
     ``tests/test_multihost.py``."""
     from pipelinedp_tpu import ingest
+    from pipelinedp_tpu.ingest import assign as ingest_assign
     from pipelinedp_tpu.ops import noise as noise_ops
     from pipelinedp_tpu.resilience import checkpoint as ckpt_mod
     from pipelinedp_tpu.resilience import faults
+    from pipelinedp_tpu.resilience import health as health_mod
 
     # The run's span tracer: phase totals always accumulate (the bench
     # timing fields below are derived views over them), full spans
@@ -747,6 +831,15 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                              "wedges the collective rendezvous")
             obs.inc("ingest.forced_serial")
         use_executor = False
+
+    # Mesh supervision (elastic multi-process recovery): armed only
+    # when the harness set PIPELINEDP_TPU_MESH_DIR and the mesh spans
+    # processes. Each collective dispatch first passes the supervisor's
+    # gate — publish my liveness beat, wait for every peer's — so a
+    # dead peer surfaces as MeshParticipantLost BEFORE this process
+    # enqueues the collective that would wedge on it.
+    sup = (health_mod.supervisor_from_env(mesh)
+           if mesh is not None else None)
 
     n_dev = mesh.devices.size if mesh is not None else 1
     P = len(encoded.pk_vocab)
@@ -801,8 +894,59 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                       tiles_per_sweep=plan.tiles_per_sweep,
                       sweeps=plan.n_sweeps)
 
-    order, counts = _batch_assignment(config, encoded, n_batches, seed,
-                                      n_dev)
+    # --- elastic resume: adopt the saved assignment -------------------
+    # A checkpoint written at a LARGER mesh shape would normally refuse
+    # to resume (its fingerprint binds n_dev). When the saved shape's
+    # own fingerprint verifies AND the new size divides the old one,
+    # the resume ADOPTS the saved assignment instead: same n_batches,
+    # same row order, same ``fold_in(k_bound, b)`` keys — the original
+    # run replayed exactly, with each batch's shard cells regrouped
+    # contiguously onto the survivors (``ingest.assign.regroup_cells``).
+    # The partition padding must also agree (pow2 meshes: it does), so
+    # the per-pk accumulator layout is unchanged.
+    ckpt_store = ckpt_mod.as_store(checkpoint)
+    if ckpt_store is not None and rng_seed is None:
+        raise ValueError(
+            "checkpointing requires a fixed rng_seed: resume must "
+            "replay the identical noise keys (the privacy budget is "
+            "consumed at noise draw, not at job success)")
+    adopt = None
+    peeked = None
+    data_dig = None
+    if ckpt_store is not None and ckpt_store.exists():
+        data_dig = ckpt_mod.data_digest(encoded)
+        peeked = ckpt_store.load()
+        a = peeked.assign if peeked is not None else None
+        if (a is not None and int(a["n_dev"]) != n_dev and
+                int(a["num_partitions"]) == P_pad and
+                int(a["n_dev"]) % n_dev == 0):
+            fp_saved = ckpt_mod.run_fingerprint(
+                config, n, int(a["n_batches"]), seed, P_pad,
+                int(a["n_dev"]), int(a["fx_bits"]), data=data_dig)
+            if fp_saved == peeked.fingerprint:
+                adopt = {k: int(v) for k, v in a.items()}
+                n_batches = int(adopt["n_batches"])
+                obs.inc("checkpoint.elastic_adoptions")
+                obs.event("checkpoint.elastic_adoption",
+                          saved_n_dev=int(adopt["n_dev"]),
+                          n_dev=int(n_dev),
+                          n_batches=int(n_batches))
+    # Persisted reshards (prior processes) + this process's records.
+    # When a run resumes its OWN checkpoint the two overlap — dedupe by
+    # content, which is safe because the chunk ordinal is global and
+    # monotone so no two distinct reshards compare equal.
+    reshard_history = list(peeked.reshards) if peeked is not None else []
+    for _rec in (_reshards or []):
+        if _rec not in reshard_history:
+            reshard_history.append(_rec)
+
+    if adopt is not None:
+        order, counts = _batch_assignment(config, encoded, n_batches,
+                                          seed, int(adopt["n_dev"]))
+        counts = ingest_assign.regroup_cells(counts, n_dev)
+    else:
+        order, counts = _batch_assignment(config, encoded, n_batches,
+                                          seed, n_dev)
     batch_rows = counts.sum(axis=1)
     # Lane capacity is bounded by the largest chunk's GLOBAL row count
     # (shard lane sums combine by psum); padding is per shard cell.
@@ -825,7 +969,8 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
             "batches (contribution bounding must see them together)")
     names = _rank1_names(config, fx_bits)
 
-    # Lane columns fold into float64 value columns per batch and never
+    # Lane columns fold into EXACT float64 step totals per batch (the
+    # scale division is deferred to release — see fold_host) and never
     # accumulate raw: only the integer count columns live in acc.
     acc = {"count": np.zeros(P_pad, np.int64),
            "privacy_id_count_raw": np.zeros(P_pad, np.int64)}
@@ -836,20 +981,27 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
     # already-folded batch prefix. The fold is left-associative, so
     # restoring the prefix sum and continuing reproduces the EXACT
     # float64 operation sequence of an uninterrupted run.
-    ckpt_store = ckpt_mod.as_store(checkpoint)
     start_batch = 0
     ckpt_fp = None
     mid_restore = None
+    if adopt is not None and fx_bits != int(adopt["fx_bits"]):
+        # Regrouping preserves each batch's GLOBAL row total, so the
+        # lane plan recomputes to the saved width by construction; a
+        # divergence means the adoption premise is broken.
+        raise AssertionError(
+            f"elastic adoption recomputed fx_bits={fx_bits} != saved "
+            f"{adopt['fx_bits']}")
     if ckpt_store is not None:
-        if rng_seed is None:
-            raise ValueError(
-                "checkpointing requires a fixed rng_seed: resume must "
-                "replay the identical noise keys (the privacy budget is "
-                "consumed at noise draw, not at job success)")
         with tr.span("ckpt.restore", cat="checkpoint"):
+            if data_dig is None:
+                data_dig = ckpt_mod.data_digest(encoded)
+            # Under adoption the fingerprint is the ORIGINAL shape's —
+            # it stays constant across every elastic reshard, so a
+            # twice-shrunken run still resumes its own checkpoints.
             ckpt_fp = ckpt_mod.run_fingerprint(
-                config, n, n_batches, seed, P_pad, n_dev, fx_bits,
-                data=ckpt_mod.data_digest(encoded))
+                config, n, n_batches, seed, P_pad,
+                int(adopt["n_dev"]) if adopt is not None else n_dev,
+                fx_bits, data=data_dig)
             saved = ckpt_store.load_for(ckpt_fp)
         if saved is not None:
             start_batch = saved.next_batch
@@ -865,6 +1017,8 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
     if mesh is not None:
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as _PSpec
+
+        from pipelinedp_tpu.parallel import sharded as psh
         row_sharding = NamedSharding(mesh, _PSpec(mesh.axis_names[0]))
     else:
         row_sharding = None
@@ -1028,18 +1182,25 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                     dev = jax.device_put(tuple(host))  # one transfer
                     nv = jnp.int32(int(ccounts[0]))
                 else:
-                    dev = jax.device_put(tuple(host), row_sharding)
-                    nv = jax.device_put(ccounts.astype(np.int32),
+                    # put_global, NOT device_put: a raw device_put onto
+                    # a multi-process sharding dispatches a hidden
+                    # equality-check collective per batch that races
+                    # with the kernel's all-reduces (see
+                    # parallel/sharded.py:put_global).
+                    dev = psh.put_global(tuple(host), row_sharding)
+                    nv = psh.put_global(ccounts.astype(np.int32),
                                         row_sharding)
                 if values_b is not None:
                     planes, values_d = dev[:-1], dev[-1]
                 else:
                     planes = dev
                     if zeros_dev is None:
-                        zeros_dev = jnp.zeros(buf_len, jnp.float32)
                         if row_sharding is not None:
-                            zeros_dev = jax.device_put(zeros_dev,
-                                                       row_sharding)
+                            zeros_dev = psh.put_global(
+                                np.zeros(buf_len, np.float32),
+                                row_sharding)
+                        else:
+                            zeros_dev = jnp.zeros(buf_len, jnp.float32)
                     values_d = zeros_dev
                 obs.inc("ingest.batches_staged")
                 if not track_reship:
@@ -1067,9 +1228,12 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
         batch64 = {name: host[i].astype(np.int64)
                    for i, name in enumerate(names)}
         batch64["privacy_id_count_raw"] = host[-1].astype(np.int64)
-        # Fold this chunk's lanes into float64 value columns (exact:
-        # integer multiples of the static quantization step).
-        je._fold_fixedpoint(config, batch64, fx_bits)
+        # Fold this chunk's lanes into EXACT float64 step totals — the
+        # scale division happens ONCE over the combined total at
+        # release, so the released low bits are invariant to the batch
+        # boundaries (and therefore to the mesh size: the elastic
+        # reshard-resume bit-parity depends on this).
+        je._fold_fx_steps(config, batch64, fx_bits)
         acc["count"] += batch64["count"]
         acc["privacy_id_count_raw"] += batch64["privacy_id_count_raw"]
         for spec in layout:
@@ -1089,6 +1253,15 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
     mid_acc = (jnp.asarray(mid_restore) if mid_restore is not None
                else None)
 
+    # Every save carries the ORIGINAL run's assignment shape (constant
+    # across elastic reshards) and the reshard history — the former is
+    # what lets a future resume on a smaller mesh adopt the assignment,
+    # the latter is the run's structured recovery trail.
+    assign_meta = (dict(adopt) if adopt is not None else
+                   {"n_batches": int(n_batches), "n_dev": int(n_dev),
+                    "num_partitions": int(P_pad),
+                    "fx_bits": int(fx_bits)})
+
     def save_ckpt(next_batch):
         nonlocal n_saves
         with tr.span("ckpt.save", cat="checkpoint",
@@ -1099,9 +1272,9 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                 arrays["vec"] = vec_acc
             if mid_acc is not None:
                 arrays["mid"] = np.asarray(mid_acc)
-            ckpt_store.save(ckpt_mod.StreamCheckpoint(ckpt_fp,
-                                                      next_batch,
-                                                      arrays))
+            ckpt_store.save(ckpt_mod.StreamCheckpoint(
+                ckpt_fp, next_batch, arrays, assign=assign_meta,
+                reshards=list(reshard_history)))
         n_saves += 1
 
     def fold_item(item):
@@ -1138,6 +1311,12 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
         # Injectable kill point: tests sever the run at chunk b and
         # assert the checkpointed resume is bit-identical.
         faults.check_chunk(b)
+        # Injectable MESH-LOSS point (before the beat: a participant
+        # that dies here is detected by its peers' gates below, before
+        # any of them enqueues the collective this batch would wedge).
+        faults.check_device_loss()
+        if sup is not None:
+            sup.gate()
         # lint: disable=rng-purity(per-batch bound key: fold of the batch index)
         kb = jax.random.fold_in(k_bound, b)
         with obs.device_annotation("pdp.stream_partials"):
@@ -1201,11 +1380,29 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
             # late, so batch b's transfer + kernel are in flight while
             # batch b-1's fetch waits.
             pending = None
-            for item in batches(start_batch):
-                out = launch(item)
+            try:
+                for item in batches(start_batch):
+                    out = launch(item)
+                    if pending is not None:
+                        fold_item(pending)
+                    pending = out
+            except (faults.FaultInjected,
+                    health_mod.MeshParticipantLost):
+                # Quiesce before propagating: the previous batch's
+                # collective is still in flight ON EVERY PROCESS. A
+                # dying participant that exits without draining it
+                # leaves its peers' fetch of that batch wedged forever;
+                # a surviving participant that re-forms without
+                # draining leaves the old mesh's collective queued
+                # under the new program. Fetch-and-discard completes
+                # it on this side either way (the result is NOT folded:
+                # the checkpoint must stay a clean fold prefix).
                 if pending is not None:
-                    fold_item(pending)
-                pending = out
+                    try:
+                        np.asarray(pending[1])
+                    except Exception:
+                        pass  # the original fault is the report
+                raise
             if pending is not None:
                 fold_item(pending)
     t_loop = pass_a.duration
@@ -1226,7 +1423,11 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                "executor": "overlapped" if use_executor else "serial"}
 
     part64: Dict[str, np.ndarray] = dict(acc)
-    part64.update(val_acc)
+    # ONE scale division over the combined step totals — bit-identical
+    # to the single-batch kernel's release (which divides its one
+    # whole-dataset total) for ANY chunking.
+    for spec in layout:
+        part64[spec.name] = val_acc[spec.name] / spec.scale
     if vec_acc is not None:
         part64["vector_sum"] = vec_acc
 
@@ -1261,6 +1462,11 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
     if ckpt_store is not None:
         stats["resumed_from_batch"] = start_batch
         stats["checkpoint_saves"] = n_saves
+    stats["mesh_reshards"] = len(reshard_history)
+    if reshard_history:
+        stats["reshard_history"] = list(reshard_history)
+    if adopt is not None:
+        stats["elastic_adopted_n_dev"] = int(adopt["n_dev"])
 
     if config.percentiles:
         # Pass B: walk the mid histogram's levels, then re-stream the
@@ -1380,6 +1586,9 @@ def stream_partials_and_select(config, encoded, scales, keep_table,
                     # (pass A re-uses the plain chunk indices, so a
                     # pass-A fault could never land here).
                     faults.check_pass_b_chunk(b)
+                    faults.check_device_loss()
+                    if sup is not None:
+                        sup.gate()
                     # lint: disable=rng-purity(per-batch bound key: fold of the batch index)
                     kb = jax.random.fold_in(k_bound, b)
                     if single_full and as_multi:

@@ -12,17 +12,52 @@ partition axis owner-sharded across the process boundary and checks:
   global axis guarantee from ``parallel/sharded.py``).
 
 Not a pytest file — invoked directly with (process_id, n_processes,
-coordinator_port) argv.
+rendezvous_file) argv.
 """
 
 import os
 import sys
 
 
+def rendezvous_port(proc_id: int, path: str,
+                    timeout_s: float = 180.0) -> int:
+    """File-based coordinator rendezvous. Process 0 allocates a free
+    port IMMEDIATELY before the coordinator binds it (closing the
+    parent-side pick-then-spawn window another process could steal the
+    port in) and publishes it atomically; the others poll the file.
+    Shared by every multihost worker variant."""
+    import json
+    import socket
+    import tempfile
+    import time
+    if proc_id == 0:
+        s = socket.socket()
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+        s.close()
+        d = os.path.dirname(os.path.abspath(path)) or "."
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            f.write(json.dumps({"port": port}))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return port
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            with open(path, encoding="utf-8") as f:
+                return int(json.loads(f.read())["port"])
+        except (OSError, ValueError, KeyError):
+            time.sleep(0.05)  # not written (or mid-replace) yet
+    raise RuntimeError(f"rendezvous file {path} never appeared "
+                       f"within {timeout_s:g}s")
+
+
 def main() -> None:
     proc_id = int(sys.argv[1])
     n_proc = int(sys.argv[2])
-    port = sys.argv[3]
+    rendezvous = sys.argv[3]
 
     # Self-deadline: if the parent test process is killed (suite
     # timeout, operator ^C) before its own worker-kill deadline fires,
@@ -36,6 +71,13 @@ def main() -> None:
     import jax
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    # Synchronous dispatch: collectives from two in-flight executables
+    # must never interleave — XLA:CPU gloo ops are keyed per-op only
+    # WITHIN an executable, so a cross-executable overlap can pair
+    # mismatched ops across the process boundary and abort the worker
+    # with a preamble-size mismatch (see test_multihost.py:_clean_env).
+    jax.config.update("jax_cpu_enable_async_dispatch", False)
+    port = rendezvous_port(proc_id, rendezvous)
     # Bounded-retry init: coordinator handshakes lose races on loaded
     # hosts, and a second attempt (jittered per process id) usually
     # lands. Exhausted retries raise — a hard failure the parent test

@@ -1,0 +1,108 @@
+"""The seeded chaos campaign (``make chaoscheck``), unit-tested: the
+schedule is a pure function of the seed, every FaultPlan seam is in the
+rotation, failures print the exact reproduction command, and single
+episodes run green in-process. The full 20-episode campaign lives in
+``make chaoscheck`` (wired into ``faultcheck``); this file pins the
+harness semantics cheaply enough for tier 1."""
+
+import os
+import threading
+
+import pytest
+
+from pipelinedp_tpu import obs
+from pipelinedp_tpu.resilience import chaos
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    obs.reset()
+    env_before = {k: v for k, v in os.environ.items()
+                  if k.startswith("PIPELINEDP_TPU_")}
+    yield
+    obs.reset()
+    orphans = [t.name for t in threading.enumerate()
+               if t.name.startswith("pdp-") and t.is_alive()]
+    assert not orphans, f"orphan threads: {orphans}"
+    env_after = {k: v for k, v in os.environ.items()
+                 if k.startswith("PIPELINEDP_TPU_")}
+    # A leaked PIPELINEDP_TPU_* knob (stream chunk, fault plan, mesh
+    # dir) would silently change every later test in the process —
+    # the exact pollution that once re-chunked the fusion suite.
+    assert env_after == env_before, (
+        f"chaos leaked env: {set(env_after) ^ set(env_before) or env_after}")
+
+
+class TestSchedule:
+
+    def test_schedule_is_deterministic_in_the_seed(self):
+        a = chaos.schedule_for(7, 40)
+        b = chaos.schedule_for(7, 40)
+        assert a == b
+        c = chaos.schedule_for(8, 40)
+        assert a != c
+        # Distinct episode seeds: 40 episodes = 40 distinct schedules.
+        assert len({e["episode_seed"] for e in a}) == 40
+
+    def test_every_seam_is_covered(self):
+        """A default campaign reaches every FaultPlan seam: each
+        scenario name appears, and collectively they exercise all the
+        plan fields plus the device-loss seam."""
+        sched = chaos.schedule_for(0, chaos.DEFAULT_SCHEDULES)
+        ran = {e["scenario"] for e in sched}
+        assert ran == set(chaos.SCENARIO_NAMES)
+        assert set(chaos.SCENARIO_NAMES) == set(chaos._SCENARIOS)
+
+    def test_failure_prints_reproducing_seed(self, monkeypatch):
+        """A failing episode's record (and the campaign output) carries
+        the exact reproduction command, seed included."""
+
+        def boom(rng, fx, tmp):
+            raise chaos.ChaosViolation("synthetic failure")
+
+        monkeypatch.setitem(chaos._SCENARIOS, "torn_ledger", boom)
+        monkeypatch.setattr(chaos, "_EXPECT_INJECTED",
+                            chaos._EXPECT_INJECTED - {"torn_ledger"})
+        lines = []
+        # Episode 7 of the rotation is torn_ledger.
+        summary = chaos.run_campaign(123, 8, out=lines.append)
+        assert summary["passed"] == 7
+        (failure,) = summary["failures"]
+        assert failure["scenario"] == "torn_ledger"
+        assert "PIPELINEDP_TPU_CHAOS_SEED=123" in failure["repro"]
+        assert "--only-episode 7" in failure["repro"]
+        assert any("PIPELINEDP_TPU_CHAOS_SEED=123" in line
+                   for line in lines)
+
+    def test_cli_seed_defaults_from_env(self, monkeypatch, capsys):
+        monkeypatch.setenv(chaos.CHAOS_SEED_ENV, "99")
+        # --only-episode 7 is torn_ledger: cheap, no jax work.
+        rc = chaos.main(["--only-episode", "7"])
+        assert rc == 0
+        assert "torn_ledger" in capsys.readouterr().out
+
+
+class TestEpisodes:
+    """Single-episode smoke: the cheap scenarios run green in-process
+    (the jax-heavy ones are covered by test_faults/test_serve and the
+    make chaoscheck campaign)."""
+
+    def test_torn_ledger_episode(self):
+        # Rotation slot 7 = torn_ledger.
+        spec = chaos.run_episode(5, 7)
+        assert spec["scenario"] == "torn_ledger"
+
+    def test_wedged_probe_episode(self):
+        # Rotation slot 4 = wedged_probe (FakeClock, zero wall time).
+        spec = chaos.run_episode(5, 4)
+        assert spec["scenario"] == "wedged_probe"
+        snap = obs.ledger().snapshot()
+        assert snap["counters"].get("faults.injected", 0) >= 1
+
+    def test_violation_surfaces_with_context(self, monkeypatch):
+        def boom(rng, fx, tmp):
+            raise chaos.ChaosViolation("invariant X broke")
+
+        monkeypatch.setitem(chaos._SCENARIOS, "wedged_probe", boom)
+        with pytest.raises(chaos.ChaosViolation, match="invariant X"):
+            chaos.run_episode(5, 4)

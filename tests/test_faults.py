@@ -264,6 +264,107 @@ class TestCheckpointResumeBitParity:
         assert_bit_identical(baseline, resumed)
 
 
+class TestElasticMeshRecovery:
+    """Device loss mid-stream is a RECOVERABLE event: the elastic
+    wrapper re-forms the mesh from the survivors, resumes from the
+    last checkpoint (adopting the original batch assignment regrouped
+    onto the smaller mesh), and releases values bit-identical to a
+    clean run at the surviving shape — the `mesh.reshard` event on the
+    run record. Single-kill AND double-kill-to-single-device."""
+
+    def _params(self, parts):
+        return pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            max_partitions_contributed=parts,
+            max_contributions_per_partition=50,
+            min_value=0.0, max_value=10.0)
+
+    def test_single_device_loss_reforms_and_matches_surviving_shape(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PIPELINEDP_TPU_STREAM_CHUNK", "500")
+        from pipelinedp_tpu import obs
+        from pipelinedp_tpu.parallel import make_mesh
+        from pipelinedp_tpu.resilience.faults import DeviceLost
+        ds, parts = make_ds(seed=8, n=14_000)
+        params = self._params(parts)
+        # Clean run at the SURVIVING shape (8 devices halve to 4).
+        baseline, _ = run_streamed(ds, params, seed=21,
+                                   mesh=make_mesh(4))
+
+        obs.reset()
+        store = CheckpointStore(str(tmp_path / "elastic.ckpt"))
+        with injected_faults(FaultPlan(lose_device_chunks=(2,))):
+            survived, timings = run_streamed(ds, params, seed=21,
+                                             mesh=make_mesh(),
+                                             checkpoint=store)
+        # The run did NOT wedge and did NOT restart from scratch: it
+        # re-formed, resumed from the checkpoint, and finished.
+        assert timings["stream_mesh_reshards"] == 1
+        hist = timings["stream_reshard_history"]
+        assert hist[0]["old_devices"] == 8
+        assert hist[0]["new_devices"] == 4
+        assert hist[0]["reason"] == "device_lost"
+        assert timings["stream_resumed_from"] >= 1
+        snap = obs.ledger().snapshot()
+        reshard_events = [e for e in snap["events"]
+                          if e["name"] == "mesh.reshard"]
+        assert len(reshard_events) == 1
+        assert reshard_events[0]["old_devices"] == 8
+        assert reshard_events[0]["new_devices"] == 4
+        assert snap["counters"]["checkpoint.elastic_adoptions"] >= 1
+        assert_bit_identical(baseline, survived)
+        assert not store.exists()  # success cleared the checkpoint
+
+    def test_double_loss_shrinks_to_single_device(self, tmp_path,
+                                                  monkeypatch):
+        """4 -> 2 -> 1: two participants lost in one run, two reshard
+        records, the final single-device mesh still releases values
+        bit-identical to a clean 1-device run."""
+        monkeypatch.setenv("PIPELINEDP_TPU_STREAM_CHUNK", "500")
+        from pipelinedp_tpu import obs
+        from pipelinedp_tpu.parallel import make_mesh
+        ds, parts = make_ds(seed=8, n=9_000)
+        params = self._params(parts)
+        baseline, _ = run_streamed(ds, params, seed=23,
+                                   mesh=make_mesh(1))
+
+        obs.reset()
+        store = CheckpointStore(str(tmp_path / "double.ckpt"))
+        with injected_faults(FaultPlan(lose_device_chunks=(1, 3))):
+            survived, timings = run_streamed(ds, params, seed=23,
+                                             mesh=make_mesh(4),
+                                             checkpoint=store)
+        hist = timings["stream_reshard_history"]
+        assert [(h["old_devices"], h["new_devices"]) for h in hist] == [
+            (4, 2), (2, 1)]
+        assert timings["stream_mesh_reshards"] == 2
+        assert_bit_identical(baseline, survived)
+
+    def test_loss_on_last_mesh_reraises(self, monkeypatch):
+        """A 1-device mesh has nothing to re-form from: the loss
+        propagates instead of looping."""
+        monkeypatch.setenv("PIPELINEDP_TPU_STREAM_CHUNK", "500")
+        from pipelinedp_tpu.parallel import make_mesh
+        from pipelinedp_tpu.resilience.faults import DeviceLost
+        ds, parts = make_ds(seed=8, n=5_000)
+        params = self._params(parts)
+        with injected_faults(FaultPlan(lose_device_chunks=(1,))):
+            with pytest.raises(DeviceLost):
+                run_streamed(ds, params, seed=23, mesh=make_mesh(1))
+
+    def test_loss_without_fixed_seed_reraises(self, monkeypatch):
+        """No fixed rng_seed means replay cannot be guaranteed — the
+        elastic retry must refuse rather than silently re-draw."""
+        monkeypatch.setenv("PIPELINEDP_TPU_STREAM_CHUNK", "500")
+        from pipelinedp_tpu.parallel import make_mesh
+        from pipelinedp_tpu.resilience.faults import DeviceLost
+        ds, parts = make_ds(seed=8, n=5_000)
+        params = self._params(parts)
+        with injected_faults(FaultPlan(lose_device_chunks=(1,))):
+            with pytest.raises(DeviceLost):
+                run_streamed(ds, params, seed=None, mesh=make_mesh())
+
+
 class TestBenchDegradation:
     """The BENCH_r05 failure mode, end to end: a wedged device probe
     must yield rc=0 and parseable ``"degraded": true`` JSON, not rc=3 —

@@ -81,7 +81,7 @@ FIXTURES = {
                 "pipelinedp_tpu/jax_engine.py"),
         # streaming.py keeps exactly two sites, in the two blessed
         # functions.
-        "clean": ("def stream_partials_and_select(src):\n"
+        "clean": ("def _stream_impl(src):\n"
                   "    return BackgroundStager(src)\n\n"
                   "def run_sweep(src):\n"
                   "    return BackgroundStager(src)\n",
@@ -283,7 +283,7 @@ class TestRuleShapes:
     """Rule behaviors beyond the basic fire/pass pair."""
 
     def test_nostager_streaming_shape_checks(self):
-        three = ("def stream_partials_and_select(s):\n"
+        three = ("def _stream_impl(s):\n"
                  "    return BackgroundStager(s)\n\n"
                  "def run_sweep(s):\n"
                  "    return BackgroundStager(s)\n\n"

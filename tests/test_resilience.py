@@ -260,6 +260,72 @@ class TestCheckpointStore:
         assert ckpt_mod.as_store(None) is None
 
 
+class _FakeMesh:
+    def __init__(self, multi):
+        self.is_multi_process = multi
+
+
+class TestCollectiveFailureToLoss:
+    """The third loss-detection channel: a peer dying INSIDE a
+    collective surfaces on the survivor as a transport runtime error,
+    which must convert to ``MeshParticipantLost`` only when a peer's
+    beat file names a provably dead pid — never on message text alone
+    (a transient network fault must not shrink the mesh)."""
+
+    GLOO = RuntimeError("FAILED_PRECONDITION: Buffer Definition Event: "
+                        "Gloo all-reduce failed: Connection reset by peer")
+
+    def _arm(self, monkeypatch, tmp_path, me=0, n=2):
+        monkeypatch.setenv(health.MESH_DIR_ENV, str(tmp_path))
+        import jax
+        monkeypatch.setattr(jax, "process_index", lambda: me)
+        monkeypatch.setattr(jax, "process_count", lambda: n)
+
+    def test_unarmed_or_single_process_returns_none(self, monkeypatch):
+        monkeypatch.delenv(health.MESH_DIR_ENV, raising=False)
+        assert health.collective_failure_to_loss(
+            self.GLOO, _FakeMesh(True)) is None
+        monkeypatch.setenv(health.MESH_DIR_ENV, "/nonexistent")
+        assert health.collective_failure_to_loss(
+            self.GLOO, _FakeMesh(False)) is None
+
+    def test_non_collective_error_returns_none(self, monkeypatch,
+                                               tmp_path):
+        self._arm(monkeypatch, tmp_path)
+        assert health.collective_failure_to_loss(
+            RuntimeError("out of memory"), _FakeMesh(True),
+            clock=FakeClock()) is None
+
+    def test_dead_peer_confirms_loss(self, monkeypatch, tmp_path):
+        self._arm(monkeypatch, tmp_path)
+        # A pid that cannot be alive: spawn a no-op child and reap it
+        # (not os.fork — jax is multithreaded and warns on fork).
+        import subprocess
+        import sys as _sys
+        child = subprocess.Popen([_sys.executable, "-c", "pass"])
+        child.wait()
+        pid = child.pid
+        ckpt_mod.atomic_write_json(
+            str(tmp_path / "mesh-1.json"),
+            {"process_id": 1, "pid": pid, "beat": 7})
+        loss = health.collective_failure_to_loss(
+            self.GLOO, _FakeMesh(True), clock=FakeClock())
+        assert isinstance(loss, health.MeshParticipantLost)
+        assert loss.process_id == 1 and loss.reason == "collective_failure"
+        assert "died mid-collective" in str(loss)
+
+    def test_all_peers_alive_reraises(self, monkeypatch, tmp_path):
+        self._arm(monkeypatch, tmp_path)
+        ckpt_mod.atomic_write_json(
+            str(tmp_path / "mesh-1.json"),
+            {"process_id": 1, "pid": os.getpid(), "beat": 7})
+        clock = FakeClock()
+        assert health.collective_failure_to_loss(
+            self.GLOO, _FakeMesh(True), clock=clock) is None
+        # It polled the full confirmation window before giving up.
+        assert clock.monotonic() >= health._COLLECTIVE_LOSS_CONFIRM_S
+
+
 class TestNoDirectSleep:
     """Lint-style invariant: no library/bench code path calls
     ``time.sleep`` directly — every wait must route through the

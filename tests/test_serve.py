@@ -890,3 +890,58 @@ class TestNoServeLint:
         `make noserve` is the same rule."""
         from pipelinedp_tpu import lint
         assert lint.check_tree("noserve") == []
+
+
+# ---------------------------------------------------------------------
+# degraded mode: structured refusal before any reserve
+# ---------------------------------------------------------------------
+
+
+class TestDegradedMode:
+
+    def test_degraded_refuses_before_reserve_and_clears(self, tmp_path):
+        """A degraded service refuses EVERY submit with the structured
+        "degraded" reason BEFORE any budget reserve — the ledger still
+        holds the full budget afterwards — and clear_degraded()
+        restores normal admission."""
+        ds = make_ds()
+        with serve.Service(str(tmp_path / "svc"),
+                           tenants={"t": (5.0, 1e-6)}) as svc:
+            svc.set_degraded("mesh lost its last participant")
+            out = svc.submit(request("t", ds, eps=1.0))
+            assert not out.ok
+            assert out.reason == "degraded"
+            assert "participant" in out.detail
+            counters = obs.ledger().snapshot()["counters"]
+            assert counters.get("serve.requests_admitted", 0) == 0
+            assert counters.get("serve.refusals.degraded", 0) == 1
+            # No reserve ever hit the durable ledger.
+            assert svc.budgets.remaining("t").epsilon == pytest.approx(
+                5.0)
+            # The heartbeat says WHY traffic is bouncing.
+            health = obs_monitor.serve_health_snapshot()
+            assert health == {"state": "degraded",
+                              "detail": "mesh lost its last participant"}
+            mon = obs_monitor.Monitor(clock=FakeClock(), run_name="dg")
+            hb = mon.poll_once()
+            assert hb["serve"]["health"]["state"] == "degraded"
+            svc.clear_degraded()
+            assert obs_monitor.serve_health_snapshot() == {"state": "ok"}
+            ok = svc.submit(request("t", ds, eps=1.0))
+            assert ok.ok, ok
+        events = [e["name"] for e in obs.ledger().snapshot()["events"]]
+        assert "serve.degraded" in events
+        assert "serve.degraded_cleared" in events
+
+    def test_degraded_env_arms_at_construction(self, tmp_path,
+                                               monkeypatch):
+        """A process that came up degraded (resilience.health set
+        PIPELINEDP_TPU_DEGRADED) starts its service refusing."""
+        from pipelinedp_tpu.resilience.health import DEGRADED_ENV
+        monkeypatch.setenv(DEGRADED_ENV, "1")
+        ds = make_ds()
+        with serve.Service(str(tmp_path / "svc"),
+                           tenants={"t": (5.0, 1e-6)}) as svc:
+            out = svc.submit(request("t", ds, eps=1.0))
+            assert out.reason == "degraded"
+            assert DEGRADED_ENV in out.detail
