@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast bench smoke multichip lint lintcheck dev clean faultcheck chaoscheck nosleep perfcheck nofoldin obscheck noperf nostager ledgercheck noartifacts watchcheck costcheck nocost plancheck noknobs kernelcheck nopallas servecheck noserve fusecheck fusionmask sketchcheck nosketchhash
+.PHONY: test test-fast bench smoke multichip lint lintcheck dev clean faultcheck chaoscheck nosleep perfcheck nofoldin obscheck noperf nostager ledgercheck noartifacts watchcheck costcheck nocost plancheck noknobs kernelcheck nopallas servecheck noserve fusecheck fusionmask sketchcheck nosketchhash veccheck
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -47,11 +47,29 @@ chaoscheck:
 # bit-parity, hybrid prefix cache, pass-B fault drain) and the
 # sketch-first suite (sketchcheck: the ingest ring's third consumer,
 # with its own kill-mid-stream drain proof).
-perfcheck: sketchcheck
+perfcheck: sketchcheck veccheck
 	$(PYTHON) -m pipelinedp_tpu.lint --rule nosleep --rule nofoldin \
 	  --rule nostager --rule nopallas
 	$(PYTHON) -m pytest tests/test_ingest.py tests/test_faults.py \
 	  tests/test_walk.py tests/test_pass_b.py -q
+
+# Wide-D vector aggregation acceptance suite: the Pallas wide-D
+# segment-sum parity matrix (random shapes, max-lane values past f32
+# exactness, every d_block bit-identical, envelope geometry + visible
+# fallbacks), the fx fixed-point accumulator's bit-identity across
+# backends / 8-device mesh / streamed pass-A (PARITY row 39), knob
+# precedence (vector_accumulator dp-UNSAFE, segsum_wide_d_block
+# dp-safe), device vector noise keyed by (partition, coordinate) with
+# distribution + key-determinism checks (PARITY row 40), fusion bucket
+# compatibility + vector padding invariance, the VECTOR_SUM elastic
+# 8->4 reshard (fx bit-identical where f32 cannot be), and the
+# pallas-confinement + rng-purity lints over the new surfaces.
+veccheck: nopallas
+	$(PYTHON) -m pipelinedp_tpu.lint --rule rng-purity
+	$(PYTHON) -m pytest tests/test_vector_fx.py tests/test_kernels.py \
+	  tests/test_fusion.py -q
+	$(PYTHON) -m pytest tests/test_faults.py -q \
+	  -k "vector_sum_survives_mid_stream_shrink"
 
 # Pallas-kernel acceptance suite: kernel-level bit-parity vs the XLA
 # scatter paths (including the lane-plan boundary widths in interpret

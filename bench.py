@@ -860,6 +860,145 @@ def bench_kernel_backend_compare(n_rows, smoke=False):
     return rec
 
 
+def bench_dp_vector_sum(n_rows, smoke=False):
+    """``dp_vector_sum_rows_per_sec``: VECTOR_SUM at MXU-facing widths
+    D in {64, 256, 1024}, streamed through the ingest ring under the
+    fixed-point (``fx``) accumulator with the Pallas wide-D segment
+    sum requested. Each width emits TWO rates — rows/s and coordinate
+    bytes/s (D x 4 bytes of accumulated payload per row: the axis the
+    wide-D tiling actually scales, where rows/s alone would reward
+    narrow vectors) — plus the per-phase roofline verdicts from the
+    cost observatory and the kernel dispatch evidence for the width
+    (``kernel.pallas_dispatches`` delta, or the visible
+    ``kernel.fallback`` reasons when the envelope refuses). Row counts
+    shrink as D grows so every width moves a comparable coordinate
+    payload. Both records stamp ``kernel_backend`` AND
+    ``vector_accumulator``, so ``--compare`` refuses cross-backend or
+    cross-accumulator gating instead of reporting a phantom
+    regression."""
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu import obs
+    from pipelinedp_tpu import streaming as streaming_mod
+    from pipelinedp_tpu.obs import costs as obs_costs
+    from pipelinedp_tpu.backends import JaxBackend
+    from pipelinedp_tpu.plan import knobs as plan_knobs
+
+    widths = (64, 256, 1024)
+    parts = 200 if smoke else 2_048
+    rng = np.random.default_rng(29)
+    acc_spec = plan_knobs.BY_NAME["vector_accumulator"]
+    kb_spec = plan_knobs.BY_NAME["kernel_backend"]
+    prev = {var: os.environ.get(var)
+            for var in (streaming_mod._CHUNK_ENV, obs_costs.ENV_VAR,
+                        acc_spec.env_var, kb_spec.env_var)}
+    # ENV pins (the top of the knob precedence chain), same isolation
+    # rationale as the kernel-backend A/B: a seam set to a default
+    # would fall through to a loaded plan file.
+    os.environ[obs_costs.ENV_VAR] = "1"
+    os.environ[acc_spec.env_var] = "fx"
+    os.environ[kb_spec.env_var] = "pallas"
+    # The cost table is process-global; save the run's captures and
+    # restore them after the per-width resets (same dance as the
+    # kernel-backend record).
+    captured_programs = dict(obs_costs.TABLE.snapshot()["programs"])
+    recs = []
+    try:
+        for d in widths:
+            # Constant coordinate payload across widths: D=1024 at the
+            # D=64 row count would be a 16x larger array (8 GB at the
+            # full-run size), and the interesting axis is D, not rows.
+            n = max((n_rows * widths[0]) // d, 2_000)
+            ds = pdp.ArrayDataset(
+                privacy_ids=rng.integers(0, max(n // 8, 500), n),
+                partition_keys=(rng.zipf(1.3, n) % parts).astype(
+                    np.int32),
+                values=rng.uniform(-1.0, 1.0,
+                                   (n, d)).astype(np.float32))
+            params = pdp.AggregateParams(
+                metrics=[pdp.Metrics.VECTOR_SUM],
+                noise_kind=pdp.NoiseKind.GAUSSIAN,
+                max_partitions_contributed=4,
+                max_contributions_per_partition=2,
+                vector_size=d, vector_max_norm=4.0,
+                vector_norm_kind=pdp.NormKind.L2)
+            # Force the ingest ring at this width's row count: 4+
+            # chunks so pass-A streams even at smoke sizes.
+            os.environ[streaming_mod._CHUNK_ENV] = str(
+                max(n // 4, 500))
+
+            def run(ds):
+                ds.invalidate_cache()
+                acc = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                                total_delta=1e-6)
+                engine = pdp.DPEngine(acc, JaxBackend(rng_seed=0))
+                result = engine.aggregate(
+                    ds, params, pdp.DataExtractors(),
+                    public_partitions=list(range(parts)))
+                acc.compute_budgets()
+                with tracer().span("bench.vector_sum", cat="bench",
+                                   d=d) as sp:
+                    out = dict(result)
+                return out, sp.duration
+
+            obs_costs.TABLE.reset()
+            before = obs.ledger().snapshot()
+            run(ds)                     # warm (compile + capture)
+            out, dt = run(ds)
+            after = obs.ledger().snapshot()
+            snap = obs_costs.TABLE.snapshot()
+            captured_programs.update(snap["programs"])
+            phases = snap["phases"]
+            # Kernel dispatch evidence for THIS width: the dispatch
+            # counter delta across both runs, and any segment_sum_wide
+            # fallback reasons — one of the two must be visible.
+            disp = (after["counters"].get("kernel.pallas_dispatches", 0)
+                    - before["counters"].get("kernel.pallas_dispatches",
+                                             0))
+            n_old = len(before["events"])
+            reasons = sorted({e.get("reason", "?")
+                              for e in after["events"][n_old:]
+                              if e["name"] == "kernel.fallback"
+                              and e.get("site") == "segment_sum_wide"})
+            rows_per_s = round(n / dt)
+            coord_bytes_per_s = round(n * d * 4 / dt)
+            common = {
+                "d": d, "rows": n, "partitions": parts,
+                "stream_s": round(dt, 3),
+                "vector_accumulator": "fx",
+                "kernel_backend": "pallas",
+                "pallas_wide_dispatches": disp,
+                "wide_fallback_reasons": reasons,
+                "device_costs": {
+                    ph: {"verdict": agg.get("verdict"),
+                         "intensity": agg.get("intensity")}
+                    for ph, agg in sorted(phases.items())
+                    if ph in ("engine", "pass_a", "pass_b")},
+            }
+            rec = {"metric": "dp_vector_sum_rows_per_sec",
+                   "value": rows_per_s, "unit": "rows/s", **common}
+            log(f"## dp_vector_sum D={d} [{n} rows x {parts} parts]: "
+                f"{rows_per_s} rows/s, {coord_bytes_per_s} "
+                f"coord-bytes/s; pallas_wide_dispatches={disp}"
+                + (f"; fallbacks={reasons}" if reasons else ""))
+            emit(rec)
+            recs.append(rec)
+            # The companion rate in the width-scaled unit: same stamp
+            # set, ``/s`` suffix, so --compare gates it identically.
+            emit({"metric": "dp_vector_sum_coord_bytes_per_sec",
+                  "value": coord_bytes_per_s, "unit": "coord-bytes/s",
+                  **common})
+    finally:
+        for var, old in prev.items():
+            if old is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = old
+        obs_costs.TABLE.reset()
+        for key, entry in captured_programs.items():
+            obs_costs.TABLE.record(key, entry)
+    return recs
+
+
 def bench_serve_latency(n_rows, smoke=False):
     """``serve_request_latency`` record: a resident ``serve.Service``
     held warm across N sequential + M concurrent requests over three
@@ -1692,6 +1831,7 @@ def compare_to_baseline(records=None, run_report=None, threshold=0.10):
     plan_mismatches = 0
     backend_mismatches = 0
     fusion_mismatches = 0
+    accumulator_mismatches = 0
     cur_plan = plan_provenance()
     cur_backend = kernel_backend_in_force()
     # One comparison per metric, at its BEST value this run — the same
@@ -1787,6 +1927,30 @@ def compare_to_baseline(records=None, run_report=None, threshold=0.10):
                 f"{rec_backend}) — not gated")
             rates.append(entry)
             continue
+        # Vector-accumulator gate (the kernel_backend refusal's twin,
+        # for the vector records): an ``fx`` rate gated against an
+        # ``f32`` baseline (or vice versa) compares exact integer
+        # accumulation against float accumulation — a different device
+        # program AND different released bits. Absent fields (old or
+        # scalar records) read as "" on both sides, so everything
+        # without the knob keeps gating exactly as before.
+        base_acc = base_rec.get("vector_accumulator", "")
+        rec_acc = rec.get("vector_accumulator", "")
+        if base_acc != rec_acc:
+            accumulator_mismatches += 1
+            entry["vector_accumulator_mismatch"] = True
+            entry["baseline_vector_accumulator"] = base_acc
+            obs.inc("bench.compare_vector_accumulator_mismatch")
+            obs.event("bench.compare_vector_accumulator_mismatch",
+                      metric=rec["metric"],
+                      baseline_accumulator=base_acc,
+                      current_accumulator=rec_acc)
+            log(f"## compare: vector-accumulator mismatch on "
+                f"{rec['metric']} (baseline "
+                f"{base_acc or 'none'}, this run "
+                f"{rec_acc or 'none'}) — not gated")
+            rates.append(entry)
+            continue
         # Fusion-mode gate (the kernel_backend refusal's twin, for the
         # serving records): a fused req/s rate gated against a solo
         # baseline (or vice versa) compares two execution modes — one
@@ -1832,6 +1996,7 @@ def compare_to_baseline(records=None, run_report=None, threshold=0.10):
             "skipped_degraded_baselines": skipped_degraded,
             "plan_mismatches": plan_mismatches,
             "kernel_backend_mismatches": backend_mismatches,
+            "vector_accumulator_mismatches": accumulator_mismatches,
             "fusion_mismatches": fusion_mismatches,
             "kernel_backend": cur_backend,
             "plan": cur_plan,
@@ -1862,6 +2027,12 @@ def compare_verdict_line(regressions):
                 f"{regressions.get('kernel_backend')} against a "
                 "baseline from the other backend; re-baseline with "
                 "matching backends before gating")
+    if regressions.get("vector_accumulator_mismatches"):
+        return (f"COMPARE: vector-accumulator mismatch — "
+                f"{regressions['vector_accumulator_mismatches']} "
+                "rate(s) not gated: this run's vector records ran the "
+                "other accumulator (fx vs f32) than their baseline; "
+                "re-baseline with matching accumulators before gating")
     if regressions.get("fusion_mismatches"):
         return (f"COMPARE: fusion-mode mismatch — "
                 f"{regressions['fusion_mismatches']} rate(s) not "
@@ -2089,6 +2260,13 @@ def main():
         # bit-parity cross-check in one record.
         bench_kernel_backend_compare(30_000 if args.smoke else 500_000,
                                      smoke=args.smoke)
+
+        # Wide-D vector aggregation: VECTOR_SUM at D in {64,256,1024}
+        # streamed through the ingest ring under the fx accumulator,
+        # with the Pallas wide-D segment sum requested and the
+        # dispatch-or-fallback evidence on the record.
+        bench_dp_vector_sum(30_000 if args.smoke else 2_000_000,
+                            smoke=args.smoke)
 
         # The resident-service record: cold vs warm request latency +
         # requests/s through a warm multi-tenant serve.Service.

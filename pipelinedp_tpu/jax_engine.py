@@ -102,6 +102,16 @@ class FusedConfig:
     # Total-cap bounding: M rows per privacy unit across ALL partitions
     # (l0/linf are None in this mode).
     max_contributions: Optional[int] = None
+    # VECTOR_SUM accumulator discipline: "f32" (plain float32
+    # segment_sum — the historical path) or "fx" (24-bit fixed-point
+    # coordinate lanes, exact). Resolved from the vector_accumulator
+    # knob in from_params; a FusedConfig built elsewhere keeps the
+    # historical default. Riding on the config (already a static jit
+    # argument on every hot path) means a knob flip re-traces.
+    vector_accumulator: str = "f32"
+    # Pinned D tile for the wide-D vector segment-sum kernel (the
+    # segsum_wide_d_block knob; 0 = the envelope's choice).
+    wide_d_block: int = 0
 
     @property
     def selection_l0(self) -> int:
@@ -129,6 +139,14 @@ class FusedConfig:
                     names.append("PERCENTILE")
             else:
                 names.append(m.name)
+        vector_accumulator = "f32"
+        wide_d_block = 0
+        if params.vector_size:
+            from pipelinedp_tpu import plan as plan_mod
+            vector_accumulator = str(
+                plan_mod.knob_value("vector_accumulator"))
+            wide_d_block = int(
+                plan_mod.knob_value("segsum_wide_d_block"))
         return FusedConfig(
             metrics=tuple(names),
             percentiles=tuple(percentiles),
@@ -148,6 +166,8 @@ class FusedConfig:
                        params.partition_selection_strategy),
             bounds_already_enforced=(
                 params.contribution_bounds_already_enforced),
+            vector_accumulator=vector_accumulator,
+            wide_d_block=wide_d_block,
         )
 
 
@@ -949,6 +969,26 @@ def _fixedpoint_layout(config: FusedConfig) -> List[_FxSpec]:
     return specs
 
 
+def _vector_fx(config: FusedConfig) -> bool:
+    """Whether VECTOR_SUM accumulates in fixed-point coordinate lanes
+    (the ``vector_accumulator`` knob resolved onto the config). Static
+    in the config, so kernel, host fold and streaming sizer agree."""
+    return ("VECTOR_SUM" in config.metrics
+            and config.vector_accumulator == "fx")
+
+
+def _vector_fx_scale(config: FusedConfig) -> float:
+    """Quantization scale of the vector coordinate grid: 2^23 - 1 steps
+    over the static norm clip bound. The quantizer's clamp doubles as a
+    per-row coordinate clamp at ±vector_max_norm — a contraction applied
+    BEFORE aggregation (never increases sensitivity; the release still
+    norm-clips the per-partition sum at the same bound), and one of the
+    two documented ways 'fx' and 'f32' releases may differ (README
+    "Vector aggregation")."""
+    bound = float(config.vector_max_norm or 0.0)
+    return (_FX_STEPS - 1) / bound if bound > 0 else 1.0
+
+
 def _reduce_per_pk(config: FusedConfig, pk_safe, masked, keep_row,
                    per_partition_sum_contrib, P, seg_marker=None,
                    fx_bits: int = 7, kernel_backend: str = "xla"):
@@ -990,7 +1030,8 @@ def _reduce_per_pk(config: FusedConfig, pk_safe, masked, keep_row,
 
     layout = _fixedpoint_layout(config)
     n_lanes = -(-_FX_PAYLOAD_BITS // fx_bits)
-    if layout and max(pk_safe.shape[0] - 8191, 1) * (
+    if (layout or _vector_fx(config)) and max(
+            pk_safe.shape[0] - 8191, 1) * (
             (1 << fx_bits) - 1) >= (1 << 31):
         # Loud trace-time guard for direct kernel callers: lane sums past
         # int32 capacity would wrap silently. The kernel only sees the
@@ -1060,14 +1101,50 @@ def _reduce_per_pk(config: FusedConfig, pk_safe, masked, keep_row,
         part[name] = ints[col + i]
 
     if "VECTOR_SUM" in names:
-        # Vector coordinates accumulate in float32 (not fixed-point
-        # lanes): the [N, V] operand would need V*n_lanes scatter
-        # columns. The f32 drift/saturation hazard the lanes eliminate
-        # for scalars therefore still applies per coordinate past ~2^24
-        # equal contributions in one partition (documented in README
-        # "Scaling limits").
-        part["vector_sum"] = jax.ops.segment_sum(masked, pk_safe,
-                                                 num_segments=P)
+        if _vector_fx(config):
+            # Fixed-point coordinate lanes — the scalar columns'
+            # discipline at [N, D] width: each coordinate quantizes to
+            # the 2^23-step grid over the norm clip bound, the
+            # offset-shifted payload splits into n_lanes int32 lane
+            # planes concatenated lane-major ([N, n_lanes*D]), and ONE
+            # wide segment sum reduces them per partition — exact
+            # int32 totals, backend- and mesh-bit-identical (PARITY
+            # row 39). The host fold (_fold_vector_fx_steps)
+            # reassembles float64 coordinates.
+            scale = _vector_fx_scale(config)
+            q = jnp.clip(jnp.round(masked * scale), -(_FX_STEPS - 1),
+                         _FX_STEPS - 1).astype(jnp.int32)
+            u = jnp.where(keep_row[:, None], q + _FX_OFFSET, 0)
+            lanes = jnp.concatenate(
+                [(u >> (k * fx_bits)) & ((1 << fx_bits) - 1)
+                 for k in range(n_lanes)], axis=1)
+            from pipelinedp_tpu.ops import kernels as hot_kernels
+            vec = hot_kernels.try_segment_sum_wide(
+                lanes, pk_safe, P, kernel_backend,
+                d_block=config.wide_d_block)
+            if vec is None:
+                vec = jax.ops.segment_sum(lanes, pk_safe,
+                                          num_segments=P)
+            part["vector_sum"] = vec
+        else:
+            # Vector coordinates accumulate in float32 (the historical
+            # default; the 'fx' accumulator above retires the hazard).
+            # The f32 drift/saturation hazard the lanes eliminate for
+            # scalars still applies per coordinate past ~2^24 equal
+            # contributions in one partition (README "Scaling
+            # limits"). The Pallas wide-D kernel never dispatches here
+            # — an f32 matmul's partial-sum order differs from the XLA
+            # scatter's, so bit-identity would not hold; a pallas
+            # request degrades visibly instead.
+            if kernel_backend == "pallas":
+                from pipelinedp_tpu import obs
+                obs.inc("kernel.fallbacks")
+                obs.event("kernel.fallback", site="segment_sum_wide",
+                          reason="vector_f32_accumulator",
+                          P=int(P), D=int(masked.shape[1]),
+                          rows=int(pk_safe.shape[0]))
+            part["vector_sum"] = jax.ops.segment_sum(masked, pk_safe,
+                                                     num_segments=P)
     return part, nseg
 
 
@@ -1092,14 +1169,41 @@ def _fold_fx_steps(config: FusedConfig, part64, fx_bits: int) -> None:
         part64[spec.name] = total
 
 
+def _fold_vector_fx_steps(config: FusedConfig, lanes, count,
+                          fx_bits: int):
+    """Reassembles the [n, n_lanes*D] vector lane sums into EXACT
+    float64 step totals [n, D]: steps = sum of lane planes * 2^(bits*k)
+    - count * offset. Same exactness contract as
+    :func:`_fold_fx_steps` — every term is an integer below 2^53, so
+    the streaming fold may accumulate step totals across chunks and
+    divide by the scale ONCE at release (batch-boundary invariant; the
+    elastic reshard-resume parity depends on it)."""
+    n_lanes = -(-_FX_PAYLOAD_BITS // fx_bits)
+    D = int(config.vector_size)
+    lanes = np.asarray(lanes)
+    total = np.zeros((lanes.shape[0], D), dtype=np.float64)
+    for k in range(n_lanes):
+        total += lanes[:, k * D:(k + 1) * D].astype(
+            np.float64) * float(1 << (k * fx_bits))
+    total -= np.asarray(count).astype(np.float64)[:, None] * _FX_OFFSET
+    return total
+
+
 def _fold_fixedpoint(config: FusedConfig, part64, fx_bits: int) -> None:
     """Reassembles the fixed-point lane columns into float64 values
     (mutates ``part64``): value = (sum of lanes * 2^(bits*k) - entries *
     offset) / scale. ``entries`` (the per-partition count of contributing
-    rows/segments) is exact int, so the offset removal is exact."""
+    rows/segments) is exact int, so the offset removal is exact. The
+    vector lanes fold the same way ([n, D] coordinates from the
+    lane-major [n, n_lanes*D] sums, offsets removed via the count
+    column)."""
     _fold_fx_steps(config, part64, fx_bits)
     for spec in _fixedpoint_layout(config):
         part64[spec.name] = part64[spec.name] / spec.scale
+    if _vector_fx(config) and "vector_sum" in part64:
+        part64["vector_sum"] = _fold_vector_fx_steps(
+            config, part64["vector_sum"], part64["count"],
+            fx_bits) / _vector_fx_scale(config)
 
 
 def _qrows(config: FusedConfig, pk, values, kept):
@@ -1276,6 +1380,12 @@ def _node_noise(noise_kind: NoiseKind, key, node_ids, pk_index=None):
 # this seam when test-mutated > plan file > this default) and the
 # module name survives as the test seam (``make noknobs``).
 _SUBHIST_BYTE_CAP = 600 << 20
+
+#: The ``vector_accumulator`` knob's module seam (plan/knobs.py
+#: registers it, dp-UNSAFE — never planned): VECTOR_SUM's 'f32' vs
+#: 'fx' accumulator discipline, resolved onto FusedConfig at
+#: from_params time.
+_VECTOR_ACCUMULATOR = "f32"
 
 # The single-batch walk unrolls its partition blocks INSIDE one XLA
 # program, so the block count is bounded: each block costs ~3 O(n)
@@ -1775,7 +1885,8 @@ def _release_noise_params(config: FusedConfig,
 
 
 def _host_release(config: FusedConfig, specs, part, nseg,
-                  rng: Optional[np.random.Generator]):
+                  rng: Optional[np.random.Generator],
+                  rng_seed: Optional[int] = None, pk_index=None):
     """The scalar DP release, on host in float64: literally the
     ``dp_computations.compute_dp_*`` mechanisms the generic combiners
     call, vectorized over the pk axis. Reusing them (instead of a
@@ -1783,7 +1894,15 @@ def _host_release(config: FusedConfig, specs, part, nseg,
     planes, draws noise at full precision — float32 noise quantizes to
     a large aggregate's ULP grid — and inherits the hardened host noise
     path when ``set_secure_host_noise(True)``. ``part`` holds float64
-    views of the fetched accumulator columns."""
+    views of the fetched accumulator columns.
+
+    VECTOR_SUM is the exception: its per-coordinate draws are batched
+    DEVICE counter RNG (``ops/vector_noise.py``) keyed by the GLOBAL
+    partition vocab index (``pk_index`` — kept indices in compact
+    release, arange(P) otherwise) and the coordinate, so streamed,
+    single-batch, fused and mesh releases of the same partition draw
+    the same noise. ``rng_seed`` is the engine seed the vector key
+    derives from; secure host noise keeps the hardened numpy path."""
     names = set(config.metrics)
     out = {}
     if "VARIANCE" in names or "MEAN" in names:
@@ -1839,19 +1958,32 @@ def _host_release(config: FusedConfig, specs, part, nseg,
             rng)
     if "VECTOR_SUM" in names:
         spec = specs["vector_sum"]
-        # add_noise_vector is batched over leading axes: the whole
-        # [P, D] stack clips + noises in one call, exactly like the
-        # generic VectorSumCombiner's per-vector release.
-        out["vector_sum"] = dp_computations.add_noise_vector(
-            part["vector_sum"],
-            dp_computations.AdditiveVectorNoiseParams(
-                eps_per_coordinate=spec.eps / config.vector_size,
-                delta_per_coordinate=spec.delta / config.vector_size,
-                max_norm=config.vector_max_norm,
-                l0_sensitivity=config.l0,
-                linf_sensitivity=config.linf,
-                norm_kind=config.vector_norm_kind,
-                noise_kind=config.noise_kind), rng)
+        noise_params = dp_computations.AdditiveVectorNoiseParams(
+            eps_per_coordinate=spec.eps / config.vector_size,
+            delta_per_coordinate=spec.delta / config.vector_size,
+            max_norm=config.vector_max_norm,
+            l0_sensitivity=config.l0,
+            linf_sensitivity=config.linf,
+            norm_kind=config.vector_norm_kind,
+            noise_kind=config.noise_kind)
+        from pipelinedp_tpu.ops import noise as noise_ops
+        if (noise_ops.secure_host_noise_enabled() and rng is None):
+            # Hardened release: the snapping/discrete mechanisms live
+            # on host — same batched call the generic combiner makes.
+            out["vector_sum"] = dp_computations.add_noise_vector(
+                part["vector_sum"], noise_params, rng)
+        else:
+            # Norm-clip on host float64 (identical to
+            # add_noise_vector's clip), then batched device
+            # counter-RNG draws keyed by (partition vocab index,
+            # coordinate) scaled by the same calibrated per-coordinate
+            # scale the numpy path uses.
+            from pipelinedp_tpu.ops import vector_noise
+            clipped = dp_computations._clip_vector(
+                np.asarray(part["vector_sum"], dtype=np.float64),
+                config.vector_max_norm, config.vector_norm_kind)
+            out["vector_sum"] = vector_noise.add_vector_noise(
+                clipped, noise_params, rng_seed, pk_index)
     return out
 
 
@@ -2137,7 +2269,7 @@ def fused_fx_bits(config: FusedConfig, padded_rows: int) -> int:
     decompositions of the same quantized per-row values, so the folded
     float64 release is bit-identical either way (the lane plan is a
     capacity choice, never a precision choice)."""
-    if _fixedpoint_layout(config):
+    if _fixedpoint_layout(config) or _vector_fx(config):
         return _fx_plan(max(int(padded_rows), 1))[0]
     return 12
 
@@ -2341,7 +2473,8 @@ class LazyFusedResult:
                        if self._rng_seed is not None else None)
                 metric_arrays = _host_release(
                     config, self._specs, part64,
-                    part64["privacy_id_count_raw"], rng)
+                    part64["privacy_id_count_raw"], rng,
+                    rng_seed=self._rng_seed, pk_index=vocab_idx)
                 for qi, name in enumerate(
                         _percentile_field_names(config.percentiles)):
                     vals_q = stream_stats["percentile_values"][:P, qi]
@@ -2480,9 +2613,18 @@ class LazyFusedResult:
             # lint: disable=rng-purity(host-release rng seeded by the engine seed)
             rng = (np.random.default_rng(self._rng_seed)
                    if self._rng_seed is not None else None)
+            # Row-aligned global vocab indices for the vector noise
+            # counters: ``vocab_idx`` when the release rows ARE the
+            # kept set (compact / public), arange otherwise (the
+            # full-fetch fallback releases every vocab row in order).
+            n_rel_rows = len(part64["count"])
+            row_vocab = (np.asarray(vocab_idx)
+                         if len(vocab_idx) == n_rel_rows
+                         else np.arange(n_rel_rows))
             metric_arrays = _host_release(config, self._specs, part64,
                                           part64["privacy_id_count_raw"],
-                                          rng)
+                                          rng, rng_seed=self._rng_seed,
+                                          pk_index=row_vocab)
             for name in _percentile_field_names(config.percentiles):
                 metric_arrays[name] = fetched[name]
             out = _assemble_output(config, encoded.pk_vocab,
@@ -2607,7 +2749,9 @@ def _run_fused_kernel(config: FusedConfig, encoded: EncodedData, scales,
     # columns (COUNT/PRIVACY_ID_COUNT-only, PERCENTILE, VECTOR_SUM,
     # select_partitions) skip the plan entirely — their int32 count
     # columns are exact to 2^31 rows and must not inherit the lane cap.
-    if _fixedpoint_layout(config):
+    # VECTOR_SUM joins the plan when its accumulator is 'fx' (the
+    # coordinate lanes share the scalar capacity arithmetic).
+    if _fixedpoint_layout(config) or _vector_fx(config):
         fx_bits, _ = _fx_plan(max(encoded.n_rows, 1))
     else:
         fx_bits = 12

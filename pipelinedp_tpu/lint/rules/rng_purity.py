@@ -6,8 +6,8 @@ assume noise keys derive deterministically from the run seed and the
 data content — an un-keyed ``np.random`` draw or a stray
 ``random.random()`` anywhere in the release path silently voids
 bit-identical replay AND the DP guarantee (unseeded noise cannot be
-audited).  This rule confines randomness to the two blessed generator
-modules; every other call site is either a violation to fix or a
+audited).  This rule confines randomness to the three blessed
+generator modules; every other call site is either a violation to fix or a
 seeded entry seam to bless inline with a written reason — the
 suppression inventory IS the repo's rng audit.
 
@@ -23,9 +23,12 @@ from pipelinedp_tpu.lint.rules.base import (Rule, dotted_name,
                                             terminal_name)
 
 #: Modules allowed to draw randomness: the counter-based node-noise
-#: generator and the host/device noise ops.
+#: generator, the host/device noise ops, and the batched vector-noise
+#: seam (the device twin of ``add_noise_vector`` — counter draws keyed
+#: by (partition vocab index, coordinate)).
 BLESSED_MODULES = ("pipelinedp_tpu/ops/counter_rng.py",
-                   "pipelinedp_tpu/ops/noise.py")
+                   "pipelinedp_tpu/ops/noise.py",
+                   "pipelinedp_tpu/ops/vector_noise.py")
 
 #: from-imports that hide rng call sites behind bare names.
 _RNG_FROM_MODULES = frozenset({"random", "numpy.random", "jax.random"})

@@ -126,11 +126,18 @@ def roofline_verdict(flops: Optional[float],
     if peaks is not None:
         out["ridge"] = round(peaks["flops_per_s"] /
                              peaks["hbm_bytes_per_s"], 3)
-    if (flops is None or bytes_accessed is None or bytes_accessed <= 0
-            or peaks is None):
+    if flops is None or bytes_accessed is None or bytes_accessed <= 0:
         return out
+    # Arithmetic intensity is a property of the PROGRAM — report it
+    # even without a peak row (the verdict stays unknown: intensity
+    # alone can't place a program against an unknown ridge). Wide-D
+    # matmul programs on an unmatched device kind used to lose their
+    # intensity here, hiding the one number that shows they are
+    # MXU-shaped.
     intensity = flops / bytes_accessed
     out["intensity"] = round(intensity, 4)
+    if peaks is None:
+        return out
     out["verdict"] = ("compute_bound" if intensity >= out["ridge"]
                       else "bandwidth_bound")
     return out

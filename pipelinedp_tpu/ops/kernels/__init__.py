@@ -23,6 +23,11 @@ hand-tiled alternatives:
   blocks at most 512 rows, so every f32 partial sum is below 2^24 —
   exact — and the int32 totals match ``jax.ops.segment_sum`` bit for
   bit.
+* :func:`segment_sum_wide` — the wide-D twin for VECTOR_SUM's
+  fixed-point coordinate lanes: the same contraction with D tiled at
+  an envelope-governed ``d_block`` (the ``segsum_wide_d_block`` knob
+  can pin it), so only a [P, Dt] accumulator slab is VMEM-resident.
+  Same exactness bound, same bit-identity (PARITY row 39).
 
 Dispatch is the ``kernel_backend`` knob (``plan/knobs.py``: env >
 seam > plan file > default, default ``xla`` — cold start is
@@ -43,9 +48,10 @@ the AST twin in ``tests/test_kernels.py``).
 # moment ``plan.seam_override`` mutates the real one.
 from pipelinedp_tpu.ops.kernels.dispatch import (  # noqa: F401
     KNOWN_BACKENDS, hist_envelope, pallas_available, segsum_envelope,
-    select_backend, try_hist_bin_multi, try_segment_sum_lanes,
-    use_interpret)
+    segsum_wide_envelope, select_backend, try_hist_bin_multi,
+    try_segment_sum_lanes, try_segment_sum_wide, use_interpret)
 from pipelinedp_tpu.ops.kernels.hist import (  # noqa: F401
     hist_bin_multi, hist_bin_multi_program)
 from pipelinedp_tpu.ops.kernels.segsum import (  # noqa: F401
-    segment_sum_lanes, segment_sum_lanes_program)
+    segment_sum_lanes, segment_sum_lanes_program, segment_sum_wide,
+    segment_sum_wide_program)

@@ -52,6 +52,18 @@ _SEGSUM_MAX_COLS = 32
 #: R <= 512, so integer accumulation through the f32 MXU stays exact.
 _ROW_BLOCKS = (512, 256, 128)
 
+#: Wide-D segment sum: D-tile candidates, widest first. The [P, Dt]
+#: accumulator slab must fit ``_OUT_BYTES_CAP`` (8192 partitions x
+#: 128 lanes x 4 B is exactly 4 MB, so even the max-P envelope keeps
+#: a tile). Lane values stay below 2^12 (the vector fixed-point lane
+#: plan), so the scalar kernel's exactness bound carries over.
+_D_BLOCKS = (512, 256, 128)
+
+#: The ``segsum_wide_d_block`` knob's module seam (plan/knobs.py
+#: registers it): 0 means "envelope picks the widest tile"; a nonzero
+#: in-envelope value pins the D tile (the autotune sweep's axis).
+_WIDE_D_BLOCK = 0
+
 #: Test seam: force ``pallas_available()`` to answer False, exercising
 #: the unavailability fallback without uninstalling anything.
 _FORCE_UNAVAILABLE = False
@@ -115,6 +127,22 @@ def segsum_envelope(P: int, C: int) -> Optional[int]:
     return _row_block(P * 4)
 
 
+def segsum_wide_envelope(P: int, D: int):
+    """``(row_block, d_block)`` for an in-envelope wide-D ``[P, D]``
+    vector segment-sum request, or None when out of envelope. Unlike
+    :func:`segsum_envelope` there is no column cap — D is tiled — but
+    the [P, Dt] slab and the [P, R] one-hot must both fit VMEM."""
+    if P > _SEGSUM_MAX_P or D < 1:
+        return None
+    rb = _row_block(P * 4)
+    if rb is None:
+        return None
+    for db in _D_BLOCKS:
+        if P * db * 4 <= _OUT_BYTES_CAP:
+            return rb, db
+    return None
+
+
 def select_backend(requested: str, site: str,
                    row_block: Optional[int], **shape) -> str:
     """The one fallback decision: ``pallas`` only when requested,
@@ -154,6 +182,29 @@ def try_segment_sum_lanes(cols, pk, P: int, requested: str):
         return None
     from pipelinedp_tpu.ops.kernels.segsum import segment_sum_lanes
     return segment_sum_lanes(cols, pk, P, rb, use_interpret())
+
+
+def try_segment_sum_wide(cols, pk, P: int, requested: str,
+                         d_block: int = 0):
+    """Dispatch seam for the wide-D vector segment sum — same contract
+    as :func:`try_segment_sum_lanes`. ``d_block`` (the
+    ``segsum_wide_d_block`` knob, 0 = auto) pins the D tile when it is
+    itself in envelope; an out-of-envelope pin falls back to the
+    envelope's own choice rather than to XLA (the knob is a dp-safe
+    performance hint, not a correctness gate)."""
+    if requested != "pallas":
+        return None
+    D = int(cols.shape[1])
+    env = segsum_wide_envelope(P, D)
+    rb = env[0] if env else None
+    if select_backend(requested, "segment_sum_wide", rb, P=int(P),
+                      D=D, rows=int(pk.shape[0])) != "pallas":
+        return None
+    db = env[1]
+    if d_block in _D_BLOCKS and P * d_block * 4 <= _OUT_BYTES_CAP:
+        db = d_block
+    from pipelinedp_tpu.ops.kernels.segsum import segment_sum_wide
+    return segment_sum_wide(cols, pk, P, rb, db, use_interpret())
 
 
 def try_hist_bin_multi(qpk, leaf, kept, sub_starts, p_offsets, Pb: int,

@@ -24,6 +24,13 @@ widths).
 Invalid rows already arrive masked (pk 0, all columns 0 — the XLA
 path's convention), so they add exact zeros; padding rows appended
 here do the same.
+
+:func:`segment_sum_wide` is the wide-D twin for VECTOR_SUM's
+fixed-point coordinate lanes: the same contraction with the D axis
+tiled at an envelope-governed ``d_block`` so a [P, Dt] accumulator
+slab (not the whole [P, D] block) is VMEM-resident, with the row axis
+as the inner grid dimension so each slab sees every row block before
+the next tile starts.
 """
 
 from __future__ import annotations
@@ -85,3 +92,62 @@ def segment_sum_lanes(cols, pk, P: int, row_block: int,
 segment_sum_lanes_program = instrumented_jit(
     phase="engine", static_argnames=("P", "row_block", "interpret"))(
         segment_sum_lanes)
+
+
+def _segsum_wide_kernel_body(pk_ref, cols_ref, out_ref):
+    from jax.experimental import pallas as pl
+    P, _ = out_ref.shape
+    R = pk_ref.shape[1]
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    pk = pk_ref[0, :].astype(jnp.float32)             # [R], exact ints
+    iota_p = jax.lax.broadcasted_iota(jnp.float32, (P, R), 0)
+    oh = jnp.where(pk[None, :] == iota_p, 1.0, 0.0)   # [P, R]
+    part = jax.lax.dot_general(
+        oh, cols_ref[...].astype(jnp.float32),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)           # [P, Dt]
+    out_ref[...] += part.astype(jnp.int32)
+
+
+def segment_sum_wide(cols, pk, P: int, row_block: int, d_block: int,
+                     interpret: bool):
+    """Wide-D tiled segment sum: ``cols`` [N, D] int32 (fixed-point
+    vector lanes), ``pk`` [N] int32 in [0, P) — returns [P, D] int32
+    bit-identical to ``jax.ops.segment_sum(cols, pk, num_segments=P)``.
+
+    Same one-hot MXU contraction as :func:`segment_sum_lanes`, but D
+    is tiled at ``d_block`` (the outer grid axis) so only a [P, Dt]
+    accumulator slab is VMEM-resident at a time; the row axis is the
+    INNER grid axis, so each slab accumulates across all row blocks
+    before the grid advances to the next D tile. ``row_block`` and
+    ``d_block`` come from ``dispatch.segsum_wide_envelope``."""
+    from jax.experimental import pallas as pl
+    n, D = cols.shape
+    n_pad = -(-n // row_block) * row_block
+    d_pad = -(-D // d_block) * d_block
+    pk2 = jnp.pad(pk, (0, n_pad - n)).reshape(-1, row_block)
+    cols2 = jnp.pad(cols, ((0, n_pad - n), (0, d_pad - D)))
+    out = pl.pallas_call(
+        _segsum_wide_kernel_body,
+        grid=(d_pad // d_block, n_pad // row_block),
+        in_specs=[
+            pl.BlockSpec((1, row_block), lambda j, i: (i, 0)),
+            pl.BlockSpec((row_block, d_block), lambda j, i: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((P, d_block), lambda j, i: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((P, d_pad), jnp.int32),
+        interpret=interpret,
+        **_compiler_params(interpret),
+    )(pk2, cols2)
+    return out[:, :D]
+
+
+#: Standalone instrumented entry for the wide-D kernel.
+segment_sum_wide_program = instrumented_jit(
+    phase="engine",
+    static_argnames=("P", "row_block", "d_block", "interpret"))(
+        segment_sum_wide)

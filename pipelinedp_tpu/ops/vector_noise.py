@@ -1,0 +1,110 @@
+"""On-device batched per-coordinate vector noise — a blessed RNG seam.
+
+VECTOR_SUM's release adds independent calibrated noise to every
+coordinate of every released [D] vector. The reference (and this
+repo's generic ``VectorSumCombiner``) draws that noise on host through
+numpy; for wide-D blocks ([P, D] with D in the hundreds) the draw is
+the release's dominant host cost. This module moves it on device as
+ONE batched counter-based threefry pass (``ops/counter_rng.py``): the
+(global partition vocab index, coordinate index) pair IS the counter,
+so a partition's noise vector is identical wherever it is released —
+single-batch compact or full fetch, streamed, serve-fused and
+mesh-sharded paths all draw the same values by construction (the
+``_node_noise`` discipline, at [n, D] width).
+
+This is a SEEDED SEAM, not a bit-twin of the numpy path: the draw
+order (and the underlying generator) differs from
+``dp_computations.add_noise_vector``'s host rng, so seeded releases
+through the fused engine differ from the generic combiners' in the
+noise bits while agreeing in distribution (asserted by the
+released-value distribution tests in ``tests/test_vector_fx.py``). The
+hardened path is untouched: with ``set_secure_host_noise(True)`` the
+engine keeps the host snapping/discrete mechanisms and never calls
+into this module.
+
+The key derives from the engine seed folded with a stream label of its
+own (``0x7ec``), independent of the selection stream (the raw engine
+key) and the quantile-tree stream (``0x7ee``). rng-purity: this module
+is one of the blessed generator modules — jax.random appears here so
+callers never touch it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pipelinedp_tpu.aggregate_params import NoiseKind
+from pipelinedp_tpu.obs.costs import instrumented_jit
+from pipelinedp_tpu.ops import counter_rng
+from pipelinedp_tpu.ops import noise as noise_ops
+
+#: Stream label folded into the engine key for the vector-noise
+#: counter stream (selection uses the raw key, the quantile tree
+#: 0x7ee).
+_VECTOR_STREAM = 0x7EC
+
+
+@instrumented_jit(phase="engine", static_argnames=("kind", "d"))
+def _unit_noise_block(seed, pk_index, kind: str, d: int):
+    """[n, d] unit-scale noise, element (i, j) a pure function of
+    (seed, pk_index[i], j)."""
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), _VECTOR_STREAM)
+    pk = jnp.asarray(pk_index).astype(jnp.uint32)
+    n = pk.shape[0]
+    x0 = jnp.broadcast_to(pk[:, None], (n, d))
+    x1 = jnp.broadcast_to(
+        jnp.arange(d, dtype=jnp.uint32)[None, :], (n, d))
+    if kind == "laplace":
+        return counter_rng.laplace(key, x0, x1)
+    return counter_rng.normal(key, x0, x1)
+
+
+def unit_noise_block(noise_kind: NoiseKind, seed: int, pk_index,
+                     d: int) -> np.ndarray:
+    """Host view of the device draw: [len(pk_index), d] float32
+    unit-scale noise keyed by (partition vocab index, coordinate)."""
+    kind = ("laplace" if noise_kind == NoiseKind.LAPLACE else
+            "gaussian")
+    return np.asarray(_unit_noise_block(
+        np.uint32(seed & 0xFFFFFFFF),
+        np.asarray(pk_index, dtype=np.uint32), kind, int(d)))
+
+
+def add_vector_noise(clipped: np.ndarray, noise_params,
+                     rng_seed: Optional[int],
+                     pk_index=None) -> np.ndarray:
+    """The device twin of ``dp_computations.add_noise_vector``'s noise
+    step: ``clipped`` [n, D] float64 (already norm-clipped), returns
+    clipped + device unit draws * the SAME calibrated per-coordinate
+    scale the numpy path computes. ``pk_index`` carries the global
+    partition vocab indices of the released rows (defaults to
+    arange(n): the public/full-release layout); an unseeded engine
+    draws a fresh stream label from host entropy."""
+    clipped = np.asarray(clipped, dtype=np.float64)
+    n, d = clipped.shape
+    if pk_index is None:
+        pk_index = np.arange(n, dtype=np.uint32)
+    if rng_seed is None:
+        rng_seed = int(np.random.SeedSequence().entropy & 0x7FFFFFFF)
+    if noise_params.noise_kind == NoiseKind.LAPLACE:
+        scale = noise_ops.laplace_scale(
+            noise_params.eps_per_coordinate,
+            noise_ops.compute_l1_sensitivity(
+                noise_params.l0_sensitivity,
+                noise_params.linf_sensitivity))
+    elif noise_params.noise_kind == NoiseKind.GAUSSIAN:
+        scale = noise_ops.gaussian_sigma(
+            noise_params.eps_per_coordinate,
+            noise_params.delta_per_coordinate,
+            noise_ops.compute_l2_sensitivity(
+                noise_params.l0_sensitivity,
+                noise_params.linf_sensitivity))
+    else:
+        raise ValueError("Noise kind must be either Laplace or Gaussian.")
+    unit = unit_noise_block(noise_params.noise_kind, rng_seed,
+                            pk_index, d)
+    return clipped + unit.astype(np.float64) * float(scale)

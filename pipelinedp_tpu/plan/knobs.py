@@ -138,6 +138,31 @@ REGISTRY: Tuple[KnobSpec, ...] = (
         "fall back to 'xla' with a kernel.fallback event.",
         choices=("xla", "pallas")),
     KnobSpec(
+        "segsum_wide_d_block", "coordinates per wide-D tile (0 = auto)",
+        0, "PIPELINEDP_TPU_SEGSUM_WIDE_D_BLOCK",
+        ("pipelinedp_tpu.ops.kernels.dispatch", "_WIDE_D_BLOCK"),
+        True, int,
+        "Pins the D-tile width of the wide-D vector segment-sum kernel "
+        "(ops/kernels/segsum.segment_sum_wide); 0 lets the envelope "
+        "pick the widest in-envelope tile. dp-safe: every tile width "
+        "is bit-identical integer arithmetic (PARITY row 39); an "
+        "out-of-envelope pin falls back to the envelope's choice."),
+    KnobSpec(
+        "vector_accumulator", "f32 | fx", "f32",
+        "PIPELINEDP_TPU_VECTOR_ACCUMULATOR",
+        ("pipelinedp_tpu.jax_engine", "_VECTOR_ACCUMULATOR"),
+        False, str,
+        "VECTOR_SUM per-coordinate accumulator: 'f32' (plain float32 "
+        "segment_sum — the historical default, drift hazard past ~2^24 "
+        "contributions per coordinate) or 'fx' (24-bit fixed-point "
+        "coordinate lanes quantized against the norm clip bound, int32 "
+        "lane sums, float64 host reassembly — exact, backend- and "
+        "mesh-bit-identical, the wide-D Pallas kernel's operand). NOT "
+        "dp-safe: the two accumulators release different floats (fx "
+        "quantizes at the clip bound), so a plan never flips it — env "
+        "override, test seam and default only.",
+        choices=("f32", "fx")),
+    KnobSpec(
         "serve_fusion", "bool", False,
         "PIPELINEDP_TPU_SERVE_FUSION", None, True, bool,
         "Shape-bucketed request fusion in the resident service "

@@ -423,12 +423,19 @@ def autotune_candidates() -> list:
             # knob, so a device where it loses (CPU interpret mode)
             # self-selects "xla" from the trial argmin.
             {"kernel_backend": "pallas"},
+            # Wide-D vector segment-sum tile widths: dp-safe (every
+            # tile is bit-identical integer arithmetic, PARITY row
+            # 39); only the vector bench workloads exercise them, so
+            # scalar trials measure the default's no-op.
+            {"segsum_wide_d_block": 256},
+            {"segsum_wide_d_block": 128},
             # The sketch binner's scatter reference: dp-safe (PARITY
             # row 36) so it sweeps with the rest. Every autotune trial
             # dispatches a small sketch-first request with its
             # vector's backend (bench.run_autotune's sketch_probe), so
             # this deviation's argmin is a measured matmul-vs-scatter
-            # comparison, not timing noise.
+            # comparison, not timing noise. Kept LAST: the sketch
+            # suite pins this position.
             {"sketch_backend": "xla"},
     ):
         vec = dict(base)

@@ -87,6 +87,16 @@ class BucketKey:
     rows: int        # pow2 row edge every member pads to
     partitions: int  # the solo path's _pad_pow2(P) — shared exactly
     fx_bits: int     # lane plan at the bucket's row edge
+    # Vector compile shape, EXPLICIT: the params signature covers
+    # these too, but the batched kernel's [B, rows, D] value plane and
+    # its accumulator layout are incompatible across any difference
+    # here — a D=64 and a D=256 request (or 'fx' vs 'f32' lanes) in
+    # one bucket would be a shape error at best and silently mixed
+    # noise calibration at worst. Keying them directly means no
+    # signature-scheme change can ever re-merge them.
+    vector_size: int = 0          # 0 = scalar request
+    vector_norm_kind: str = ""    # "" = scalar request
+    vector_accumulator: str = ""  # "" = scalar request
 
     @property
     def label(self) -> str:
@@ -118,9 +128,15 @@ def bucket_for(config, encoded, rows_floor: int) -> Optional[BucketKey]:
         return None
     rows = max(je._pad_rows(int(encoded.n_rows)),
                max(int(rows_floor), _ROWS_FLOOR_MIN))
-    return BucketKey(signature="", rows=rows,
-                     partitions=je._pad_pow2(P),
-                     fx_bits=je.fused_fx_bits(config, rows))
+    return BucketKey(
+        signature="", rows=rows, partitions=je._pad_pow2(P),
+        fx_bits=je.fused_fx_bits(config, rows),
+        vector_size=int(config.vector_size or 0),
+        vector_norm_kind=(config.vector_norm_kind.value
+                          if config.vector_size and
+                          config.vector_norm_kind else ""),
+        vector_accumulator=(config.vector_accumulator
+                            if config.vector_size else ""))
 
 
 def pad_request_to_bucket(encoded, rows_pad: int, needs_values: bool

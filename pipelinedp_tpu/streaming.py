@@ -140,7 +140,7 @@ def chunk_target_rows(config, n_dev: int) -> int:
     at the default knob on a >= 32-device mesh) would silently wrap
     them."""
     chunk = min(stream_chunk_rows() * n_dev, (1 << 31) - 1)
-    if je._fixedpoint_layout(config):
+    if je._fixedpoint_layout(config) or je._vector_fx(config):
         chunk = min(chunk, je._fx_max_rows())
     return chunk
 
@@ -959,7 +959,8 @@ def _stream_impl(config, encoded, scales, keep_table,
     # skew: one unit's rows are indivisible (bounding must see them
     # together), so the heaviest unit sets the batch floor.
     try:
-        fx_bits = je._fx_plan(max_rows)[0] if layout else 12
+        fx_bits = (je._fx_plan(max_rows)[0]
+                   if layout or je._vector_fx(config) else 12)
     except NotImplementedError:
         raise NotImplementedError(
             f"the largest streaming batch holds {max_rows} rows — beyond "
@@ -1239,7 +1240,17 @@ def _stream_impl(config, encoded, scales, keep_table,
         for spec in layout:
             val_acc[spec.name] += batch64[spec.name]
         if vec is not None:
-            v64 = np.asarray(vec).astype(np.float64)
+            if je._vector_fx(config):
+                # Same discipline as the scalar lanes: fold this
+                # chunk's [P, n_lanes*D] lane sums into EXACT [P, D]
+                # float64 step totals (offsets removed with the
+                # CHUNK's count — offset removal is linear, so
+                # per-chunk removal equals one global removal
+                # exactly) and defer the scale division to release.
+                v64 = je._fold_vector_fx_steps(
+                    config, np.asarray(vec), batch64["count"], fx_bits)
+            else:
+                v64 = np.asarray(vec).astype(np.float64)
             vec_acc = v64 if vec_acc is None else vec_acc + v64
 
     n_saves = 0
@@ -1429,7 +1440,9 @@ def _stream_impl(config, encoded, scales, keep_table,
     for spec in layout:
         part64[spec.name] = val_acc[spec.name] / spec.scale
     if vec_acc is not None:
-        part64["vector_sum"] = vec_acc
+        part64["vector_sum"] = (
+            vec_acc / je._vector_fx_scale(config)
+            if je._vector_fx(config) else vec_acc)
 
     if config.selection is None:
         keep = np.ones(P_pad, bool)

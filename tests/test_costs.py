@@ -110,6 +110,35 @@ class TestRoofline:
         no_peaks = costs.roofline_verdict(8.0, 2.0, None)
         assert no_peaks["verdict"] == "unknown"
         assert no_peaks["ridge"] is None
+        # Intensity is a property of the PROGRAM: it must survive a
+        # missing peak row (only the verdict needs the ridge).
+        assert no_peaks["intensity"] == 4.0
+
+    def test_wide_d_matmul_classifies_compute_bound_on_v5e(self):
+        """ISSUE-17 satellite: the wide-D segment-sum program's shape
+        on the v5e row. HBM traffic is one pass over the [N, D] lanes
+        + pk plus the [P, D] result (the [P, Dt] accumulator slab is
+        VMEM-resident across row blocks — the kernel's whole point),
+        while the one-hot contraction does 2*N*P*D flops: intensity
+        ~P/2 flop/byte, so at P >= ~512 partitions the program clears
+        the v5e ridge (~240) and classifies compute_bound — the one
+        workload in the repo that saturates the MXU instead of the
+        memory system."""
+        peaks = costs.device_peaks("TPU v5e")
+        assert peaks is not None and peaks["kind"] == "tpu_v5e"
+        N, P, D = 200_000, 1024, 256
+        flops = 2.0 * N * P * D
+        bytes_accessed = 4.0 * (N * D + N + P * D)
+        got = costs.roofline_verdict(flops, bytes_accessed, peaks)
+        assert got["intensity"] > got["ridge"] > 100
+        assert got["verdict"] == "compute_bound"
+        # The scalar-lane shape (C ~ a handful of metric columns)
+        # stays bandwidth_bound on the same row — wide D is what
+        # changes the regime, exactly the ISSUE's motivation.
+        C = 4
+        scalar = costs.roofline_verdict(
+            2.0 * N * 64 * C, 4.0 * (N * C + N + 64 * C), peaks)
+        assert scalar["verdict"] == "bandwidth_bound"
 
 
 class FakeCompiled:

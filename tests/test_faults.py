@@ -364,6 +364,54 @@ class TestElasticMeshRecovery:
             with pytest.raises(DeviceLost):
                 run_streamed(ds, params, seed=None, mesh=make_mesh())
 
+    @pytest.mark.parametrize("accumulator", ["fx", "f32"])
+    def test_vector_sum_survives_mid_stream_shrink(self, tmp_path,
+                                                   monkeypatch,
+                                                   accumulator):
+        """ISSUE-17 satellite: a VECTOR_SUM workload shrinks 8 -> 4
+        mid-stream and resumes matching a clean run at the surviving
+        shape. Under 'fx' the match is BIT-identical (int32 lane psum
+        + exact per-chunk lanes->steps fold — the same contract the
+        scalar metrics hold); under 'f32' it is only
+        float-approximate, because the f32 psum's partial-sum grouping
+        changes with the device count — the gap the fx accumulator
+        exists to close."""
+        monkeypatch.setenv("PIPELINEDP_TPU_STREAM_CHUNK", "500")
+        monkeypatch.setenv("PIPELINEDP_TPU_VECTOR_ACCUMULATOR",
+                           accumulator)
+        from pipelinedp_tpu.parallel import make_mesh
+        rng = np.random.default_rng(29)
+        n, parts, d = 14_000, 12, 16
+        ds = pdp.ArrayDataset(
+            privacy_ids=rng.integers(0, 2_000, n),
+            partition_keys=rng.integers(0, parts, n),
+            values=rng.uniform(-1.0, 1.0, (n, d)))
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.VECTOR_SUM],
+            max_partitions_contributed=parts,
+            max_contributions_per_partition=50,
+            vector_size=d, vector_max_norm=4.0,
+            vector_norm_kind=pdp.NormKind.L2)
+        baseline, _ = run_streamed(ds, params, seed=31,
+                                   mesh=make_mesh(4))
+        store = CheckpointStore(str(tmp_path / "vec_elastic.ckpt"))
+        with injected_faults(FaultPlan(lose_device_chunks=(2,))):
+            survived, timings = run_streamed(ds, params, seed=31,
+                                             mesh=make_mesh(),
+                                             checkpoint=store)
+        assert timings["stream_mesh_reshards"] == 1
+        hist = timings["stream_reshard_history"]
+        assert (hist[0]["old_devices"], hist[0]["new_devices"]) == (8, 4)
+        assert timings["stream_resumed_from"] >= 1
+        if accumulator == "fx":
+            assert_bit_identical(baseline, survived)
+        else:
+            assert set(baseline) == set(survived)
+            for k in baseline:
+                np.testing.assert_allclose(
+                    np.asarray(survived[k].vector_sum),
+                    np.asarray(baseline[k].vector_sum), rtol=1e-6)
+
 
 class TestBenchDegradation:
     """The BENCH_r05 failure mode, end to end: a wedged device probe

@@ -263,6 +263,129 @@ class TestPaddingInvariance:
         long = np.asarray(counter_rng.row_bits(key, 4_096))
         np.testing.assert_array_equal(short, long[:1_000])
 
+    @pytest.mark.parametrize("accumulator", ["fx", "f32"])
+    def test_vector_kernel_bit_identical_under_larger_row_padding(
+            self, accumulator):
+        """ISSUE-17 acceptance: VECTOR_SUM (both accumulators) holds
+        the same padding invariance the scalar metrics do — any bucket
+        edge >= the request's rows yields identical raw accumulator
+        bits, so a vector request can ride any compatible bucket."""
+        from pipelinedp_tpu import plan as plan_mod
+        D = 32
+        rng = np.random.default_rng(23)
+        n = 7_000
+        users = n // 20
+        data = [(int(rng.integers(0, users)), int(rng.integers(0, 30)),
+                 rng.uniform(-1.0, 1.0, D)) for _ in range(n)]
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.VECTOR_SUM],
+            noise_kind=pdp.NoiseKind.LAPLACE,
+            max_partitions_contributed=3,
+            max_contributions_per_partition=2,
+            vector_size=D, vector_max_norm=4.0,
+            vector_norm_kind=pdp.NormKind.L2)
+        with plan_mod.seam_override("vector_accumulator", accumulator):
+            config = je.FusedConfig.from_params(params, public=False)
+        assert config.vector_accumulator == accumulator
+        import operator
+        ext = DataExtractors(
+            privacy_id_extractor=operator.itemgetter(0),
+            partition_extractor=operator.itemgetter(1),
+            value_extractor=operator.itemgetter(2))
+        encoded = je.encode(data, ext, D, None)
+        P_pad = je._pad_pow2(len(encoded.pk_vocab))
+        keep_table, thr, s_scale, min_count = je.selection_inputs(
+            config, 1.0, 1e-8, None)
+        scales = np.asarray([0.9], np.float32)
+        fx_bits = je.fused_fx_bits(config, 32_768)
+
+        def run(rows_pad):
+            pid, pk, values, valid = fusion.pad_request_to_bucket(
+                encoded, rows_pad, config.needs_values)
+            keep, raw = je.fused_aggregate_kernel(
+                config, P_pad, jnp.asarray(pid), jnp.asarray(pk),
+                jnp.asarray(values), jnp.asarray(valid),
+                jnp.asarray(scales), jnp.asarray(keep_table),
+                jnp.float32(thr), jnp.float32(s_scale),
+                jnp.float32(min_count), jnp.float32(1.0),
+                jax.random.PRNGKey(11), fx_bits=fx_bits)
+            return (np.asarray(keep),
+                    {k: np.asarray(v) for k, v in raw.items()})
+
+        base_keep, base_raw = run(je._pad_rows(encoded.n_rows))
+        assert "vector_sum" in base_raw
+        if accumulator == "fx":
+            # The accumulator really is the int32 lane plane, not a
+            # float path wearing the knob.
+            assert base_raw["vector_sum"].dtype == np.int32
+        for rows_pad in (16_384, 32_768):
+            keep, raw = run(rows_pad)
+            np.testing.assert_array_equal(base_keep, keep)
+            assert set(base_raw) == set(raw)
+            for k in base_raw:
+                np.testing.assert_array_equal(base_raw[k], raw[k],
+                                              err_msg=f"{rows_pad}:{k}")
+
+
+class TestBucketVectorCompatibility:
+    """ISSUE-17 satellite: the bucket key carries the vector compile
+    shape EXPLICITLY — two requests differing in D, norm kind or
+    accumulator can never land in one fused batch."""
+
+    @staticmethod
+    def _encoded(d):
+        import operator
+        rng = np.random.default_rng(d)
+        data = [(u, u % 7, rng.uniform(-1, 1, d)) for u in range(200)]
+        ext = DataExtractors(
+            privacy_id_extractor=operator.itemgetter(0),
+            partition_extractor=operator.itemgetter(1),
+            value_extractor=operator.itemgetter(2))
+        return je.encode(data, ext, d, None)
+
+    @staticmethod
+    def _config(d, norm_kind=pdp.NormKind.L2, accumulator="f32"):
+        from pipelinedp_tpu import plan as plan_mod
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.VECTOR_SUM],
+            noise_kind=pdp.NoiseKind.LAPLACE,
+            max_partitions_contributed=3,
+            max_contributions_per_partition=2,
+            vector_size=d, vector_max_norm=4.0,
+            vector_norm_kind=norm_kind)
+        with plan_mod.seam_override("vector_accumulator", accumulator):
+            return je.FusedConfig.from_params(params, public=False)
+
+    def test_different_d_never_share_a_bucket(self):
+        k64 = fusion.bucket_for(self._config(64), self._encoded(64),
+                                8192)
+        k256 = fusion.bucket_for(self._config(256), self._encoded(256),
+                                 8192)
+        assert k64 is not None and k256 is not None
+        assert k64.vector_size == 64 and k256.vector_size == 256
+        assert k64 != k256
+
+    def test_norm_kind_and_accumulator_split_buckets(self):
+        enc = self._encoded(64)
+        l2 = fusion.bucket_for(self._config(64), enc, 8192)
+        linf = fusion.bucket_for(
+            self._config(64, norm_kind=pdp.NormKind.Linf), enc, 8192)
+        fx = fusion.bucket_for(
+            self._config(64, accumulator="fx"), enc, 8192)
+        assert l2.vector_norm_kind == "l2"
+        assert linf.vector_norm_kind == "linf"
+        assert fx.vector_accumulator == "fx"
+        assert len({l2, linf, fx}) == 3
+
+    def test_scalar_requests_keep_empty_vector_fields(self):
+        ds = make_ds(9, 2_000)
+        config = je.FusedConfig.from_params(fusable_params(),
+                                            public=False)
+        encoded = je.encode(ds, DataExtractors(), None, None)
+        key = fusion.bucket_for(config, encoded, 8192)
+        assert (key.vector_size, key.vector_norm_kind,
+                key.vector_accumulator) == (0, "", "")
+
 
 # ---------------------------------------------------------------------
 # kill-mid-batch: every lease resolves exactly once

@@ -8,6 +8,10 @@ every assertion here runs on the CPU proxy. Covered:
 * kernel-level bit-parity against the XLA scatter paths, including
   max-value lanes at every lane-plan width (12/11/4 bits) with
   per-partition totals past 2^24 (the f32-block-exactness cliff);
+* the wide-D vector twin (``segment_sum_wide``, ISSUE 17): D-tiled
+  [P, Dt] slabs bit-identical at every tile width, the
+  ``segsum_wide_d_block`` pin, and the ``vector_f32_accumulator``
+  refusal (the f32 accumulator never rides the MXU kernel);
 * the end-to-end lane-cap boundary shape from ``test_jax_engine.py``
   (525,000 rows — the 12->11-bit plan switch) bit-identical across
   backends;
@@ -89,6 +93,173 @@ class TestSegsumKernelParity:
         assert int(got[0, 0]) == n * lane_max
         ref = np.asarray(jax.ops.segment_sum(cols, pk, num_segments=P))
         np.testing.assert_array_equal(got, ref)
+
+
+class TestWideSegsumKernelParity:
+    """``segment_sum_wide`` must equal ``jax.ops.segment_sum`` bit for
+    bit over [N, D] fixed-point vector coordinate lanes — the kernel
+    leg of PARITY row 39."""
+
+    @pytest.mark.parametrize("P,D,n", [
+        (8, 64, 1000), (37, 200, 511), (512, 1024, 1300),
+        (8192, 64, 700),
+    ])
+    def test_random_parity(self, P, D, n):
+        rng = np.random.default_rng(P + D + n)
+        pk = jnp.asarray(rng.integers(0, P, n).astype(np.int32))
+        cols = jnp.asarray(
+            rng.integers(0, 4096, (n, D)).astype(np.int32))
+        env = kernels.segsum_wide_envelope(P, D)
+        assert env is not None
+        rb, db = env
+        got = kernels.segment_sum_wide(cols, pk, P, rb, db,
+                                       kernels.use_interpret())
+        ref = jax.ops.segment_sum(cols, pk, num_segments=P)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+    def test_max_lane_values_past_f32_exactness(self):
+        """Every row carries the 12-bit lane max into ONE partition at
+        a D that is NOT a d_block multiple: per-coordinate totals
+        (8192 * 4095 > 2^24) exceed f32 exactness, and the ragged last
+        D tile must mask its padding columns out of the result."""
+        n, P, D = 8192, 16, 130
+        lane_max = (1 << 12) - 1
+        pk = jnp.zeros(n, jnp.int32)
+        cols = jnp.full((n, D), lane_max, jnp.int32)
+        rb, db = kernels.segsum_wide_envelope(P, D)
+        got = np.asarray(kernels.segment_sum_wide(
+            cols, pk, P, rb, db, kernels.use_interpret()))
+        assert int(got[0, 0]) == n * lane_max
+        ref = np.asarray(jax.ops.segment_sum(cols, pk, num_segments=P))
+        np.testing.assert_array_equal(got, ref)
+
+    def test_every_d_block_is_bit_identical(self):
+        """The D tile width (the ``segsum_wide_d_block`` autotune axis)
+        is a performance hint only: every candidate reduces to the
+        same bits, so the sweep can never change released values."""
+        rng = np.random.default_rng(40)
+        n, P, D = 3000, 64, 640
+        pk = jnp.asarray(rng.integers(0, P, n).astype(np.int32))
+        cols = jnp.asarray(
+            rng.integers(0, 4096, (n, D)).astype(np.int32))
+        ref = np.asarray(jax.ops.segment_sum(cols, pk, num_segments=P))
+        rb, _ = kernels.segsum_wide_envelope(P, D)
+        for db in dispatch._D_BLOCKS:
+            got = kernels.segment_sum_wide(cols, pk, P, rb, db,
+                                           kernels.use_interpret())
+            np.testing.assert_array_equal(np.asarray(got), ref,
+                                          err_msg=f"d_block={db}")
+
+
+class TestWideSegsumDispatch:
+    """The wide-D dispatch seam: envelope geometry, the d_block pin,
+    and the visible fallbacks (``kernel.fallback`` events, never a
+    silent path change)."""
+
+    def test_envelope_geometry(self):
+        # Max-P narrows BOTH axes: the [P, R] one-hot and the [P, Dt]
+        # slab each hit their 4 MB budget exactly at 128.
+        assert kernels.segsum_wide_envelope(8192, 1024) == (128, 128)
+        # Small P affords the widest tile.
+        assert kernels.segsum_wide_envelope(64, 1024) == (512, 512)
+        # No column cap — D is tiled, unlike the scalar lane kernel.
+        assert kernels.segsum_wide_envelope(
+            64, dispatch._SEGSUM_MAX_COLS * 128) is not None
+        # P past the one-block one-hot/accumulator cap is out.
+        assert kernels.segsum_wide_envelope(
+            dispatch._SEGSUM_MAX_P * 2, 64) is None
+
+    def test_out_of_envelope_event(self):
+        rng = np.random.default_rng(1)
+        n, P = 200, dispatch._SEGSUM_MAX_P * 2
+        pk = jnp.asarray(rng.integers(0, P, n).astype(np.int32))
+        cols = jnp.asarray(
+            rng.integers(0, 4096, (n, 8)).astype(np.int32))
+        obs.reset()
+        assert dispatch.try_segment_sum_wide(cols, pk, P,
+                                             "pallas") is None
+        events = _fallback_events("out_of_envelope")
+        assert events and events[0]["site"] == "segment_sum_wide"
+
+    def test_xla_request_short_circuits(self):
+        pk = jnp.zeros(4, jnp.int32)
+        cols = jnp.ones((4, 8), jnp.int32)
+        obs.reset()
+        assert dispatch.try_segment_sum_wide(cols, pk, 8, "xla") is None
+        assert not _fallback_events()
+
+    def test_pin_honored_and_bad_pin_ignored(self):
+        """An in-envelope ``segsum_wide_d_block`` pin is used; a pin
+        whose [P, Dt] slab would blow VMEM falls back to the
+        envelope's own tile — never to XLA (the knob is a dp-safe
+        performance hint, not a correctness gate)."""
+        rng = np.random.default_rng(2)
+        n, D = 1000, 300
+        pk_small = jnp.asarray(rng.integers(0, 64, n).astype(np.int32))
+        cols = jnp.asarray(
+            rng.integers(0, 4096, (n, D)).astype(np.int32))
+        ref64 = np.asarray(
+            jax.ops.segment_sum(cols, pk_small, num_segments=64))
+        obs.reset()
+        got = dispatch.try_segment_sum_wide(cols, pk_small, 64,
+                                            "pallas", d_block=128)
+        assert got is not None
+        np.testing.assert_array_equal(np.asarray(got), ref64)
+        # At P=8192 a 512-wide slab is 16 MB — pin ignored, still
+        # a pallas dispatch, still exact.
+        pk_big = jnp.asarray(
+            rng.integers(0, 8192, n).astype(np.int32))
+        ref8k = np.asarray(
+            jax.ops.segment_sum(cols, pk_big, num_segments=8192))
+        got = dispatch.try_segment_sum_wide(cols, pk_big, 8192,
+                                            "pallas", d_block=512)
+        assert got is not None
+        np.testing.assert_array_equal(np.asarray(got), ref8k)
+        assert not _fallback_events()
+
+    def test_f32_accumulator_refuses_pallas_visibly(self):
+        """A pallas request over the default f32 vector accumulator
+        cannot be bit-identical (MXU partial-sum order differs from
+        the XLA scatter), so ``_reduce_per_pk`` refuses the kernel
+        VISIBLY: XLA results, a ``vector_f32_accumulator`` fallback
+        event — the ISSUE-17 'visibly falling back' clause."""
+        rng = np.random.default_rng(17)
+        data = [(u, f"p{u % 4}", rng.uniform(-1, 1, 64))
+                for u in range(300)]
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.VECTOR_SUM],
+            max_partitions_contributed=2,
+            max_contributions_per_partition=1,
+            vector_size=64, vector_max_norm=4.0,
+            vector_norm_kind=pdp.NormKind.L2)
+
+        def run(seed):
+            from pipelinedp_tpu.ops import noise as noise_ops
+            noise_ops.seed_host_rng(0)
+            acc = pdp.NaiveBudgetAccountant(total_epsilon=1e5,
+                                            total_delta=1e-6)
+            engine = pdp.DPEngine(acc, JaxBackend(rng_seed=seed))
+            import operator
+            ext = pdp.DataExtractors(
+                privacy_id_extractor=operator.itemgetter(0),
+                partition_extractor=operator.itemgetter(1),
+                value_extractor=operator.itemgetter(2))
+            res = engine.aggregate(data, params, ext,
+                                   public_partitions=[f"p{i}"
+                                                      for i in range(4)])
+            acc.compute_budgets()
+            return {k: np.asarray(v.vector_sum)
+                    for k, v in dict(res).items()}
+
+        base = run(9)
+        obs.reset()
+        with plan_mod.seam_override("kernel_backend", "pallas"):
+            pal = run(9)
+        events = _fallback_events("vector_f32_accumulator")
+        assert events and events[0]["site"] == "segment_sum_wide"
+        assert set(base) == set(pal)
+        for k in base:
+            np.testing.assert_array_equal(base[k], pal[k])
 
 
 class TestHistKernelParity:

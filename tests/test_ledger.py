@@ -527,6 +527,45 @@ class TestBenchCompareAcceptance:
                   if e["name"] == "bench.compare_skipped_degraded"]
         assert events and events[0]["metric"] == "m"
 
+    def test_vector_accumulator_mismatch_refuses_gate(self,
+                                                      monkeypatch):
+        """An ``fx`` vector rate never gates against an ``f32``
+        baseline (the kernel-backend refusal's twin): the mismatch is
+        recorded and the verdict line says so — while a matching-
+        accumulator pair still gates normally, including the
+        ``coord-bytes/s`` unit the wide-D vector bench emits."""
+        bench = _import_bench(monkeypatch)
+        bench.reset_run_state()
+        env = bench.env_fingerprint()
+        store = obs_store.LedgerStore(obs_store.ledger_dir())
+        store.append("v", {"record": {
+            "metric": "v", "value": 1000, "unit": "coord-bytes/s",
+            "vector_accumulator": "f32"}}, env=env)
+        bench.reset_run_state()
+        reg = bench.compare_to_baseline(records=[
+            {"metric": "v", "value": 500, "unit": "coord-bytes/s",
+             "plan_source": "default", "kernel_backend": "xla",
+             "vector_accumulator": "fx"}])
+        rate = reg["rates"][0]
+        assert rate.get("vector_accumulator_mismatch") is True
+        assert rate["baseline_vector_accumulator"] == "f32"
+        assert reg["regressed"] == []
+        assert reg["vector_accumulator_mismatches"] == 1
+        assert "vector-accumulator mismatch" in \
+            bench.compare_verdict_line(reg)
+        events = [e for e in obs.ledger().snapshot()["events"]
+                  if e["name"] ==
+                  "bench.compare_vector_accumulator_mismatch"]
+        assert events and events[0]["metric"] == "v"
+        # Same accumulator on both sides: the coord-bytes/s rate
+        # gates exactly like rows/s — a >10% drop is a regression.
+        reg2 = bench.compare_to_baseline(records=[
+            {"metric": "v", "value": 500, "unit": "coord-bytes/s",
+             "plan_source": "default", "kernel_backend": "xla",
+             "vector_accumulator": "f32"}])
+        assert reg2["rates"][0].get("regressed") is True
+        assert reg2["regressed"] == ["v"]
+
 
 class TestNoAdHocArtifactWrites:
     """AST-precise twin of ``make noartifacts``: ``json.dump(`` file

@@ -611,3 +611,52 @@ class TestSeededRegressions:
         found = findings_for("rng-purity", seeded,
                              "pipelinedp_tpu/jax_engine.py")
         assert len(found) == 1
+
+
+class TestVectorSurfaces:
+    """ISSUE-17's new files under the existing rules: the wide-D
+    kernel keeps its pallas privileges, the device vector-noise seam
+    is a blessed generator module — and NEITHER privilege leaks to
+    the other file."""
+
+    def test_nopallas_covers_the_wide_kernel_file(self):
+        src = "from jax.experimental import pallas as pl\n"
+        # The new kernel file carries the import like every kernels/
+        # module ...
+        assert findings_for("nopallas", src,
+                            "pipelinedp_tpu/ops/kernels/segsum.py") == []
+        # ... but the noise seam is NOT a kernel: a pallas import
+        # there is a finding.
+        assert findings_for("nopallas", src,
+                            "pipelinedp_tpu/ops/vector_noise.py")
+
+    def test_rng_purity_blesses_the_vector_noise_seam(self):
+        src = ("import jax\n\n"
+               "def unit(key, x0, x1):\n"
+               "    k = jax.random.fold_in(key, 0x7EC)\n"
+               "    return jax.random.normal(k, x0.shape)\n")
+        # Blessed: the seam module draws and derives keys freely.
+        assert findings_for("rng-purity", src,
+                            "pipelinedp_tpu/ops/vector_noise.py") == []
+        # The same draws anywhere else stay findings — the blessing
+        # is the file, not the pattern.
+        assert findings_for("rng-purity", src,
+                            "pipelinedp_tpu/jax_engine.py")
+        assert findings_for("rng-purity", src,
+                            "pipelinedp_tpu/ops/kernels/segsum.py")
+
+    def test_real_vector_noise_module_is_clean(self):
+        real = open(os.path.join(REPO, "pipelinedp_tpu", "ops",
+                                 "vector_noise.py"),
+                    encoding="utf-8").read()
+        result = engine.lint_source(real,
+                                    "pipelinedp_tpu/ops/vector_noise.py")
+        assert [f for f in result.findings] == []
+
+    def test_real_wide_kernel_module_is_clean(self):
+        real = open(os.path.join(REPO, "pipelinedp_tpu", "ops",
+                                 "kernels", "segsum.py"),
+                    encoding="utf-8").read()
+        result = engine.lint_source(
+            real, "pipelinedp_tpu/ops/kernels/segsum.py")
+        assert [f for f in result.findings] == []

@@ -67,6 +67,8 @@ HARDCODED_DEFAULTS = {
     "ingest_executor": True,
     "q_chunk": 0,
     "kernel_backend": "xla",
+    "segsum_wide_d_block": 0,
+    "vector_accumulator": "f32",
     "serve_fusion": False,
     "serve_fuse_window_ms": 8,
     "serve_fuse_batch": 8,
@@ -93,6 +95,8 @@ def fresh_plan_state(monkeypatch):
                 "PIPELINEDP_TPU_SERVE_FUSE_WINDOW_MS",
                 "PIPELINEDP_TPU_SERVE_FUSE_BATCH",
                 "PIPELINEDP_TPU_SERVE_FUSE_ROWS_FLOOR",
+                "PIPELINEDP_TPU_SEGSUM_WIDE_D_BLOCK",
+                "PIPELINEDP_TPU_VECTOR_ACCUMULATOR",
                 "PIPELINEDP_TPU_COMPILE_CACHE"):
         monkeypatch.delenv(var, raising=False)
     obs.reset()
