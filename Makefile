@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast bench smoke multichip lint lintcheck dev clean faultcheck chaoscheck nosleep perfcheck nofoldin obscheck noperf nostager ledgercheck noartifacts watchcheck costcheck nocost plancheck noknobs kernelcheck nopallas servecheck noserve fusecheck fusionmask sketchcheck nosketchhash veccheck
+.PHONY: test test-fast bench smoke multichip lint lintcheck dev clean faultcheck chaoscheck nosleep perfcheck nofoldin obscheck noperf nostager ledgercheck noartifacts watchcheck costcheck nocost plancheck noknobs kernelcheck nopallas servecheck noserve fusecheck fusionmask sketchcheck nosketchhash veccheck sweepcheck
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -47,11 +47,26 @@ chaoscheck:
 # bit-parity, hybrid prefix cache, pass-B fault drain) and the
 # sketch-first suite (sketchcheck: the ingest ring's third consumer,
 # with its own kill-mid-stream drain proof).
-perfcheck: sketchcheck veccheck
+perfcheck: sketchcheck veccheck sweepcheck
 	$(PYTHON) -m pipelinedp_tpu.lint --rule nosleep --rule nofoldin \
 	  --rule nostager --rule nopallas
 	$(PYTHON) -m pytest tests/test_ingest.py tests/test_faults.py \
 	  tests/test_walk.py tests/test_pass_b.py -q
+
+# Utility-analysis megasweep acceptance suite (ISSUE 18): configs as a
+# device axis — walked-vs-batched bit parity at every config-batch
+# width incl. non-dividing widths and the 8-device mesh (PARITY row
+# 41), kill-mid-megasweep resume from the .sweep sibling checkpoint,
+# serve `tune` requests (admitted, quota'd, books-stamped, zero (eps,
+# delta) debited, warm second tune compiles nothing), the configs/s
+# compare-gate refusal across batch widths — plus the jit-staticness
+# lint over the batched kernels: config values (bounds, eps-splits,
+# noise tables, knob reads) must arrive as RUNTIME inputs, never
+# freeze into the traced program.
+sweepcheck:
+	$(PYTHON) -m pipelinedp_tpu.lint --rule jit-staticness
+	$(PYTHON) -m pytest tests/test_analysis.py tests/test_serve.py \
+	  tests/test_ledger.py tests/test_lint.py -q
 
 # Wide-D vector aggregation acceptance suite: the Pallas wide-D
 # segment-sum parity matrix (random shapes, max-lane values past f32

@@ -467,6 +467,159 @@ def bench_analysis_sweep(n_rows, n_users, n_partitions, n_configs):
     return rec
 
 
+def bench_utility_megasweep(n_rows, smoke=False):
+    """The utility-analysis megasweep record: configurations as a device
+    axis. For each K in {16, 64, 256} (smoke: {4, 16}) the SAME K-config
+    (l0 x linf) grid over one >=1e6-row synthetic runs twice in one
+    process — walked (``sweep_config_batch=1``: one dispatch per config,
+    the host-walk baseline) vs batched (width K: every config rides one
+    dispatch of one warm executable whose bounds/eps-splits/selection
+    tables/noise kinds are runtime inputs) — with the outputs
+    cross-checked bit-for-bit per config. The cost observatory is
+    force-enabled for the record's duration, so the dispatch-count
+    collapse is WITNESSED, not asserted: the sweep-chunk program's
+    ``calls`` delta across each timed leg is ceil(K/width), and the
+    batched timed leg captures zero new programs (the executable was
+    warm). The record carries configs/s, configs*partitions/s, the
+    sweep phase's roofline verdict and the ``sweep_config_batch``
+    stamp ``--compare`` refuses to gate across."""
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu import analysis
+    from pipelinedp_tpu import plan as plan_mod
+    from pipelinedp_tpu.backends import JaxBackend
+    from pipelinedp_tpu.obs import costs as obs_costs
+
+    parts = 200 if smoke else 2_000
+    ds = zipf_dataset(n_rows, max(1_000, n_rows // 25), parts, seed=23)
+    extractors = pdp.DataExtractors()
+    backend = JaxBackend(rng_seed=0)
+    _SWEEP_PROGRAMS = ("_sweep_chunk_body", "_sweep_chunk_sharded")
+
+    def grid_options(k):
+        # K distinct (l0, linf) pairs — the BASELINE config-5 grid shape
+        # at width K, so every config is a genuinely different
+        # contribution-bounding hypothesis.
+        side = int(round(np.sqrt(k)))
+        pairs = [(a, b) for a in range(1, side + 1)
+                 for b in range(1, k // side + 1)]
+        multi = analysis.MultiParameterConfiguration(
+            max_partitions_contributed=[p[0] for p in pairs],
+            max_contributions_per_partition=[p[1] for p in pairs])
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT],
+            noise_kind=pdp.NoiseKind.LAPLACE,
+            max_partitions_contributed=4,
+            max_contributions_per_partition=2)
+        return len(pairs), analysis.UtilityAnalysisOptions(
+            epsilon=1.0, delta=1e-6, aggregate_params=params,
+            multi_param_configuration=multi)
+
+    def sweep_calls():
+        snap = obs_costs.TABLE.snapshot()
+        return sum(e.get("calls", 0) for e in snap["programs"].values()
+                   if e.get("program") in _SWEEP_PROGRAMS)
+
+    def sweep_programs():
+        snap = obs_costs.TABLE.snapshot()
+        return sum(1 for e in snap["programs"].values()
+                   if e.get("program") in _SWEEP_PROGRAMS)
+
+    def run(options, width, label):
+        with plan_mod.seam_override("sweep_config_batch", width):
+            with tracer().span("bench.megasweep_run", cat="bench",
+                               width=width, leg=label) as sp:
+                res = list(analysis.perform_utility_analysis(
+                    ds, backend, options, extractors))[0]
+        return res, sp.duration
+
+    prev_costs = os.environ.get(obs_costs.ENV_VAR)
+    os.environ[obs_costs.ENV_VAR] = "1"
+    # The cost table is process-global: preserve every program the
+    # earlier records captured, exactly like the kernel-backend A/B.
+    captured_programs = dict(obs_costs.TABLE.snapshot()["programs"])
+    recs = []
+    try:
+        for k in ((4, 16) if smoke else (16, 64, 256)):
+            n_cfg, options = grid_options(k)
+            # Batched leg: width = K -> the whole grid is ONE dispatch.
+            run(options, n_cfg, "batched_warm")     # compile + capture
+            calls0, progs0 = sweep_calls(), sweep_programs()
+            batched, batched_dt = run(options, n_cfg, "batched")
+            calls1, progs1 = sweep_calls(), sweep_programs()
+            batched_dispatches = calls1 - calls0
+            new_programs_warm_leg = progs1 - progs0
+            # Walked leg: width = 1 -> one dispatch per config (the
+            # pre-megasweep host walk, measured in the same process on
+            # the same data).
+            run(options, 1, "walked_warm")
+            calls2 = sweep_calls()
+            walked, walked_dt = run(options, 1, "walked")
+            walked_dispatches = sweep_calls() - calls2
+            parity = len(batched) == len(walked) == n_cfg
+            for b, w in zip(batched, walked):
+                bm, wm = b.count_metrics, w.count_metrics
+                for f in ("error_expected", "error_variance",
+                          "error_l0_expected", "error_quantiles",
+                          "ratio_data_dropped_l0"):
+                    if getattr(bm, f) != getattr(wm, f):
+                        parity = False
+            if not parity:
+                log(f"## MEGASWEEP PARITY MISMATCH at K={n_cfg} "
+                    "(batched vs walked)")
+            snap = obs_costs.TABLE.snapshot()
+            sweep_phase = (snap["phases"] or {}).get("sweep") or {}
+            captured_programs.update(snap["programs"])
+            rec = {
+                "metric": "utility_megasweep_configs_per_sec",
+                "value": round(n_cfg / batched_dt, 1),
+                "unit": "configs/s",
+                "rows": n_rows,
+                "partitions": parts,
+                "configs": n_cfg,
+                "sweep_config_batch": n_cfg,
+                "batched_s": round(batched_dt, 3),
+                "walked_s": round(walked_dt, 3),
+                "walked_configs_per_s": round(n_cfg / walked_dt, 1),
+                "configs_partitions_per_sec": round(
+                    n_cfg * parts / batched_dt),
+                "batched_vs_walked": round(walked_dt / batched_dt, 2),
+                "dispatches_batched": batched_dispatches,
+                "dispatches_walked": walked_dispatches,
+                "new_programs_in_timed_leg": new_programs_warm_leg,
+                "dispatch_check": (
+                    "ok" if (batched_dispatches == 1
+                             and walked_dispatches == n_cfg
+                             and new_programs_warm_leg == 0)
+                    else "MISMATCH"),
+                "parity": "ok" if parity else "MISMATCH",
+                "sweep_phase": {
+                    "verdict": sweep_phase.get("verdict"),
+                    "intensity": sweep_phase.get("intensity"),
+                    "calls": sweep_phase.get("calls"),
+                },
+            }
+            log(f"## megasweep K={n_cfg}: batched {batched_dt:.2f}s "
+                f"({rec['value']} cfg/s, {batched_dispatches} dispatch) "
+                f"vs walked {walked_dt:.2f}s "
+                f"({rec['walked_configs_per_s']} cfg/s, "
+                f"{walked_dispatches} dispatches) -> "
+                f"{rec['batched_vs_walked']}x; parity {rec['parity']}; "
+                f"dispatch check {rec['dispatch_check']}")
+            emit(rec)
+            recs.append(rec)
+    finally:
+        if prev_costs is None:
+            os.environ.pop(obs_costs.ENV_VAR, None)
+        else:
+            os.environ[obs_costs.ENV_VAR] = prev_costs
+        # Restore the run-wide table (earlier records' programs + this
+        # record's captures) for the final run report.
+        obs_costs.TABLE.reset()
+        for key, entry in captured_programs.items():
+            obs_costs.TABLE.record(key, entry)
+    return recs
+
+
 def bench_streaming(n_rows):
     """Streaming ingest past the single-batch capacity (VERDICT r3 #1):
     one COUNT+SUM+MEAN aggregation over ``n_rows`` rows — more than the
@@ -1421,6 +1574,47 @@ def run_autotune(args):
         hh_acc.compute_budgets()
         dict(hh_res)
 
+    # Megasweep twin workload: the sweep_config_batch knob is only a
+    # MEASURED choice if the trial actually dispatches the config-
+    # batched sweep kernels — every trial runs the same small
+    # utility-analysis grid inside its timed span with the trial
+    # vector's batch width in force (via the seam; the sweep phase
+    # feeds the trial's ``phases`` dict, which plan/model.py's fit
+    # consumes), so the base-vs-deviation argmin compares measured
+    # walked-vs-batched dispatch behavior and every other deviation
+    # pays the identical sweep cost.
+    from pipelinedp_tpu import analysis as analysis_mod
+    sw_rng = np.random.default_rng(31)
+    sw_n = 30_000
+    sw_ds = pdp.ArrayDataset(
+        privacy_ids=sw_rng.integers(0, 4_000, sw_n),
+        partition_keys=(sw_rng.zipf(1.3, sw_n) % 200).astype(np.int64),
+        values=sw_rng.uniform(0.0, 10.0, sw_n))
+    sw_pairs = [(a, b) for a in range(1, 5) for b in range(1, 5)]
+    sw_options = analysis_mod.UtilityAnalysisOptions(
+        epsilon=1.0, delta=1e-6,
+        aggregate_params=pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT],
+            noise_kind=pdp.NoiseKind.LAPLACE,
+            max_partitions_contributed=4,
+            max_contributions_per_partition=2),
+        multi_param_configuration=(
+            analysis_mod.MultiParameterConfiguration(
+                max_partitions_contributed=[p[0] for p in sw_pairs],
+                max_contributions_per_partition=[p[1]
+                                                 for p in sw_pairs])))
+
+    def sweep_probe(vec):
+        with plan_mod.seam_override(
+                "sweep_config_batch",
+                int(vec.get("sweep_config_batch", 0))):
+            with tracer().span("autotune.sweep_probe",
+                               cat="autotune") as sp:
+                list(analysis_mod.perform_utility_analysis(
+                    sw_ds, JaxBackend(rng_seed=0), sw_options,
+                    pdp.DataExtractors()))
+        return sp.duration
+
     led = _bench_ledger()
     # Pre-sweep end offset of the ledger file: the post-sweep fit reads
     # only the bytes appended after this point (read_from), so fitting
@@ -1479,7 +1673,8 @@ def run_autotune(args):
             with tracer().span("autotune.trial", cat="autotune") as sp:
                 dict(result)
                 sketch_probe(vec)
-        return sp.duration, result.timings or {}
+                sweep_s = sweep_probe(vec)
+        return sp.duration, result.timings or {}, sweep_s
 
     try:
         candidates = plan_mod.autotune_candidates()
@@ -1491,7 +1686,7 @@ def run_autotune(args):
         # window and bias the measured argmin toward the default.
         for i, vec in enumerate(candidates):
             one_run(vec)
-            dt, timings = one_run(vec)
+            dt, timings, sweep_s = one_run(vec)
             trial = {
                 "index": i,
                 "knobs": {k: (int(v) if isinstance(v, bool) else v)
@@ -1503,6 +1698,7 @@ def run_autotune(args):
                 "phases": {
                     "pass_a": timings.get("stream_t_total"),
                     "pass_b": timings.get("stream_pass_b_sweep_s"),
+                    "sweep": round(sweep_s, 4),
                 },
                 "pass_b_sweeps": timings.get("stream_pass_b_sweeps"),
             }
@@ -1832,6 +2028,7 @@ def compare_to_baseline(records=None, run_report=None, threshold=0.10):
     backend_mismatches = 0
     fusion_mismatches = 0
     accumulator_mismatches = 0
+    sweep_batch_mismatches = 0
     cur_plan = plan_provenance()
     cur_backend = kernel_backend_in_force()
     # One comparison per metric, at its BEST value this run — the same
@@ -1951,6 +2148,33 @@ def compare_to_baseline(records=None, run_report=None, threshold=0.10):
                 f"{rec_acc or 'none'}) — not gated")
             rates.append(entry)
             continue
+        # Sweep-config-batch gate (the kernel_backend refusal's twin,
+        # for the megasweep records): a width-256 configs/s rate gated
+        # against a width-16 baseline compares two different dispatch
+        # regimes of the same kernel — ceil(K/width) dispatches each —
+        # and grids of different size besides. The outputs are
+        # bit-identical per config at every width (PARITY row 41), so
+        # only the RATE comparison is meaningless, never the results.
+        # Absent fields (old or non-megasweep records) read as "" on
+        # both sides, so everything without the stamp keeps gating
+        # exactly as before.
+        base_scb = base_rec.get("sweep_config_batch", "")
+        rec_scb = rec.get("sweep_config_batch", "")
+        if base_scb != rec_scb:
+            sweep_batch_mismatches += 1
+            entry["sweep_config_batch_mismatch"] = True
+            entry["baseline_sweep_config_batch"] = base_scb
+            obs.inc("bench.compare_sweep_config_batch_mismatch")
+            obs.event("bench.compare_sweep_config_batch_mismatch",
+                      metric=rec["metric"],
+                      baseline_batch=base_scb,
+                      current_batch=rec_scb)
+            log(f"## compare: sweep-config-batch mismatch on "
+                f"{rec['metric']} (baseline "
+                f"{base_scb or 'none'}, this run "
+                f"{rec_scb or 'none'}) — not gated")
+            rates.append(entry)
+            continue
         # Fusion-mode gate (the kernel_backend refusal's twin, for the
         # serving records): a fused req/s rate gated against a solo
         # baseline (or vice versa) compares two execution modes — one
@@ -1998,6 +2222,7 @@ def compare_to_baseline(records=None, run_report=None, threshold=0.10):
             "kernel_backend_mismatches": backend_mismatches,
             "vector_accumulator_mismatches": accumulator_mismatches,
             "fusion_mismatches": fusion_mismatches,
+            "sweep_config_batch_mismatches": sweep_batch_mismatches,
             "kernel_backend": cur_backend,
             "plan": cur_plan,
             "regressed": regressed}
@@ -2039,11 +2264,20 @@ def compare_verdict_line(regressions):
                 "gated: this run's serve records ran the other "
                 "fusion mode than their baseline; re-baseline with "
                 "matching modes before gating")
+    if regressions.get("sweep_config_batch_mismatches"):
+        return (f"COMPARE: sweep-config-batch mismatch — "
+                f"{regressions['sweep_config_batch_mismatches']} "
+                "rate(s) not gated: this run's megasweep records ran "
+                "a different config-batch width than their baseline "
+                "(a different dispatch regime of the same "
+                "bit-identical kernel); re-baseline with matching "
+                "widths before gating")
     n_based = sum(1 for r in regressions["rates"]
                   if r.get("baseline") is not None and
                   not r.get("plan_mismatch") and
                   not r.get("kernel_backend_mismatch") and
-                  not r.get("fusion_mismatch"))
+                  not r.get("fusion_mismatch") and
+                  not r.get("sweep_config_batch_mismatch"))
     if n_based == 0:
         # Nothing was actually gated — say so, instead of an "on pace"
         # that reads as a passing verdict on a first run or a fresh
@@ -2287,6 +2521,13 @@ def main():
         # Config 5: the analysis epsilon-sweep.
         bench_analysis_sweep(a_rows, max(1000, a_rows // 25),
                              1_000 if not args.smoke else 100, a_configs)
+
+        # The config-axis megasweep: walked-vs-batched A/B at K in
+        # {16,64,256} over a >=1e6-row synthetic, per-config
+        # bit-parity cross-checked, dispatch counts witnessed from the
+        # cost observatory.
+        bench_utility_megasweep(20_000 if args.smoke else 1_000_000,
+                                smoke=args.smoke)
 
         # The north-star workload at ITS OWN scale: MovieLens-25M is
         # 25M ratings x 162k users x 59k movies (BASELINE configs 1-2).

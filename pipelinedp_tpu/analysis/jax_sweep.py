@@ -76,6 +76,11 @@ _CHUNK_CAP = 512
 # Row-broadcast budget per chunk: bounds the [n, Cc] stage-B footprint
 # (n_pad * chunk <= this).
 _CHUNK_ROW_BUDGET = 1 << 26
+# Knob seam (plan/knobs.py "sweep_config_batch"): nonzero pins the
+# configuration-axis batch width; 0 = the auto sizing below. Kept as a
+# module constant purely as the registry's test seam — consumers go
+# through knobs.value().
+_SWEEP_CONFIG_BATCH = 0
 
 
 def sweep_is_supported(options: data_structures.UtilityAnalysisOptions,
@@ -333,14 +338,14 @@ def _keep_probability(strategy, mu, var, m3, table, thr, scale, is_tg,
     pmf = pmf.at[..., -1].set(1.0 - cdf_lo[..., -1])
     valid_center = centers >= 0
     pmf = jnp.where(valid_center, pmf, 0.0)
-    win = jnp.sum(pmf * keep_at(jnp.maximum(centers, 0.0)), axis=-1)
+    win = _fold_last(pmf * keep_at(jnp.maximum(centers, 0.0)))
 
     # --- Gauss-Hermite (large sigma) ---
     nodes, weights = np.polynomial.hermite.hermgauss(_GH_ORDER)
     xs = mu[..., None] + math.sqrt(2.0) * sigma[..., None] * nodes
-    gh = jnp.sum(
+    gh = _fold_last(
         (weights / math.sqrt(math.pi)) *
-        keep_at(jnp.maximum(xs.astype(jnp.float32), 0.0)), axis=-1)
+        keep_at(jnp.maximum(xs.astype(jnp.float32), 0.0)))
 
     point = keep_at(jnp.maximum(jnp.round(mu), 0.0)[..., None])[..., 0]
     small = sigma * 8.0 <= _WINDOW
@@ -355,33 +360,75 @@ def _table_lookup(table, ii):
                     out_axes=1)(table, ii)
 
 
-def _error_quantiles(noise_kind, exp_l0, var_l0, noise_std, log_rs,
-                     t_table, is_gauss=None):
+def _fold_partitions(a):
+    """Sum over the leading (partition) axis with a FIXED halving tree:
+    each stage adds the upper half onto the lower half elementwise, so
+    the floating-point combination order is a function of P alone —
+    never of the config-axis width riding in the trailing dims. This
+    is what makes walked (chunk=1) and batched (chunk=K) sweeps
+    bit-identical per config (PARITY row 41): a plain
+    ``jnp.sum(axis=0)`` lets XLA pick a width-dependent reduction
+    strategy whose rounding differs in the last ulp. P is normally the
+    pow2-padded partition count; an odd stage carries its last row
+    into the next fold unchanged."""
+    while a.shape[0] > 1:
+        n = a.shape[0]
+        half = n // 2
+        front = a[:half] + a[half:2 * half]
+        a = (front if n % 2 == 0 else
+             jnp.concatenate([front, a[2 * half:]], axis=0))
+    return a[0]
+
+
+def _fold_last(a):
+    """`_fold_partitions` over the trailing axis: a fixed halving tree
+    replacing ``jnp.sum(axis=-1)`` on the window / Gauss-Hermite axes of
+    `_keep_probability`. XLA lowers a plain last-axis ``reduce`` with a
+    width- and layout-dependent accumulation order (it even splits long
+    axes through ``reduce-window``), so the same moments summed under a
+    different config-axis width can drift by an ulp — explicit slices and
+    adds pin the combination order for every chunk width (PARITY row
+    41)."""
+    while a.shape[-1] > 1:
+        n = a.shape[-1]
+        half = n // 2
+        front = a[..., :half] + a[..., half:2 * half]
+        a = (front if n % 2 == 0 else
+             jnp.concatenate([front, a[..., 2 * half:]], axis=-1))
+    return a[..., 0]
+
+
+def _error_quantiles(noise_kind, exp_l0, var_l0, noise_std, noise_sq,
+                     log_rs, t_table, is_gauss=None):
     """Per-(partition, config, q) error quantiles of bounding + noise.
     Host twin: ``SumAggregateErrorMetricsCombiner._compute_error_quantiles``
     with the inverted quantile levels. ``noise_kind=None`` means a mixed
     sweep: both closed forms are evaluated and selected per config via
-    the ``is_gauss`` [Cc] mask."""
+    the ``is_gauss`` [Cc] mask. ``noise_sq`` is the host-precomputed
+    noise_std² (see `_metric_chunk`: squaring on device invites a
+    width-dependent fma contraction of ``var_l0 + noise²``)."""
     inv_q = np.asarray([1.0 - q for q in ERROR_QUANTILES], np.float32)
 
     def gaussian():
-        std = jnp.sqrt(var_l0 + noise_std**2)
+        std = jnp.sqrt(var_l0 + noise_sq)
         return (exp_l0[..., None] +
                 std[..., None] * _ndtri(inv_q)[None, None, :])
 
     def laplace():
         # Laplace noise + Gaussian L0 error: interpolated quantile table
-        # over the noise ratio r = sigma_l0 / b.
+        # over the noise ratio r = sigma_l0 / b. One vectorized
+        # computation over the quantile axis (jnp.interp interpolates
+        # each table column at every logr point; elementwise math is
+        # identical to interpolating the columns one at a time).
         b = noise_std / math.sqrt(2.0)
         r = jnp.sqrt(jnp.maximum(var_l0, 0.0)) / jnp.maximum(b, 1e-30)
         logr = jnp.log(jnp.maximum(r, 1e-6))
-        ts = []
-        for qi in range(len(ERROR_QUANTILES)):
-            t = jnp.interp(logr, log_rs, t_table[:, qi])
-            # Beyond the grid the Gaussian term dominates: t ≈ r·Φ⁻¹(q).
-            t = jnp.where(r > 900.0, r * float(_scipy_ppf(inv_q[qi])), t)
-            ts.append(t)
-        return exp_l0[..., None] + b[..., None] * jnp.stack(ts, axis=-1)
+        t = jax.vmap(lambda col: jnp.interp(logr, log_rs, col),
+                     in_axes=1, out_axes=-1)(t_table)  # [..., Q]
+        # Beyond the grid the Gaussian term dominates: t ≈ r·Φ⁻¹(q).
+        ppf = jnp.asarray(_scipy_ppf(inv_q), t.dtype)
+        t = jnp.where((r > 900.0)[..., None], r[..., None] * ppf, t)
+        return exp_l0[..., None] + b[..., None] * t
 
     if noise_kind == NoiseKind.GAUSSIAN:
         return gaussian()
@@ -396,9 +443,9 @@ def _scipy_ppf(q):
 
 
 def _metric_chunk(metric_name, x_u, marker, pk_safe, p_u, bounds_lo,
-                  bounds_hi, noise_std, noise_kind, p_keep_pk, mask_pk,
-                  pseudo_mask_pk, P, log_rs, t_table, is_gauss=None,
-                  per_partition=False):
+                  bounds_hi, noise_std, noise_sq_row, noise_kind,
+                  p_keep_pk, mask_pk, pseudo_mask_pk, P, log_rs, t_table,
+                  is_gauss=None, per_partition=False):
     """Stage B+C for one metric over one config chunk. Returns the [Cc]
     aggregate accumulator fields (reference
     ``SumAggregateErrorMetricsCombiner.create_accumulator`` summed over
@@ -440,7 +487,15 @@ def _metric_chunk(metric_name, x_u, marker, pk_safe, p_u, bounds_lo,
         exp_l0 = exp_l0 + (-zc) * pm
         # var term is zero: p(1-p) = 0.
 
-    noise = noise_std[None, :]  # [1, Cc]
+    noise = noise_std[None, :]      # [1, Cc]
+    # noise² is HOST-precomputed (same f32 rounding as an on-device
+    # multiply) and shipped as data: written as ``noise * noise`` LLVM
+    # may contract ``var_l0 + noise*noise`` into fma(noise, noise,
+    # var_l0), and whether it does depends on the config-axis
+    # vectorization width — breaking walked-vs-batched bit parity
+    # (PARITY row 41) in the last ulp. A parameter operand cannot be
+    # contracted, so the sum rounds identically at every chunk width.
+    noise_sq = noise_sq_row[None, :]  # [1, Cc]
     p_keep = p_keep_pk          # [P, Cc]
     m = mask_pk[:, None]
 
@@ -448,10 +503,11 @@ def _metric_chunk(metric_name, x_u, marker, pk_safe, p_u, bounds_lo,
     err_linf_min = p_keep * e_min
     err_linf_max = p_keep * e_max
     err_l0_var = p_keep * var_l0
-    err_var = p_keep * (var_l0 + noise**2)
+    err_var = p_keep * (var_l0 + noise_sq)
     qs = _error_quantiles(noise_kind, exp_l0, var_l0,
-                          jnp.broadcast_to(noise, exp_l0.shape), log_rs,
-                          t_table, is_gauss)  # [P, Cc, Q]
+                          jnp.broadcast_to(noise, exp_l0.shape),
+                          jnp.broadcast_to(noise_sq, exp_l0.shape),
+                          log_rs, t_table, is_gauss)  # [P, Cc, Q]
     err_quant = p_keep[..., None] * (qs + (e_min + e_max)[..., None])
     err_w_dropped = (p_keep * (exp_l0 + e_min + e_max) +
                      (1 - p_keep) * -psum)
@@ -473,10 +529,10 @@ def _metric_chunk(metric_name, x_u, marker, pk_safe, p_u, bounds_lo,
         dropped_sel = (1 - p_keep) * (psum + exp_l0 + e_max)
 
     def S(a):  # sum over (masked) partitions → [Cc]
-        return jnp.sum(a * m, axis=0)
+        return _fold_partitions(a * m)
 
     def Sq(a):  # [P, Cc, Q] → [Cc, Q]
-        return jnp.sum(a * m[..., None], axis=0)
+        return _fold_partitions(a * m[..., None])
 
     pp = {}
     if per_partition:
@@ -487,7 +543,7 @@ def _metric_chunk(metric_name, x_u, marker, pk_safe, p_u, bounds_lo,
 
     return {
         **pp,
-        "num_partitions": jnp.sum(m) * jnp.ones(Cc),
+        "num_partitions": _fold_partitions(m)[0] * jnp.ones(Cc),
         "kept_partitions_expected": S(p_keep),
         "total_aggregate": S(psum),
         "data_dropped_l0": S(dropped_l0),
@@ -558,10 +614,15 @@ def _sweep_chunk_body(metric_names, strategy, noise_kind, P, public,
                                       table, thr, scale, is_tg, is_lap)
         p_keep_pk = jnp.where(mask_pk[:, None], p_keep_pk, 0.0)
         mf = mask_pk.astype(jnp.float32)[:, None]
+        # Partition-axis sums via the fixed fold: the combination order
+        # must not depend on the config-axis width (see
+        # _fold_partitions).
         sel_stats = {
-            "num_partitions": jnp.sum(mf) * jnp.ones(l0.shape[0]),
-            "keep_sum": jnp.sum(p_keep_pk * mf, axis=0),
-            "keep_var": jnp.sum(p_keep_pk * (1 - p_keep_pk) * mf, axis=0),
+            "num_partitions": (_fold_partitions(mf)[0] *
+                               jnp.ones(l0.shape[0])),
+            "keep_sum": _fold_partitions(p_keep_pk * mf),
+            "keep_var": _fold_partitions(p_keep_pk * (1 - p_keep_pk) *
+                                         mf),
         }
 
     out = {}
@@ -576,9 +637,12 @@ def _sweep_chunk_body(metric_names, strategy, noise_kind, P, public,
         else:  # privacy_id_count
             x_u = jnp.minimum(count_u, 1.0)
             lo_b, hi_b = jnp.zeros_like(linf), jnp.ones_like(linf)
+        # Rows [M:] of noise_std_rows carry the host-precomputed squares
+        # (see _metric_chunk on why noise² must arrive as data).
         out[name] = _metric_chunk(
             name, x_u, markerf, pk_safe, p_u, lo_b, hi_b,
-            noise_std_rows[idx], noise_kind, p_keep_pk,
+            noise_std_rows[idx], noise_std_rows[len(metric_names) + idx],
+            noise_kind, p_keep_pk,
             mask_pk.astype(jnp.float32), pseudo_mask, P, log_rs, t_table,
             is_gauss, per_partition=per_partition)
         idx += 1
@@ -977,22 +1041,34 @@ class LazySweepResult:
             tuple(1.0 - q for q in ERROR_QUANTILES))
 
         # Config chunking: bound both the [n, Cc] broadcast and the
-        # [P, Cc, 2·WINDOW+1] selection-window footprints.
+        # [P, Cc, 2·WINDOW+1] selection-window footprints. The
+        # sweep_config_batch knob (0 = this auto sizing) pins the width
+        # explicitly — every width is bit-identical per config, so the
+        # planner may sweep it.
+        from pipelinedp_tpu.plan import knobs as _knobs
         n_dev = self._mesh.devices.size if self._mesh is not None else 1
-        chunk = int(np.clip(
-            min(_CHUNK_ROW_BUDGET // max(n_pad, 1),
-                (1 << 28) // max(P_pad * (2 * _WINDOW + 1), 1),
-                _pad_pow2(C, minimum=1)),  # don't pad tiny sweeps up
-            1, _CHUNK_CAP))
-        # Lane-align the config axis: every [n, Cc] / [P, Cc, w] operand
-        # carries Cc in the TPU lane dimension, which tiles in units of
-        # 128 — a chunk of 133 silently pads every broadcast to 256
-        # lanes (measured 6x on the 10k-config sweep). Large chunks
-        # round DOWN to a 128 multiple, small ones to a power of two.
-        if chunk >= 128:
-            chunk = (chunk // 128) * 128
-        elif chunk > 1:
-            chunk = 1 << (chunk.bit_length() - 1)
+        pinned = int(_knobs.value("sweep_config_batch"))
+        if pinned > 0:
+            # A pin is respected exactly (clamped to the chunk cap):
+            # chunk=1 IS the walked mode the parity bench measures
+            # against, so no lane rounding here.
+            chunk = int(np.clip(pinned, 1, _CHUNK_CAP))
+        else:
+            chunk = int(np.clip(
+                min(_CHUNK_ROW_BUDGET // max(n_pad, 1),
+                    (1 << 28) // max(P_pad * (2 * _WINDOW + 1), 1),
+                    _pad_pow2(C, minimum=1)),  # don't pad tiny sweeps up
+                1, _CHUNK_CAP))
+            # Lane-align the config axis: every [n, Cc] / [P, Cc, w]
+            # operand carries Cc in the TPU lane dimension, which tiles
+            # in units of 128 — a chunk of 133 silently pads every
+            # broadcast to 256 lanes (measured 6x on the 10k-config
+            # sweep). Large chunks round DOWN to a 128 multiple, small
+            # ones to a power of two.
+            if chunk >= 128:
+                chunk = (chunk // 128) * 128
+            elif chunk > 1:
+                chunk = 1 << (chunk.bit_length() - 1)
         if n_dev > 1:
             # Sharded over the mesh: every device takes an equal slice of
             # the chunk's configuration axis.
@@ -1016,7 +1092,13 @@ class LazySweepResult:
 
         host_cfg = (cpad(vectors["l0"]), cpad(vectors["linf"]),
                     cpad(vectors["min_sum"]), cpad(vectors["max_sum"]),
-                    cpad(noise_rows, axis=1) if len(noise_rows) else
+                    # Rows [M:] are the host-precomputed squares the
+                    # kernel adds to var_l0 (squaring on device invites
+                    # a width-dependent fma contraction, see
+                    # _metric_chunk).
+                    cpad(np.concatenate([noise_rows,
+                                         noise_rows * noise_rows]),
+                         axis=1) if len(noise_rows) else
                     np.zeros((0, C_pad), np.float32),
                     cpad(table), cpad(thr), cpad(scale), cpad(is_tg),
                     cpad(is_lap), cpad(is_gauss))
@@ -1102,15 +1184,38 @@ class LazySweepResult:
                     flat[f"s:{f}"] = np.asarray(v)
             return flat
 
+        import time as _time
+
+        from pipelinedp_tpu.obs import monitor as _monitor
+
         chunk_outs = []
         pp_chunks = []
+        n_chunks = -(-C // chunk)
+        t_sweep0 = _time.monotonic()
+        live_configs = 0  # configs dispatched THIS run (excl. resume)
         for ci, start in enumerate(range(0, C, chunk)):
             if ckpt_store is not None and ci < done_chunks:
                 continue  # restored from the checkpoint prefix
-            # Injectable kill point (the streaming loop's chunk-kill
-            # twin): tests sever the sweep at chunk ci and assert the
-            # resumed grid is bit-identical.
+            # Injectable kill points (the streaming loop's chunk-kill
+            # twin, plus the megasweep's own seam): tests sever the
+            # sweep at config chunk ci and assert the resumed grid is
+            # bit-identical.
             faults.check_chunk(ci)
+            faults.check_sweep_config_chunk(ci)
+            # Megasweep heartbeat: the monitor's push registry carries
+            # configs done vs planned + configs/s, so a stalled config
+            # batch is nameable from the heartbeat alone.
+            el = _time.monotonic() - t_sweep0
+            _monitor.update_sweep({
+                "configs_done": min(ci * chunk, C),
+                "configs_planned": C,
+                "chunk": ci,
+                "chunks_planned": n_chunks,
+                "config_batch": chunk,
+                "configs_per_s": round(live_configs / el, 1) if el > 0
+                else 0.0,
+                "resumed_from_chunk": done_chunks,
+            })
             # Ledger span per sweep chunk (a no-op unless
             # PIPELINEDP_TPU_TRACE is set); dispatch is async, so an
             # untraced chunk costs nothing and a traced one shows where
@@ -1151,6 +1256,12 @@ class LazySweepResult:
                         ckpt_fp, ci + 1, acc_flat))
             else:
                 chunk_outs.append((out, sel))
+            live_configs += chunk
+
+        # The grid completed: clear the heartbeat's sweep section. (A
+        # KILLED sweep deliberately leaves its last snapshot installed,
+        # so the stall watchdog names the blocked config batch.)
+        _monitor.update_sweep(None)
 
         if ckpt_store is not None:
             # Reassemble the flat checkpoint namespace; the trailing

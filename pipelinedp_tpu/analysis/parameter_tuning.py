@@ -126,20 +126,19 @@ def _convert_utility_analysis_to_tune_result(
             MinimizingFunction.ABSOLUTE_ERROR)
     metric = tune_options.aggregate_params.metrics[0]
     if metric == Metrics.COUNT:
-        rmse = [
-            am.count_metrics.absolute_rmse()
-            for am in utility_analysis_result
-        ]
+        ms = [am.count_metrics for am in utility_analysis_result]
     elif metric == Metrics.SUM:
-        rmse = [
-            am.sum_metrics.absolute_rmse()
-            for am in utility_analysis_result
-        ]
+        ms = [am.sum_metrics for am in utility_analysis_result]
     else:
-        rmse = [
-            am.privacy_id_count_metrics.absolute_rmse()
-            for am in utility_analysis_result
-        ]
+        ms = [am.privacy_id_count_metrics
+              for am in utility_analysis_result]
+    # Argmin over the batched error surface: one vectorized RMSE over
+    # the [C] config axis (the per-config absolute_rmse closed form,
+    # sqrt(E[err]^2 + Var[err]), evaluated as arrays) instead of C
+    # Python method calls.
+    exp = np.asarray([m.error_expected for m in ms], np.float64)
+    var = np.asarray([m.error_variance for m in ms], np.float64)
+    rmse = np.sqrt(exp * exp + var)
     index_best = int(np.argmin(rmse))
     return TuneResult(tune_options, contribution_histograms,
                       run_configurations, index_best,

@@ -98,6 +98,19 @@ class JitStaticnessRule(Rule):
                     yield (node.lineno,
                            f"time.{term} inside traced '{fn.name}' "
                            "freezes at trace time")
+                elif (term == "value"
+                      and isinstance(recv, ast.Name)
+                      and recv.id in ("knobs", "_knobs")):
+                    # The megasweep contract (ISSUE 18): config values
+                    # — batch widths, bounds, eps-splits — reach the
+                    # batched kernels as RUNTIME inputs; a knob read
+                    # inside the traced body bakes one plan's value
+                    # into the compiled program and every new config
+                    # batch recompiles.
+                    yield (node.lineno,
+                           f"knobs.value read inside traced "
+                           f"'{fn.name}' freezes the planner's value "
+                           "at trace time")
             name = None
             if isinstance(node, ast.Name):
                 name = node.id

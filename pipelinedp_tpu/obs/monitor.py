@@ -168,6 +168,7 @@ def reset_requests() -> None:
     update_fusion(None)
     update_mesh(None)
     update_serve_health(None)
+    update_sweep(None)
 
 
 # The serve-fusion bucket registry: the fusion layer (serve/fusion.py)
@@ -240,6 +241,30 @@ def serve_health_snapshot() -> Optional[Dict[str, Any]]:
     with _SERVE_HEALTH_LOCK:
         return (dict(_SERVE_HEALTH) if _SERVE_HEALTH is not None
                 else None)
+
+
+# The megasweep-progress registry: the utility-analysis sweep driver
+# (analysis/jax_sweep.py) pushes its config-chunk progress here — same
+# push pattern as fusion/mesh above (the monitor never imports the
+# layers it observes). The heartbeat grows a "sweep" section while a
+# megasweep is in flight (configs done vs planned, configs/s, current
+# chunk), so the stall watchdog can name the blocked config batch.
+
+_SWEEP_LOCK = threading.Lock()
+_SWEEP_STATE: Optional[Dict[str, Any]] = None
+
+
+def update_sweep(snapshot: Optional[Dict[str, Any]]) -> None:
+    """Install (or, with None, clear) the megasweep progress snapshot
+    the next heartbeat embeds."""
+    global _SWEEP_STATE
+    with _SWEEP_LOCK:
+        _SWEEP_STATE = dict(snapshot) if snapshot is not None else None
+
+
+def sweep_snapshot() -> Optional[Dict[str, Any]]:
+    with _SWEEP_LOCK:
+        return dict(_SWEEP_STATE) if _SWEEP_STATE is not None else None
 
 
 class Monitor:
@@ -514,6 +539,12 @@ class Monitor:
             # Elastic-recovery trail: the mesh re-formed mid-run
             # (old shape -> new shape, reason, reshard count).
             hb["mesh"] = mesh
+        sweep = sweep_snapshot()
+        if sweep is not None:
+            # Megasweep progress: configs done vs planned + configs/s,
+            # so a long utility-analysis sweep is visible live and a
+            # stall names its blocked config batch.
+            hb["sweep"] = sweep
         if stalled:
             hb["stall"] = {"stalled_for_s": round(stalled_for, 3),
                            "deadline_s": self.stall_s,

@@ -148,6 +148,22 @@ REGISTRY: Tuple[KnobSpec, ...] = (
         "is bit-identical integer arithmetic (PARITY row 39); an "
         "out-of-envelope pin falls back to the envelope's choice."),
     KnobSpec(
+        "sweep_config_batch", "configs per compiled sweep chunk "
+        "(0 = widest in-HBM-budget)", 0,
+        "PIPELINEDP_TPU_SWEEP_CONFIG_BATCH",
+        ("pipelinedp_tpu.analysis.jax_sweep", "_SWEEP_CONFIG_BATCH"),
+        True, int,
+        "Pins the configuration-axis batch width of the utility-analysis "
+        "megasweep (analysis/jax_sweep.py): every sweep chunk dispatches "
+        "this many configs through ONE warm compiled program whose "
+        "bounds / eps-splits / selection tables / noise kinds are "
+        "runtime inputs. 0 lets the driver pick the widest chunk inside "
+        "the HBM row-broadcast and selection-window budgets. dp-safe: "
+        "every batch width is bit-identical per config (PARITY row 41 — "
+        "padding-invariant, walked == batched), so --autotune may sweep "
+        "it. Note the sweep checkpoint fingerprint covers the width: a "
+        "resume must run the same batch width it was killed at."),
+    KnobSpec(
         "vector_accumulator", "f32 | fx", "f32",
         "PIPELINEDP_TPU_VECTOR_ACCUMULATOR",
         ("pipelinedp_tpu.jax_engine", "_VECTOR_ACCUMULATOR"),

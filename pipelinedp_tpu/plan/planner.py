@@ -306,7 +306,7 @@ def resolve(shape: Optional[Dict[str, int]] = None, mesh=None,
         m = _plan_model(plan)
         dk = plan.get("device_kind")
         preds = {}
-        for phase in ("pass_a", "pass_b", "walk"):
+        for phase in ("pass_a", "pass_b", "walk", "sweep"):
             p = m.predict_seconds(dk, phase, shape.get("rows", 0),
                                   shape.get("partitions", 1),
                                   shape.get("quantiles", 0))
@@ -429,6 +429,14 @@ def autotune_candidates() -> list:
             # scalar trials measure the default's no-op.
             {"segsum_wide_d_block": 256},
             {"segsum_wide_d_block": 128},
+            # Megasweep config-batch widths: dp-safe (every width is
+            # bit-identical per config, PARITY row 41); only the
+            # utility-analysis sweep phase reads them, so scalar
+            # trials measure the default's no-op. bench.run_autotune's
+            # sweep_probe dispatches a small megasweep per trial so
+            # the argmin is a measured walked-vs-batched comparison.
+            {"sweep_config_batch": 64},
+            {"sweep_config_batch": 256},
             # The sketch binner's scatter reference: dp-safe (PARITY
             # row 36) so it sweeps with the rest. Every autotune trial
             # dispatches a small sketch-first request with its

@@ -53,6 +53,7 @@ SCENARIO_NAMES = (
     "serve_kill",       # fail_serve_requests: reserve survives restart
     "sketch_kill",      # fail_sketch_chunks: sketch-first drain proof
     "torn_ledger",      # torn run-ledger tail: fsck repairs it
+    "sweep_kill",       # fail_sweep_config_chunks: megasweep resume
 )
 
 
@@ -105,6 +106,31 @@ class _Fixtures:
                 partition_keys=np.char.add("key/", raw.astype("U6")),
                 values=rng.uniform(0.0, 10.0, n))
         return self._ds["sketch"]
+
+    def sweep_ds(self):
+        import numpy as np
+        import pipelinedp_tpu as pdp
+        if "sweep" not in self._ds:
+            # lint: disable=rng-purity(chaos fixture data synthesis, seeded, never a DP draw)
+            rng = np.random.default_rng(31)
+            n = 8_000
+            self._ds["sweep"] = pdp.ArrayDataset(
+                privacy_ids=rng.integers(0, 600, n),
+                partition_keys=rng.integers(0, 40, n),
+                values=rng.uniform(0.0, 10.0, n))
+        return self._ds["sweep"]
+
+    def sweep_baseline(self) -> List[Dict[str, Any]]:
+        """Per-config metric dicts of one uninterrupted megasweep (no
+        fault plan, no checkpoint) — the bit-parity oracle for the
+        ``sweep_kill`` scenario's resumed grid."""
+        key = ("sweep", -1)
+        if key not in self._baselines:
+            from pipelinedp_tpu.resilience import faults
+            _check(faults.active() is None,
+                   "sweep baseline computed under an active fault plan")
+            self._baselines[key], _ = run_megasweep(self)
+        return self._baselines[key]
 
     def params(self, workload: str):
         import pipelinedp_tpu as pdp
@@ -165,6 +191,36 @@ def run_streamed(ds, params, seed=21, eps=5.0, delta=1e-6, public=None,
     _check(res.timings.get("stream_batches", 0) > 1,
            "dataset did not stream — the kill seam was not exercised")
     return got, res.timings
+
+
+def run_megasweep(fx: "_Fixtures", checkpoint: Optional[str] = None):
+    """One config-batched utility-analysis megasweep through the public
+    entry point: a fixed 12-config grid at ``sweep_config_batch=4`` (3
+    config chunks, so every kill index lands between batches). Returns
+    ``([per-config count-metric dicts], LazySweepResult)``."""
+    import dataclasses
+
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu import analysis, plan as plan_mod
+    from pipelinedp_tpu.analysis import data_structures
+    from pipelinedp_tpu.backends import JaxBackend
+    ds = fx.sweep_ds()
+    multi = data_structures.MultiParameterConfiguration(
+        max_partitions_contributed=list(range(1, 13)),
+        max_contributions_per_partition=[1, 2] * 6)
+    options = analysis.UtilityAnalysisOptions(
+        epsilon=1.0, delta=1e-6,
+        aggregate_params=pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT],
+            max_partitions_contributed=4,
+            max_contributions_per_partition=2),
+        multi_param_configuration=multi)
+    with plan_mod.seam_override("sweep_config_batch", 4):
+        res = analysis.perform_utility_analysis(
+            ds, JaxBackend(rng_seed=0, checkpoint=checkpoint),
+            options, pdp.DataExtractors())
+        out = list(res)[0]
+    return [dataclasses.asdict(m.count_metrics) for m in out], res
 
 
 def assert_bit_identical(got_a, got_b, context: str) -> None:
@@ -483,6 +539,43 @@ def _scenario_sketch_kill(rng: random.Random, fx: _Fixtures,
     _check(len(out) > 0, "post-kill sketch run released nothing")
 
 
+def _scenario_sweep_kill(rng: random.Random, fx: _Fixtures,
+                         tmp: str) -> None:
+    """Kill the utility-analysis megasweep between config batches
+    (``fail_sweep_config_chunks``); the ``.sweep`` sibling checkpoint
+    must resume ONLY the remaining config chunks, and the resumed grid
+    must be bit-identical to an uninterrupted batched run."""
+    import numpy as np
+
+    from pipelinedp_tpu.resilience import (CheckpointStore, FaultPlan,
+                                           injected_faults)
+    from pipelinedp_tpu.resilience.faults import ChunkFailure
+    kill_at = rng.randint(0, 2)
+    baseline = fx.sweep_baseline()
+    path = os.path.join(tmp, "ua.ckpt")
+    killed = False
+    with injected_faults(
+            FaultPlan(fail_sweep_config_chunks=(kill_at,))):
+        try:
+            run_megasweep(fx, checkpoint=path)
+        except ChunkFailure:
+            killed = True
+    _check(killed,
+           f"fail_sweep_config_chunks=({kill_at},) never fired")
+    resumed, res = run_megasweep(fx, checkpoint=path)
+    _check(res._resumed_from_chunk == kill_at,
+           f"sweep resumed from chunk {res._resumed_from_chunk}, "
+           f"expected {kill_at}")
+    for ci, (a, b) in enumerate(zip(resumed, baseline)):
+        for field in a:
+            _check(bool(np.array_equal(np.asarray(a[field]),
+                                       np.asarray(b[field]))),
+                   f"sweep_kill@{kill_at}: cfg{ci}.{field} differs "
+                   f"({a[field]!r} vs {b[field]!r})")
+    _check(not CheckpointStore(path + ".sweep").exists(),
+           "success did not clear the .sweep checkpoint")
+
+
 def _scenario_torn_ledger(rng: random.Random, fx: _Fixtures,
                           tmp: str) -> None:
     from pipelinedp_tpu.obs import store as obs_store
@@ -515,6 +608,7 @@ _SCENARIOS: Dict[str, Callable[[random.Random, _Fixtures, str], None]] = {
     "wedged_probe": _scenario_wedged_probe,
     "serve_kill": _scenario_serve_kill,
     "sketch_kill": _scenario_sketch_kill,
+    "sweep_kill": _scenario_sweep_kill,
     "torn_ledger": _scenario_torn_ledger,
 }
 
@@ -522,7 +616,7 @@ _SCENARIOS: Dict[str, Callable[[random.Random, _Fixtures, str], None]] = {
 #: hold/wedge scenarios record holds/wedges instead of raising).
 _EXPECT_INJECTED = {"stream_kill", "device_loss", "pass_b_kill",
                     "hold_wedge", "wedged_probe", "serve_kill",
-                    "sketch_kill"}
+                    "sketch_kill", "sweep_kill"}
 
 
 def schedule_for(seed: int, n_schedules: int) -> List[Dict[str, Any]]:

@@ -86,6 +86,11 @@ class FaultPlan:
     #: ``ChunkFailure`` (kills a sketch-first phase 1 mid-stream; the
     #: ingest stager must drain to zero orphan ``pdp-*`` threads).
     fail_sketch_chunks: Tuple[int, ...] = ()
+    #: utility-analysis megasweep config-chunk indices whose dispatch
+    #: raises ``ChunkFailure`` (kills a config-batched sweep mid-grid;
+    #: the ``.sweep`` chunk-prefix checkpoint must resume the remaining
+    #: configs bit-identically).
+    fail_sweep_config_chunks: Tuple[int, ...] = ()
     #: serve-request admission indices (0-based, in admission order)
     #: whose compute raises ``ServeKill`` mid-request — AFTER the
     #: durable budget reserve, BEFORE commit/release. The resident
@@ -128,6 +133,9 @@ class FaultPlan:
         if self.fail_sketch_chunks:
             parts.append("fail_sketch_chunks=" +
                          ":".join(str(c) for c in self.fail_sketch_chunks))
+        if self.fail_sweep_config_chunks:
+            parts.append("fail_sweep_config_chunks=" + ":".join(
+                str(c) for c in self.fail_sweep_config_chunks))
         if self.coordinator_timeouts:
             parts.append(f"coordinator_timeouts={self.coordinator_timeouts}")
         if self.fail_serve_requests:
@@ -152,8 +160,9 @@ def plan_from_env(spec: str) -> FaultPlan:
             continue
         k, _, v = item.partition("=")
         if k in ("fail_chunks", "fail_pass_b_chunks",
-                 "fail_sketch_chunks", "hold_fetch_batches",
-                 "fail_serve_requests", "lose_device_chunks"):
+                 "fail_sketch_chunks", "fail_sweep_config_chunks",
+                 "hold_fetch_batches", "fail_serve_requests",
+                 "lose_device_chunks"):
             kw[k] = tuple(int(c) for c in v.split(":") if c)
         elif k == "wedged_hold":
             kw[k] = bool(int(v))
@@ -295,6 +304,19 @@ def check_sketch_chunk(index: int) -> None:
         _record("sketch_chunk_failure", index=int(index))
         raise ChunkFailure(
             f"injected failure at sketch chunk {index}")
+
+
+def check_sweep_config_chunk(index: int) -> None:
+    """Raise :class:`ChunkFailure` when the active plan kills the
+    utility-analysis megasweep at config chunk ``index`` — the kill
+    lands between the ``.sweep`` checkpoint of the completed-chunk
+    prefix and the next config batch's dispatch, so a resume must
+    replay only the remaining configs, bit-identically."""
+    plan = active()
+    if plan is not None and index in plan.fail_sweep_config_chunks:
+        _record("sweep_config_chunk_failure", index=int(index))
+        raise ChunkFailure(
+            f"injected failure at sweep config chunk {index}")
 
 
 def check_device_loss() -> None:

@@ -39,7 +39,7 @@ import threading
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
-from pipelinedp_tpu.aggregate_params import AggregateParams
+from pipelinedp_tpu.aggregate_params import AggregateParams, Metrics
 from pipelinedp_tpu.budget_accounting import (Budget,
                                               NaiveBudgetAccountant)
 from pipelinedp_tpu.dp_engine import DataExtractors, DPEngine
@@ -85,7 +85,18 @@ class ServeRequest:
     durable ledger — they become the per-request accountant's totals,
     so the ledger's debit and the accountant's distribution agree
     exactly. ``rng_seed`` fixes the noise stream (tests, replayable
-    pipelines); None draws fresh noise per request."""
+    pipelines); None draws fresh noise per request.
+
+    ``kind="tune"`` asks the utility-analysis megasweep which (bounds,
+    budget split, selection strategy) would minimize expected error at
+    the given (epsilon, delta) — BEFORE spending them. A tune request
+    is admitted, quota'd, books-stamped and refused exactly like an
+    aggregate, but debits ZERO (ε, δ) from the tenant's ledger:
+    utility analysis releases error ESTIMATES of hypothetical
+    mechanisms, never private data (the reference's analysis engine
+    makes the same argument). ``tune_parameters`` optionally carries a
+    ``parameter_tuning.ParametersToTune``; None tunes the bounds the
+    single analyzed metric supports."""
     tenant: str
     params: AggregateParams
     dataset: Any
@@ -95,6 +106,8 @@ class ServeRequest:
     public_partitions: Any = None
     rng_seed: Optional[int] = None
     request_id: Optional[str] = None
+    kind: str = "aggregate"
+    tune_parameters: Any = None
 
 
 @dataclasses.dataclass
@@ -155,6 +168,10 @@ def params_signature(request: ServeRequest) -> str:
         repr((ext is not None and ext.privacy_id_extractor is not None,
               ext is not None and ext.partition_extractor is not None,
               ext is not None and ext.value_extractor is not None)),
+        # The request kind: a tune and an aggregate at the same params
+        # run DIFFERENT programs (the megasweep vs the engine), so
+        # they must never share a warm slot.
+        request.kind,
     ))
     return hashlib.sha256(basis.encode("utf-8")).hexdigest()[:16]
 
@@ -454,6 +471,14 @@ class Service:
         if not (isinstance(request.delta, (int, float))
                 and request.delta >= 0):
             return f"delta must be >= 0, got {request.delta!r}"
+        if request.kind not in ("aggregate", "tune"):
+            return ("kind must be 'aggregate' or 'tune', got "
+                    f"{request.kind!r}")
+        if request.kind == "tune":
+            metrics_list = list(request.params.metrics or [])
+            if len(metrics_list) != 1:
+                return ("tune requests analyze exactly one metric, got "
+                        f"{[str(m) for m in metrics_list]!r}")
         return None
 
     def submit(self, request: ServeRequest):
@@ -559,6 +584,17 @@ class Service:
                             tenant, []).append(self._clock.monotonic())
         if verdict is not None:
             return self._refuse(rid, tenant, *verdict)
+        if request.kind == "tune":
+            # Utility analysis releases no private data — the request's
+            # (epsilon, delta) are the HYPOTHETICAL budget the error
+            # model simulates, not a demand on the ledger. A synthetic
+            # zero-amount lease (state="tune", never written to disk)
+            # rides the same pending plumbing; _release_lease no-ops on
+            # it and the worker routes it through _execute_tune /
+            # _respond_tune, leaving the durable ledger untouched.
+            lease = BudgetLease(tenant=tenant, request_id=rid,
+                                epsilon=0.0, delta=0.0, state="tune")
+            return self._enqueue_admitted(request, lease, rid, tenant)
         try:
             lease = self.budgets.reserve(tenant, rid, request.epsilon,
                                          request.delta)
@@ -583,10 +619,24 @@ class Service:
         except BaseException:
             self._rollback_admission(tenant, rid)
             raise
+        return self._enqueue_admitted(request, lease, rid, tenant)
+
+    def _enqueue_admitted(self, request: ServeRequest,
+                          lease: BudgetLease, rid: str, tenant: str):
+        """The post-reserve half of ``submit``: register with the
+        monitor, route through fusion (aggregate kind only) or the solo
+        queue, block for the outcome. Shared by aggregates (durable
+        lease) and tunes (synthetic zero-debit lease)."""
+        from pipelinedp_tpu import obs
+        from pipelinedp_tpu.obs import monitor as obs_monitor
+        full_detail = (f"request queue is full ({self.max_queue} "
+                       "deep); back off and resubmit")
+        verdict: Optional[Tuple[str, str]] = None
         # Register BEFORE the enqueue: the worker's update/unregister
         # must always follow the registration, or a fast completion
         # would leave a phantom live request in every later heartbeat.
-        obs_monitor.register_request(rid, tenant=tenant, phase="queued")
+        obs_monitor.register_request(rid, tenant=tenant, phase="queued",
+                                     kind=request.kind)
         routed = False
         with self._admit:
             if self._closed.is_set():  # raced close()
@@ -595,13 +645,15 @@ class Service:
             else:
                 pending = _Pending(request, lease, self._seq)
                 self._seq += 1
-        if verdict is None and self._fuser is not None:
+        if (verdict is None and self._fuser is not None
+                and request.kind == "aggregate"):
             # The fusion layer sits between admission and the workers:
             # a fusable request joins its shape bucket here (the
             # host-side encode runs on THIS caller's thread, so it
             # parallelizes across tenants); everything else falls
             # through to the solo queue, including anything offered
-            # while the fuser is closing.
+            # while the fuser is closing. Tune requests never fuse —
+            # the megasweep is its own batched program.
             try:
                 routed = self._fuser.offer(pending)
             except Exception:
@@ -674,8 +726,10 @@ class Service:
         from ``_live``: released first, a same-id retry arriving in
         between sees a 'released' debit and reserves fresh; removed
         first, the retry would dedup onto the still-'reserved' debit
-        as a replayed lease whose budget this refund then yanks away."""
-        if lease.replayed:
+        as a replayed lease whose budget this refund then yanks away.
+        Tune leases are synthetic (zero amounts, never on disk):
+        nothing to refund."""
+        if lease.replayed or lease.state == "tune":
             return
         from pipelinedp_tpu import obs
         try:
@@ -784,6 +838,9 @@ class Service:
         signature = params_signature(request)
         obs_monitor.update_request(rid, phase="running",
                                    signature=signature)
+        if request.kind == "tune":
+            self._execute_tune(pending, signature)
+            return
         try:
             # The injected hard-kill seam: between the durable reserve
             # and any commit/release — a FaultInjected here models the
@@ -901,6 +958,142 @@ class Service:
         obs_monitor.unregister_request(rid)
         pending.finish("response", ServeResponse(
             request_id=rid, tenant=tenant, results=results,
+            remaining=remaining, warm=warm, signature=signature,
+            wall_s=wall_s, audit=audit_record))
+
+    def _execute_tune(self, pending: "_Pending", signature: str) -> None:
+        """Serve one ``kind="tune"`` request: contribution histograms +
+        the utility-analysis megasweep + argmin over the batched error
+        surface, on the warm (tenant, signature) backend. The sweep
+        releases error estimates of hypothetical mechanisms, never
+        private data, so the synthetic lease debits zero (ε, δ) — but
+        the request is still books-stamped like any other. A second
+        same-signature tune reuses the warm backend and the
+        module-level jitted sweep kernels: zero new compile.program
+        captures."""
+        from pipelinedp_tpu import obs
+        from pipelinedp_tpu.obs import monitor as obs_monitor
+        from pipelinedp_tpu.resilience import faults
+        request, lease = pending.request, pending.lease
+        rid, tenant = lease.request_id, lease.tenant
+        try:
+            # Same hard-kill seam as aggregate execution; with no
+            # reserve outstanding there is nothing durable to protect,
+            # but the caller must still see the crash.
+            faults.check_serve_request(pending.seq)
+            entry, warm = self._warm_entry(request, signature)
+            obs.inc("serve.warm_hits" if warm else "serve.cold_builds")
+            with entry.lock:
+                from pipelinedp_tpu.analysis import jax_sweep
+                from pipelinedp_tpu.analysis import parameter_tuning
+                extractors = (request.data_extractors
+                              if request.data_extractors is not None
+                              else DataExtractors())
+                to_tune = request.tune_parameters
+                if to_tune is None:
+                    metric = request.params.metrics[0]
+                    to_tune = parameter_tuning.ParametersToTune(
+                        max_partitions_contributed=True,
+                        max_contributions_per_partition=(
+                            metric == Metrics.COUNT))
+                tune_options = parameter_tuning.TuneOptions(
+                    epsilon=float(request.epsilon),
+                    delta=float(request.delta),
+                    aggregate_params=request.params,
+                    function_to_minimize=(
+                        parameter_tuning.MinimizingFunction
+                        .ABSOLUTE_ERROR),
+                    parameters_to_tune=to_tune)
+                with self._tr.span("serve.request", cat="serve",
+                                   tenant=tenant, warm=warm,
+                                   kind="tune") as sp:
+                    hist = list(jax_sweep.fused_dataset_histograms(
+                        request.dataset, extractors))[0]
+                    tuned = parameter_tuning.tune(
+                        request.dataset, entry.backend, hist,
+                        tune_options, extractors,
+                        request.public_partitions)
+                    tune_result = list(tuned)[0]
+        except faults.FaultInjected as e:
+            # Hard kill mid-tune: no reserve to preserve (tune debits
+            # nothing), but the warm slot is dropped and the caller
+            # sees the crash, mirroring the aggregate path.
+            self._drop_entry(request, signature)
+            obs.inc("serve.requests_killed")
+            obs.event("serve.request_killed", request_id=rid,
+                      tenant=tenant, error=repr(e))
+            obs_monitor.unregister_request(rid)
+            pending.finish("raise", e)
+            return
+        except Exception as e:
+            self._drop_entry(request, signature)
+            self._release_lease(lease)  # no-op for a tune lease
+            obs_monitor.unregister_request(rid)
+            pending.finish("refusal", self._refuse(
+                rid, tenant, "error",
+                f"{type(e).__name__}: {e}"))
+            return
+        self._respond_tune(pending, tune_result, warm, signature,
+                           sp.duration)
+
+    def _respond_tune(self, pending: "_Pending", tune_result, warm: bool,
+                      signature: str, wall_s: float) -> None:
+        """The tune twin of ``_commit_and_respond``: there is no
+        durable debit to commit — the lease was synthesized with zero
+        (ε, δ) and never reserved — so the tail only stamps the books
+        (with ``kind="tune"`` and ``budget_debited=False``) and hands
+        the TuneResult back. ``remaining`` is read purely to show the
+        caller their balance is untouched."""
+        from pipelinedp_tpu import obs
+        from pipelinedp_tpu.obs import monitor as obs_monitor
+        lease = pending.lease
+        rid, tenant = lease.request_id, lease.tenant
+        try:
+            remaining = self.budgets.remaining(tenant)
+        except Exception as e:
+            obs.event("serve.commit_failed", request_id=rid,
+                      tenant=tenant, error=repr(e))
+            obs_monitor.unregister_request(rid)
+            pending.finish("raise", e)
+            return
+        cfg = tune_result.utility_analysis_parameters
+        best: Dict[str, Any] = {}
+        if cfg.max_partitions_contributed is not None:
+            best["max_partitions_contributed"] = int(
+                cfg.max_partitions_contributed[tune_result.index_best])
+        if cfg.max_contributions_per_partition is not None:
+            best["max_contributions_per_partition"] = int(
+                cfg.max_contributions_per_partition[
+                    tune_result.index_best])
+        audit_record = {
+            "kind": "tune",
+            "budget_debited": False,
+            "simulated_epsilon": float(pending.request.epsilon),
+            "simulated_delta": float(pending.request.delta),
+            "candidates": int(cfg.size),
+            "index_best": int(tune_result.index_best),
+            "best": best,
+        }
+        books = {
+            "request_id": rid,
+            "signature": signature,
+            "kind": "tune",
+            "warm": warm,
+            "wall_s": round(wall_s, 6),
+            "candidates": int(cfg.size),
+            "epsilon": 0.0,
+            "delta": 0.0,
+            "remaining_epsilon": remaining.epsilon,
+            "remaining_delta": remaining.delta,
+            "audit": audit_record,
+        }
+        self._append_books(tenant, "serve.request", books)
+        obs.inc("serve.requests_served")
+        obs.inc("serve.tunes_served")
+        obs_monitor.unregister_request(rid)
+        pending.finish("response", ServeResponse(
+            request_id=rid, tenant=tenant,
+            results=[("tune", tune_result)],
             remaining=remaining, warm=warm, signature=signature,
             wall_s=wall_s, audit=audit_record))
 

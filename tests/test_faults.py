@@ -264,6 +264,100 @@ class TestCheckpointResumeBitParity:
         assert_bit_identical(baseline, resumed)
 
 
+class TestMegasweepKillResume:
+    """Kill the utility-analysis megasweep between config batches via
+    ``FaultPlan.fail_sweep_config_chunks``, resume from the ``.sweep``
+    sibling checkpoint, and assert the resumed grid is BIT-IDENTICAL to
+    an uninterrupted batched run — with zero orphan threads left behind
+    (ISSUE-18 acceptance)."""
+
+    GRID = 12
+    BATCH = 4  # 12 configs / 4 per batch = 3 sweep chunks
+
+    @staticmethod
+    def _run_sweep(checkpoint=None):
+        import dataclasses
+
+        from pipelinedp_tpu import analysis, plan as plan_mod
+        from pipelinedp_tpu.analysis import data_structures
+        rng = np.random.default_rng(31)
+        n = 8_000
+        ds = pdp.ArrayDataset(
+            privacy_ids=rng.integers(0, 600, n),
+            partition_keys=rng.integers(0, 40, n),
+            values=rng.uniform(0, 10, n))
+        multi = data_structures.MultiParameterConfiguration(
+            max_partitions_contributed=list(range(1, 13)),
+            max_contributions_per_partition=[1, 2] * 6)
+        options = analysis.UtilityAnalysisOptions(
+            epsilon=1.0, delta=1e-6,
+            aggregate_params=pdp.AggregateParams(
+                metrics=[pdp.Metrics.COUNT],
+                max_partitions_contributed=4,
+                max_contributions_per_partition=2),
+            multi_param_configuration=multi)
+        with plan_mod.seam_override("sweep_config_batch",
+                                    TestMegasweepKillResume.BATCH):
+            res = analysis.perform_utility_analysis(
+                ds, JaxBackend(rng_seed=0, checkpoint=checkpoint),
+                options, pdp.DataExtractors())
+            out = list(res)[0]
+        assert len(out) == TestMegasweepKillResume.GRID
+        metrics = [dataclasses.asdict(m.count_metrics) for m in out]
+        return metrics, res
+
+    @staticmethod
+    def _assert_configs_bit_identical(got, ref):
+        for ci, (a, b) in enumerate(zip(got, ref)):
+            for field in a:
+                np.testing.assert_array_equal(
+                    np.asarray(a[field]), np.asarray(b[field]),
+                    err_msg=f"cfg{ci}.{field}")
+
+    def test_killed_megasweep_resumes_bit_identical(self, tmp_path):
+        import threading
+
+        # Ground truth: one uninterrupted batched run, no checkpoint.
+        baseline, _ = self._run_sweep()
+
+        # Kill at config chunk 2: chunks 0-1 (8 configs) are already in
+        # the ``.sweep`` sibling checkpoint; chunk 2 never dispatched.
+        path = str(tmp_path / "ua.ckpt")
+        sweep_store = CheckpointStore(path + ".sweep")
+        with injected_faults(FaultPlan(fail_sweep_config_chunks=(2,))):
+            with pytest.raises(ChunkFailure):
+                self._run_sweep(checkpoint=path)
+        assert sweep_store.exists(), (
+            "no .sweep checkpoint survived the kill")
+        orphans = [t.name for t in threading.enumerate()
+                   if t.name.startswith("pdp-") and t.is_alive()]
+        assert not orphans, f"killed sweep left orphans: {orphans}"
+
+        # Resume: replays only the remaining chunk, bit-identically.
+        resumed, res = self._run_sweep(checkpoint=path)
+        assert res._resumed_from_chunk == 2
+        self._assert_configs_bit_identical(resumed, baseline)
+        # Completion cleared the sweep checkpoint — a finished grid
+        # cannot be accidentally resumed.
+        assert not sweep_store.exists()
+        orphans = [t.name for t in threading.enumerate()
+                   if t.name.startswith("pdp-") and t.is_alive()]
+        assert not orphans, f"resumed sweep left orphans: {orphans}"
+
+    def test_kill_on_first_config_chunk_resumes_from_scratch(
+            self, tmp_path):
+        baseline, _ = self._run_sweep()
+        path = str(tmp_path / "ua0.ckpt")
+        with injected_faults(FaultPlan(fail_sweep_config_chunks=(0,))):
+            with pytest.raises(ChunkFailure):
+                self._run_sweep(checkpoint=path)
+        # Nothing was checkpointed — the resume IS a fresh run.
+        assert not CheckpointStore(path + ".sweep").exists()
+        resumed, res = self._run_sweep(checkpoint=path)
+        assert res._resumed_from_chunk == 0
+        self._assert_configs_bit_identical(resumed, baseline)
+
+
 class TestElasticMeshRecovery:
     """Device loss mid-stream is a RECOVERABLE event: the elastic
     wrapper re-forms the mesh from the survivors, resumes from the

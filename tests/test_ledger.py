@@ -566,6 +566,51 @@ class TestBenchCompareAcceptance:
         assert reg2["rates"][0].get("regressed") is True
         assert reg2["regressed"] == ["v"]
 
+    def test_sweep_config_batch_mismatch_refuses_gate(self,
+                                                      monkeypatch):
+        """A width-256 ``configs/s`` rate never gates against a
+        width-16 baseline (the kernel-backend / vector-accumulator
+        refusals' megasweep twin): ceil(K/width) dispatches per grid
+        are different dispatch regimes, so only matching widths
+        compare — the mismatch is recorded, counted and named in the
+        verdict line, while a matching-width pair still gates."""
+        bench = _import_bench(monkeypatch)
+        bench.reset_run_state()
+        env = bench.env_fingerprint()
+        store = obs_store.LedgerStore(obs_store.ledger_dir())
+        store.append("utility_megasweep_configs_per_sec", {"record": {
+            "metric": "utility_megasweep_configs_per_sec",
+            "value": 400, "unit": "configs/s",
+            "sweep_config_batch": 16}}, env=env)
+        bench.reset_run_state()
+        reg = bench.compare_to_baseline(records=[
+            {"metric": "utility_megasweep_configs_per_sec",
+             "value": 200, "unit": "configs/s",
+             "plan_source": "default", "kernel_backend": "xla",
+             "sweep_config_batch": 256}])
+        rate = reg["rates"][0]
+        assert rate.get("sweep_config_batch_mismatch") is True
+        assert rate["baseline_sweep_config_batch"] == 16
+        assert reg["regressed"] == []
+        assert reg["sweep_config_batch_mismatches"] == 1
+        assert "sweep-config-batch mismatch" in \
+            bench.compare_verdict_line(reg)
+        events = [e for e in obs.ledger().snapshot()["events"]
+                  if e["name"] ==
+                  "bench.compare_sweep_config_batch_mismatch"]
+        assert events and events[0][
+            "metric"] == "utility_megasweep_configs_per_sec"
+        # Matching widths on both sides gate exactly like any rate:
+        # a >10% drop is a regression.
+        reg2 = bench.compare_to_baseline(records=[
+            {"metric": "utility_megasweep_configs_per_sec",
+             "value": 200, "unit": "configs/s",
+             "plan_source": "default", "kernel_backend": "xla",
+             "sweep_config_batch": 16}])
+        assert reg2["rates"][0].get("regressed") is True
+        assert reg2["regressed"] == [
+            "utility_megasweep_configs_per_sec"]
+
 
 class TestNoAdHocArtifactWrites:
     """AST-precise twin of ``make noartifacts``: ``json.dump(`` file

@@ -410,6 +410,36 @@ class TestRuleShapes:
         assert "fsync() inside a held lock body" in by_msg[0]
         assert "nested lock" in by_msg[1]
 
+    def test_jit_staticness_megasweep_config_constants(self):
+        """ISSUE-18's batched-sweep contract, as a lint fixture pair:
+        config values (bounds, eps-splits, noise tables) must arrive as
+        RUNTIME inputs to the jitted sweep kernels — a module-level
+        config table read inside the traced body bakes the grid into
+        the compiled program, and every new config batch recompiles."""
+        bad = ("from pipelinedp_tpu.obs.costs import instrumented_jit\n"
+               "from pipelinedp_tpu.plan import knobs as _knobs\n\n\n"
+               "def _sweep_kernel(stats, noise_std):\n"
+               "    width = _knobs.value('sweep_config_batch')\n"
+               "    return stats * width + noise_std\n\n\n"
+               "program = instrumented_jit(_sweep_kernel, "
+               "phase='sweep')\n")
+        found = findings_for("jit-staticness", bad,
+                             "pipelinedp_tpu/analysis/jax_sweep.py")
+        assert len(found) == 1
+        assert "knobs.value" in found[0].message
+        # Clean twin: the same kernel with the config axis as data —
+        # one compiled program serves every config batch.
+        clean = ("from pipelinedp_tpu.obs.costs import "
+                 "instrumented_jit\n\n\n"
+                 "def _sweep_kernel(stats, bounds_hi, noise_std):\n"
+                 "    clipped = stats * bounds_hi\n"
+                 "    return clipped + noise_std\n\n\n"
+                 "program = instrumented_jit(_sweep_kernel, "
+                 "phase='sweep')\n")
+        assert findings_for(
+            "jit-staticness", clean,
+            "pipelinedp_tpu/analysis/jax_sweep.py") == []
+
     def test_jit_staticness_time_read(self):
         src = ("import time\n"
                "import jax\n\n\n"

@@ -68,6 +68,7 @@ HARDCODED_DEFAULTS = {
     "q_chunk": 0,
     "kernel_backend": "xla",
     "segsum_wide_d_block": 0,
+    "sweep_config_batch": 0,
     "vector_accumulator": "f32",
     "serve_fusion": False,
     "serve_fuse_window_ms": 8,

@@ -876,6 +876,116 @@ class TestBooksAndHeartbeat:
 
 
 # ---------------------------------------------------------------------
+# tune requests: the utility-analysis megasweep behind the serve door
+# ---------------------------------------------------------------------
+
+
+def tune_request(tenant, ds, eps=1.0, delta=1e-8, rid=None, parts=6):
+    params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                 max_partitions_contributed=parts,
+                                 max_contributions_per_partition=4)
+    return serve.ServeRequest(tenant=tenant, params=params, dataset=ds,
+                              epsilon=eps, delta=delta, rng_seed=7,
+                              request_id=rid, kind="tune")
+
+
+class TestTuneRequests:
+    """``kind="tune"`` serve requests: admitted through the same
+    admission control as aggregates (quota'd, structurally refused,
+    books-stamped) but debiting ZERO (ε, δ) — utility analysis releases
+    error estimates of hypothetical mechanisms, never private data."""
+
+    def test_tune_served_zero_budget_debited_books_stamped(
+            self, tmp_path):
+        ds = make_ds(n=2_000, parts=6)
+        with serve.Service(str(tmp_path / "svc"),
+                           tenants={"t": (5.0, 1e-6)}) as svc:
+            out = svc.submit(tune_request("t", ds, eps=1.0, rid="tu1"))
+            assert out.ok, out
+            assert out.audit["kind"] == "tune"
+            assert out.audit["budget_debited"] is False
+            assert out.audit["candidates"] > 1
+            assert "max_partitions_contributed" in out.audit["best"]
+            (label, tune_result), = out.results
+            assert label == "tune"
+            assert tune_result.index_best == out.audit["index_best"]
+            # The balance is untouched — in the response AND on disk.
+            assert out.remaining.epsilon == pytest.approx(5.0)
+            assert svc.budgets.remaining("t").epsilon == pytest.approx(
+                5.0)
+            assert svc.budgets.remaining("t").delta == pytest.approx(
+                1e-6)
+            # Books: stamped like any request, with kind="tune" and
+            # zero (eps, delta).
+            path = os.path.join(svc.books_dir("t"), "run_ledger.jsonl")
+            entries = [json.loads(line) for line in
+                       open(path, encoding="utf-8")]
+            served = [e for e in entries if e["name"] == "serve.request"]
+            assert len(served) == 1
+            book = served[0]["payload"]["serve"]
+            assert book["kind"] == "tune"
+            assert book["epsilon"] == 0.0 and book["delta"] == 0.0
+            assert book["audit"]["budget_debited"] is False
+            assert book["audit"]["simulated_epsilon"] == 1.0
+
+    def test_tune_second_same_signature_warm_zero_new_compiles(
+            self, tmp_path, monkeypatch):
+        """The second same-signature tune is a warm registry hit and —
+        with the cost observatory watching — captures zero new
+        ``compile.program`` spans (one compiled megasweep serves every
+        config batch)."""
+        monkeypatch.setenv("PIPELINEDP_TPU_COSTS", "1")
+        ds = make_ds(n=2_000, parts=6)
+        with serve.Service(str(tmp_path / "svc"),
+                           tenants={"t": (5.0, 1e-6)}) as svc:
+            ds.invalidate_cache()
+            first = svc.submit(tune_request("t", ds, rid="tu-a"))
+            assert first.ok and first.warm is False
+            captured = obs.ledger().snapshot()["counters"].get(
+                "cost.programs_captured", 0)
+            ds.invalidate_cache()
+            second = svc.submit(tune_request("t", ds, rid="tu-b"))
+            assert second.ok and second.warm is True
+            assert second.audit["index_best"] == first.audit[
+                "index_best"]
+            after = obs.ledger().snapshot()["counters"].get(
+                "cost.programs_captured", 0)
+            assert after == captured, (
+                "second same-signature tune captured new "
+                "compile.program spans")
+
+    def test_tune_refusals_structural_and_free(self, tmp_path):
+        ds = make_ds(n=2_000, parts=6)
+        with serve.Service(str(tmp_path / "svc")) as svc:
+            svc.register_tenant("t", 5.0, 1e-6,
+                                max_rows_per_request=100)
+            # Unknown kinds are malformed before any compute.
+            bogus = svc.submit(serve.ServeRequest(
+                tenant="t", params=count_params(), dataset=ds,
+                epsilon=1.0, kind="optimize"))
+            assert not bogus.ok and bogus.reason == "malformed"
+            assert "kind" in bogus.detail
+            # Tune analyzes exactly one metric.
+            multi = tune_request("t", ds)
+            multi.params = count_params()  # COUNT + SUM
+            multi.kind = "tune"
+            out = svc.submit(multi)
+            assert not out.ok and out.reason == "malformed"
+            assert "one metric" in out.detail
+            # Unknown tenants never grow state, tune or not.
+            ghost = svc.submit(tune_request("ghost", ds))
+            assert ghost.reason == "malformed"
+            assert not os.path.exists(svc.books_dir("ghost"))
+            # Tunes ride the same per-tenant row quota.
+            quota = svc.submit(tune_request("t", ds))
+            assert not quota.ok and quota.reason == "quota"
+            assert "row quota" in quota.detail
+            # None of it burned budget.
+            assert svc.budgets.remaining("t").epsilon == pytest.approx(
+                5.0)
+
+
+# ---------------------------------------------------------------------
 # the noserve lint, AST-precise (twin of ``make noserve``)
 # ---------------------------------------------------------------------
 
