@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast bench smoke multichip lint lintcheck dev clean faultcheck chaoscheck nosleep perfcheck nofoldin obscheck noperf nostager ledgercheck noartifacts watchcheck costcheck nocost plancheck noknobs kernelcheck nopallas servecheck noserve fusecheck fusionmask sketchcheck nosketchhash veccheck sweepcheck
+.PHONY: test test-fast bench smoke multichip lint lintcheck dev clean faultcheck chaoscheck nosleep perfcheck nofoldin obscheck noperf nostager ledgercheck noartifacts watchcheck costcheck nocost plancheck noknobs kernelcheck nopallas servecheck noserve fusecheck fusionmask sketchcheck nosketchhash veccheck sweepcheck metricscheck
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -143,10 +143,22 @@ nosketchhash:
 # overlapped-ingest run, no-op-mode zero emission, bench-field parity
 # (names/semantics unchanged, DP outputs bit-identical trace on/off),
 # Chrome-trace round-trip, run-report schema, resilience/fault event
-# coverage — plus the no-raw-perf-counter and no-ad-hoc-artifact lints.
-obscheck:
+# coverage — plus the no-raw-perf-counter and no-ad-hoc-artifact lints
+# and the metrics-plane suite (metricscheck).
+obscheck: metricscheck
 	$(PYTHON) -m pipelinedp_tpu.lint --rule noperf --rule noartifacts
 	$(PYTHON) -m pytest tests/test_obs.py -q
+
+# Metrics-plane + wire-surface acceptance suite: request-scoped trace
+# propagation across the serve thread handoffs (fused batches included,
+# concurrent tenants isolated), histogram bucket-boundary exactness,
+# the Prometheus exposition round-trip through a LIVE /metrics scrape,
+# endpoint lifecycle (off-by-default zero threads, clean drain under
+# ServeKill), and the trace-context on/off DP bit-parity — plus the
+# socket-confinement lint (wire machinery confined to obs/http.py).
+metricscheck:
+	$(PYTHON) -m pipelinedp_tpu.lint --rule socket-confinement
+	$(PYTHON) -m pytest tests/test_metrics.py -q
 
 # Audit-record + run-ledger acceptance suite: schema-v2 privacy section
 # (per-mechanism eps/delta + noise stddevs, selection pre/post counts,

@@ -1415,6 +1415,144 @@ def bench_serve_fused_throughput(n_rows, smoke=False):
     return rec
 
 
+def bench_obs_overhead(n_rows, smoke=False):
+    """``obs_overhead`` record: the SAME multi-tenant serve burst run
+    twice in one process — once with the full observability plane
+    armed (request-context tracing via ``PIPELINEDP_TPU_TRACE`` + the
+    metrics registry + a LIVE ``/metrics`` endpoint scraped mid-run)
+    and once with all of it off — with a same-seed bit-parity
+    cross-check between the modes (the trace-context on/off PARITY
+    row). The headline value is the INSTRUMENTED requests/s (unit
+    ``req/s`` so ``--compare`` gates a regression in the traced path);
+    the record carries the dark rate and the overhead fraction, which
+    is the cost-of-observability claim made measurable."""
+    import shutil
+    import tempfile
+    import urllib.request
+
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu import serve
+    from pipelinedp_tpu.ingest.executor import _CaptureThread
+
+    n_conc = 4
+    rounds = 2 if smoke else 3
+    parts = 200 if smoke else 1_000
+    rng = np.random.default_rng(29)
+    ds = pdp.ArrayDataset(
+        privacy_ids=rng.integers(0, max(n_rows // 8, 1_000), n_rows),
+        partition_keys=(rng.zipf(1.3, n_rows) % parts).astype(np.int64),
+        values=rng.uniform(0.0, 10.0, n_rows))
+    params = pdp.AggregateParams(
+        metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+        noise_kind=pdp.NoiseKind.LAPLACE,
+        max_partitions_contributed=4, max_contributions_per_partition=2,
+        min_value=0.0, max_value=10.0)
+    tenants = {f"bench-t{i}": (1e6, 1e-3) for i in range(2)}
+
+    def req(i, seed):
+        payload = pdp.ArrayDataset(privacy_ids=ds.privacy_ids,
+                                   partition_keys=ds.partition_keys,
+                                   values=ds.values)
+        return serve.ServeRequest(tenant=f"bench-t{i % 2}",
+                                  params=params, dataset=payload,
+                                  epsilon=0.5, delta=1e-8,
+                                  rng_seed=seed)
+
+    def burst(svc, seed0):
+        outs = [None] * n_conc
+
+        def one(i):
+            def body():
+                outs[i] = svc.submit(req(i, seed0 + i))
+            return _CaptureThread(body, f"pdp-serve-bench-{i}")
+
+        with tracer().span("bench.obs_burst", cat="bench") as sp:
+            threads = [one(i) for i in range(n_conc)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        for t in threads:
+            if t.exc is not None:
+                raise t.exc
+        for out in outs:
+            assert out.ok, f"serve refused: {out}"
+        return sp.duration, outs
+
+    def run_mode(instrumented, seed0):
+        """One serve lifetime with observability fully on or fully
+        dark; returns (req/s, warm-burst results, scrape bytes)."""
+        saved = {k: os.environ.get(k)
+                 for k in ("PIPELINEDP_TPU_TRACE",
+                           "PIPELINEDP_TPU_METRICS_PORT")}
+        if instrumented:
+            os.environ["PIPELINEDP_TPU_TRACE"] = "1"
+            os.environ["PIPELINEDP_TPU_METRICS_PORT"] = "0"
+        else:
+            os.environ.pop("PIPELINEDP_TPU_TRACE", None)
+            os.environ.pop("PIPELINEDP_TPU_METRICS_PORT", None)
+        state_dir = tempfile.mkdtemp(prefix="pdp_obs_overhead_bench_")
+        scraped = 0
+        try:
+            with serve.Service(state_dir, tenants=tenants,
+                               max_queue=max(n_conc * 2, 16),
+                               max_inflight_per_tenant=n_conc,
+                               workers=2) as svc:
+                _, warm_outs = burst(svc, seed0)  # warm-up: compiles
+                best = None
+                for r in range(rounds):
+                    wall, _ = burst(svc, seed0 + 100 * (r + 1))
+                    best = wall if best is None else min(best, wall)
+                if instrumented:
+                    # A live scrape loop is part of the instrumented
+                    # reality being priced, not a separate benchmark.
+                    assert svc._http is not None, (
+                        "metrics endpoint did not start")
+                    url = f"{svc._http.url}/metrics"
+                    with urllib.request.urlopen(url) as resp:
+                        scraped = len(resp.read())
+        finally:
+            shutil.rmtree(state_dir, ignore_errors=True)
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        return (n_conc / max(best, 1e-9),
+                [dict(out.results) for out in warm_outs], scraped)
+
+    dark_rps, dark_parity, _ = run_mode(False, seed0=7_000)
+    on_rps, on_parity, scraped = run_mode(True, seed0=7_000)
+    assert any(dark_parity), "parity burst released no partitions"
+    parity_ok = all(
+        set(d) == set(o) and all(tuple(d[k]) == tuple(o[k]) for k in d)
+        for d, o in zip(dark_parity, on_parity))
+    overhead = max(dark_rps / max(on_rps, 1e-9) - 1.0, 0.0)
+    rec = {
+        "metric": "obs_overhead_serve_req_per_s",
+        "value": round(on_rps, 2),
+        "unit": "req/s",
+        "rows_per_request": n_rows,
+        "tenants": len(tenants),
+        "concurrent_requests": n_conc,
+        "rounds": rounds,
+        "dark_req_per_s": round(dark_rps, 2),
+        "overhead_frac": round(overhead, 4),
+        "metrics_scrape_bytes": int(scraped),
+        "parity_ok": bool(parity_ok),
+    }
+    log(f"## obs_overhead [{n_rows} rows x {n_conc} concurrent]: "
+        f"instrumented {on_rps:.1f} req/s vs dark {dark_rps:.1f} "
+        f"req/s (overhead {overhead * 100:.1f}%), "
+        f"parity_ok={parity_ok}")
+    assert parity_ok, (
+        "observability on/off same-seed outputs diverged — the "
+        "trace-context parity row is broken; refusing to emit an "
+        "overhead record for wrong bits")
+    emit(rec)
+    return rec
+
+
 def bench_dp_heavy_hitters(n_rows, smoke=False):
     """DP heavy hitters over an unbounded STRING key space — the
     sketch-first two-phase path (``pipelinedp_tpu/sketch``): power-law
@@ -2511,6 +2649,13 @@ def main():
         # 20k-row same-signature requests): solo vs fused in one
         # process, same-seed bit-parity cross-checked.
         bench_serve_fused_throughput(20_000, smoke=args.smoke)
+
+        # Observability-cost A/B: the same serve burst with the full
+        # trace-context + metrics + live-/metrics-scrape plane armed
+        # vs fully dark, same-seed bit-parity cross-checked; gates
+        # the instrumented path's throughput under --compare.
+        bench_obs_overhead(5_000 if args.smoke else 20_000,
+                           smoke=args.smoke)
 
         # DP heavy hitters over an unbounded string key space: the
         # sketch-first two-phase path at ~1e7 rows over ~1e6 distinct

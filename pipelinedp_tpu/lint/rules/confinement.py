@@ -445,6 +445,49 @@ class SketchConfinementRule(Rule):
                        "owner")
 
 
+class SocketConfinementRule(Rule):
+    """Raw wire machinery (``socket`` / ``http.server`` /
+    ``socketserver``) is confined to ``obs/http.py``.
+
+    The introspection endpoint is the repo's ONE wire surface, and it
+    is read-only by construction. A second module opening sockets
+    would grow a second listener lifecycle outside the serve drain
+    discipline (orphan accept threads survive ``Service.close``) and a
+    second place where per-tenant budget state could leak off-box.
+    You cannot serve a port without importing the machinery, so the
+    import ban is the precise form — client-side stdlib
+    (``urllib``, ``http.client``) stays free for tests and tools."""
+
+    id = "socket-confinement"
+    legacy_target = None  # born with `make metricscheck`, never a grep
+    invariant = ("the process has ONE wire surface — the read-only "
+                 "obs/http.py introspection endpoint, whose accept "
+                 "thread the serve lifecycle starts and drains; any "
+                 "other socket/http.server/socketserver import grows "
+                 "an unmanaged listener")
+    fix_hint = ("expose data through pipelinedp_tpu.obs.http "
+                "(maybe_start / IntrospectionServer); never open "
+                "sockets elsewhere")
+    blessed = ("pipelinedp_tpu/obs/http.py",)
+    _BANNED_MODULES = ("socket", "socketserver", "http.server")
+
+    def check(self, ctx):
+        hits = {}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.Import, ast.ImportFrom)):
+                continue
+            for name in import_bindings(node):
+                if any(name == m or name.startswith(m + ".")
+                       for m in self._BANNED_MODULES):
+                    hits.setdefault(
+                        node.lineno,
+                        f"wire-machinery import ({name}) outside "
+                        "obs/http.py — the introspection endpoint is "
+                        "the one wire surface")
+        for line in sorted(hits):
+            yield (line, hits[line])
+
+
 PORTED_RULES = (NoSleepRule, NoFoldinRule, NoStagerRule, NoPerfRule,
                 NoArtifactsRule, NoCostRule, NoKnobsRule,
                 NoPallasRule, NoServeRule)

@@ -62,7 +62,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
-from pipelinedp_tpu.obs import audit, costs, store
+from pipelinedp_tpu.obs import audit, costs, metrics, store, trace_context
 from pipelinedp_tpu.obs import report as _report
 from pipelinedp_tpu.obs.tracer import (ACTIVITY, ENV_VAR, MAX_EVENTS,
                                        MAX_SPANS, NOOP_SPAN, NOOP_TRACER,
@@ -80,6 +80,7 @@ __all__ = [
     "gauge", "gauge_max", "sample",
     "environment_fingerprint", "build_run_report", "write_chrome_trace",
     "device_annotation", "audit", "costs", "store", "monitor",
+    "metrics", "trace_context",
 ]
 
 #: The process-global run ledger.
@@ -157,6 +158,7 @@ def reset() -> None:
     _LEDGER.reset()
     audit.reset()
     costs.reset()
+    metrics.reset()
     store.reset_run_report_cursor()
     monitor.reset_requests()
     # Lazy: plan imports obs, so a module-level import would cycle.

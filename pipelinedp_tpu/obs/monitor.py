@@ -169,6 +169,7 @@ def reset_requests() -> None:
     update_mesh(None)
     update_serve_health(None)
     update_sweep(None)
+    update_tenants(None)
 
 
 # The serve-fusion bucket registry: the fusion layer (serve/fusion.py)
@@ -267,6 +268,33 @@ def sweep_snapshot() -> Optional[Dict[str, Any]]:
         return dict(_SWEEP_STATE) if _SWEEP_STATE is not None else None
 
 
+# The tenant-budget registry: the serve layer pushes each tenant's
+# budget picture (ε/δ remaining, reserves in flight) from its durable
+# budget ledger on every reserve/commit/release — same push pattern as
+# fusion/mesh/sweep above, because the monitor never imports serve/.
+# The heartbeat grows a "tenants" section while a snapshot is
+# installed, so "who is burning budget" is answerable from the monitor
+# document alone, no HTTP endpoint armed.
+
+_TENANTS_LOCK = threading.Lock()
+_TENANTS_STATE: Optional[Dict[str, Any]] = None
+
+
+def update_tenants(snapshot: Optional[Dict[str, Any]]) -> None:
+    """Install (or, with None, clear) the per-tenant budget snapshot
+    the next heartbeat embeds (``{tenant: {epsilon_remaining, ...}}``)."""
+    global _TENANTS_STATE
+    with _TENANTS_LOCK:
+        _TENANTS_STATE = (dict(snapshot) if snapshot is not None
+                          else None)
+
+
+def tenants_snapshot() -> Optional[Dict[str, Any]]:
+    with _TENANTS_LOCK:
+        return (dict(_TENANTS_STATE) if _TENANTS_STATE is not None
+                else None)
+
+
 class Monitor:
     """The monitor: one background thread (or inline test driving via
     :meth:`poll_once`) that writes heartbeats and ages the stall
@@ -312,6 +340,9 @@ class Monitor:
         self.stalls: List[Dict[str, Any]] = []
         self.beats = 0
         self.write_errors = 0
+        #: The most recent heartbeat payload (``/heartbeat`` serves it
+        #: without forcing an off-schedule beat).
+        self.last_heartbeat: Optional[Dict[str, Any]] = None
         self._stop = threading.Event()
         self._thread = None
         self._t_start = self.clock.monotonic()
@@ -456,6 +487,7 @@ class Monitor:
                                    stalled, stalled_for)
         self._write_atomic(self.heartbeat_path, hb)
         self.beats += 1
+        self.last_heartbeat = hb
         return hb
 
     def _rate(self, now: float, rows_done: int,
@@ -545,6 +577,12 @@ class Monitor:
             # so a long utility-analysis sweep is visible live and a
             # stall names its blocked config batch.
             hb["sweep"] = sweep
+        tenants = tenants_snapshot()
+        if tenants is not None:
+            # Per-tenant budget burn-down (ε/δ remaining, reserves in
+            # flight) from the serve layer's durable budget ledger:
+            # "who is burning budget" without reading ledger JSON.
+            hb["tenants"] = tenants
         if stalled:
             hb["stall"] = {"stalled_for_s": round(stalled_for, 3),
                            "deadline_s": self.stall_s,
@@ -674,3 +712,14 @@ def stop() -> None:
     if _MONITOR is not None:
         _MONITOR.stop()
         _MONITOR = None
+
+
+def heartbeat_payload() -> Optional[Dict[str, Any]]:
+    """The active monitor's most recent heartbeat document (None when
+    no monitor runs or it has not beat yet). ``obs/http.py`` serves
+    this on ``/heartbeat``; with the monitor off, the endpoint falls
+    back to the live push registries instead."""
+    m = _MONITOR
+    if m is None:
+        return None
+    return m.last_heartbeat

@@ -22,6 +22,7 @@ import json
 import os
 import subprocess
 import threading
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 from pipelinedp_tpu.obs import audit as _audit
@@ -46,7 +47,13 @@ from pipelinedp_tpu.obs import costs as _costs
 #: bucket pre/post and candidate counts — ``obs.audit.record_sketch``);
 #: absent in v1–v4 reports AND in v5 runs with no sketch phase, which
 #: readers treat as "no sketch-first request ran".
-SCHEMA_VERSION = 5
+#: v6 (causal-tracing PR): adds the ``trace_spans`` section — the raw
+#: span dicts of every span stamped with a request trace context
+#: (``obs.trace_context``), the material ``store --summarize
+#: --trace-id`` rebuilds a request's causal chain from; absent in
+#: v1–v5 reports AND in v6 runs with no context-stamped spans, which
+#: readers treat as "no request-scoped tracing captured".
+SCHEMA_VERSION = 6
 
 _git_probe_cache: Optional[Tuple[str, bool]] = None
 
@@ -187,6 +194,16 @@ def build_run_report(snapshot: Dict[str, Any], mesh=None,
         plan_section = None
     if plan_section:
         report["plan"] = plan_section
+    # v6: raw span dicts for every span a request trace context stamped
+    # (absent = no request-scoped tracing, the v1–v5-compatible
+    # reading). The ``spans`` section above is a per-NAME summary;
+    # rebuilding one request's causal chain (``store --summarize
+    # --trace-id``, ``/trace/<id>``) needs the per-SPAN detail — but
+    # only for the stamped subset, so an untraced run adds nothing.
+    trace_spans = [s.to_dict() for s in snapshot.get("spans", [])
+                   if "trace_id" in s.args]
+    if trace_spans:
+        report["trace_spans"] = trace_spans
     if extra:
         report.update(extra)
     return report
@@ -266,12 +283,139 @@ def chrome_trace_events(snapshot: Dict[str, Any],
         out.append({"ph": "i", "name": e["name"], "cat": "event",
                     "pid": pid, "tid": 0, "s": "p",
                     "ts": (e.get("ts", t0) - t0) * 1e6, "args": args})
+    out.extend(_flow_events(spans, t0, pid))
     out.extend(_counter_track_events(series, t0, pid))
     # Thread-name metadata rows make the Perfetto lanes self-labeling.
     for tid, name in sorted(threads.items()):
         out.append({"ph": "M", "name": "thread_name", "pid": pid,
                     "tid": tid, "args": {"name": name}})
     return out
+
+
+def _flow_events(spans, t0: float, pid: int) -> List[Dict[str, Any]]:
+    """Chrome flow events (``ph: "s"`` / ``ph: "f"``) chaining the
+    context-stamped spans of each request across thread lanes, so
+    Perfetto draws one connected arc per ``trace_id`` (admission →
+    fuse → worker → release tail). For each consecutive pair of a
+    request's spans (by start time) the start event fires at the
+    earlier span's end on its lane, the finish event (``bp: "e"``:
+    bind to the ENCLOSING slice, not the next one) at the later span's
+    start on its lane; one deterministic numeric id per trace keeps
+    the whole chain a single flow."""
+    by_trace: Dict[str, List[Any]] = {}
+    for s in spans:
+        tid = s.args.get("trace_id")
+        if tid is not None:
+            by_trace.setdefault(str(tid), []).append(s)
+    out: List[Dict[str, Any]] = []
+    for trace_id, group in sorted(by_trace.items()):
+        if len(group) < 2:
+            continue
+        group.sort(key=lambda s: (s.ts, s.args.get("span_id", 0)))
+        fid = zlib.crc32(trace_id.encode("utf-8")) & 0x7FFFFFFF
+        for prev, nxt in zip(group, group[1:]):
+            out.append({"ph": "s", "name": "request", "cat": "flow",
+                        "id": fid, "pid": pid, "tid": prev.tid,
+                        "ts": (prev.ts - t0) * 1e6 + prev.dur * 1e6})
+            out.append({"ph": "f", "bp": "e", "name": "request",
+                        "cat": "flow", "id": fid, "pid": pid,
+                        "tid": nxt.tid, "ts": (nxt.ts - t0) * 1e6})
+    return out
+
+
+def build_trace_tree(trace_id: str, spans: List[Dict[str, Any]],
+                     events: Optional[List[Dict[str, Any]]] = None
+                     ) -> Dict[str, Any]:
+    """Rebuild one request's causal span tree from span/event DICTS
+    (``Span.to_dict()`` shape — works on a live snapshot, a persisted
+    run report's ``trace_spans`` section, or entries merged across
+    both). Spans nest by the ``parent_span`` arg the context stamp
+    recorded; events attach to their recorded ``parent_span`` when it
+    resolved, otherwise land in the top-level ``events`` list. The
+    shared engine behind ``/trace/<id>`` (obs/http.py) and ``store
+    --summarize --trace-id``."""
+    events = events or []
+    sel = [s for s in spans
+           if (s.get("args") or {}).get("trace_id") == trace_id]
+    sel.sort(key=lambda s: (s.get("ts", 0.0),
+                            (s.get("args") or {}).get("span_id", 0)))
+    nodes: Dict[int, Dict[str, Any]] = {}
+    ordered: List[Dict[str, Any]] = []
+    for s in sel:
+        node = dict(s)
+        node["children"] = []
+        node["events"] = []
+        ordered.append(node)
+        sid = (s.get("args") or {}).get("span_id")
+        if sid is not None and sid not in nodes:
+            nodes[sid] = node
+    roots: List[Dict[str, Any]] = []
+    for node in ordered:
+        args = node.get("args") or {}
+        parent = args.get("parent_span")
+        target = nodes.get(parent)
+        if target is not None and target is not node:
+            target["children"].append(node)
+        else:
+            roots.append(node)
+    loose: List[Dict[str, Any]] = []
+    for e in sorted((e for e in events if e.get("trace_id") == trace_id),
+                    key=lambda e: e.get("ts", 0.0)):
+        target = nodes.get(e.get("parent_span"))
+        if target is not None:
+            target["events"].append(dict(e))
+        else:
+            loose.append(dict(e))
+    tenant = request_id = None
+    for s in sel:
+        args = s.get("args") or {}
+        tenant = tenant or args.get("tenant")
+        request_id = request_id or args.get("request_id")
+    return {"trace_id": trace_id, "tenant": tenant,
+            "request_id": request_id, "span_count": len(sel),
+            "event_count": sum(1 for e in events
+                               if e.get("trace_id") == trace_id),
+            "roots": roots, "events": loose}
+
+
+def format_trace_tree(tree: Dict[str, Any]) -> str:
+    """Human-readable rendering of :func:`build_trace_tree` output:
+    one indented line per span (start offset, duration, thread) with
+    attached events inline — what ``store --summarize --trace-id``
+    prints."""
+    lines = [f"trace {tree['trace_id']}"
+             f"  tenant={tree.get('tenant') or '-'}"
+             f"  request={tree.get('request_id') or '-'}"
+             f"  spans={tree['span_count']}"
+             f"  events={tree['event_count']}"]
+    all_ts = [s.get("ts", 0.0) for s in _iter_tree_spans(tree["roots"])]
+    t0 = min(all_ts) if all_ts else 0.0
+
+    def emit(node: Dict[str, Any], depth: int) -> None:
+        pad = "  " * depth
+        lines.append(
+            f"{pad}+{(node.get('ts', 0.0) - t0) * 1e3:9.3f}ms "
+            f"{node.get('name', '?')} "
+            f"[{node.get('dur', 0.0) * 1e3:.3f}ms] "
+            f"({node.get('thread', '?')})")
+        for e in node.get("events", []):
+            lines.append(f"{pad}    ! {e.get('name', '?')}")
+        for child in node.get("children", []):
+            emit(child, depth + 1)
+
+    for root in tree["roots"]:
+        emit(root, 1)
+    for e in tree["events"]:
+        lines.append(f"  ! {e.get('name', '?')} (unparented)")
+    return "\n".join(lines)
+
+
+def _iter_tree_spans(roots: List[Dict[str, Any]]):
+    stack = list(roots)
+    while stack:
+        node = stack.pop()
+        yield node
+        stack.extend(node.get("children", []))
 
 
 def _jsonable(v):

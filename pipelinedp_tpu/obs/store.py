@@ -270,7 +270,7 @@ def _cursor_for(directory: str) -> Dict[str, Any]:
     key = os.path.abspath(directory)
     cur = _report_cursors.get(key)
     if cur is None:
-        cur = {"audit": None, "events": 0}
+        cur = {"audit": None, "events": 0, "trace_spans": 0}
         _report_cursors[key] = cur
     return cur
 
@@ -319,6 +319,42 @@ def entries_since_run_id(entries: List[Dict[str, Any]],
 
 
 # --- ledger analytics (``python -m pipelinedp_tpu.obs.store``) ---
+
+
+def trace_chain_from_entries(entries: List[Dict[str, Any]],
+                             trace_id: str) -> Dict[str, Any]:
+    """One request's causal span tree rebuilt from PERSISTED ledger
+    entries: every run-report ``trace_spans`` span and every stamped
+    event across ``entries`` is pooled, then handed to
+    ``report.build_trace_tree`` — the CLI twin of the live
+    ``/trace/<id>`` endpoint (obs/http.py), reading the durable store
+    instead of the in-process ledger."""
+    from pipelinedp_tpu.obs.report import build_trace_tree
+    spans: List[Dict[str, Any]] = []
+    events: List[Dict[str, Any]] = []
+    for e in entries:
+        payload = e.get("payload") or {}
+        rr = payload.get("run_report")
+        if isinstance(rr, dict):
+            for s in rr.get("trace_spans") or []:
+                if isinstance(s, dict):
+                    spans.append(s)
+            for ev in rr.get("events") or []:
+                if isinstance(ev, dict) and ev.get("trace_id"):
+                    events.append(ev)
+        # Serve books entries stamp trace_id inside their ``serve``
+        # payload — surface each as a synthetic event so the chain
+        # shows its durable books commit even when the run report's
+        # delta landed in a different store.
+        serve_books = payload.get("serve")
+        if (isinstance(serve_books, dict)
+                and serve_books.get("trace_id") == trace_id):
+            events.append({"name": f"books.{e.get('name')}",
+                           "ts": e.get("ts", 0.0),
+                           "trace_id": trace_id,
+                           "tenant": serve_books.get("tenant"),
+                           "request_id": serve_books.get("request_id")})
+    return build_trace_tree(trace_id, spans, events)
 
 
 def _trend(samples: List[float]) -> Optional[float]:
@@ -647,6 +683,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "./.pdp_ledger)")
     parser.add_argument("--fingerprint", default=None,
                         help="restrict to one environment fingerprint")
+    parser.add_argument("--trace-id", default=None, dest="trace_id",
+                        help="with --summarize: print ONE request's "
+                        "causal span tree (admission through books "
+                        "commit) rebuilt from persisted trace_spans — "
+                        "the CLI twin of the /trace/<id> endpoint")
     parser.add_argument("--since-run-id", default=None,
                         dest="since_run_id",
                         help="window to entries at/after the first "
@@ -688,6 +729,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.fingerprint:
         entries = [e for e in entries
                    if e.get("fingerprint") == args.fingerprint]
+    if args.trace_id:
+        if args.as_csv:
+            parser.error("--trace-id has no CSV shape; use --json")
+        tree = trace_chain_from_entries(entries, args.trace_id)
+        if args.as_json:
+            print(json.dumps({"ledger": s.path,
+                              "entries": len(entries), "trace": tree}))
+            return 0 if tree["span_count"] else 3
+        from pipelinedp_tpu.obs.report import format_trace_tree
+        print(f"ledger: {s.path} ({len(entries)} entries)")
+        print(format_trace_tree(tree))
+        if not tree["span_count"]:
+            print(f"no spans recorded for trace {args.trace_id} "
+                  "(was PIPELINEDP_TPU_TRACE set during the run?)")
+            return 3
+        return 0
     summary = summarize_entries(entries)
     if args.as_json:
         print(json.dumps({"ledger": s.path, "entries": len(entries),
@@ -780,9 +837,18 @@ def maybe_append_run_report(name: str,
             events = report.get("events", [])
             ev_start = min(int(cursor["events"]), len(events))
             report["events"] = events[ev_start:]
+            # v6 trace_spans ride the same delta discipline: entry k
+            # carries only the context-stamped spans recorded since the
+            # previous append to this directory.
+            trace_spans = report.pop("trace_spans", [])
+            ts_start = min(int(cursor.get("trace_spans", 0)),
+                           len(trace_spans))
+            if trace_spans[ts_start:]:
+                report["trace_spans"] = trace_spans[ts_start:]
             priv = report["privacy"]
             if not (priv["accountants"] or priv["aggregations"] or
-                    priv["expected_errors"] or report["events"]):
+                    priv["expected_errors"] or report["events"] or
+                    report.get("trace_spans")):
                 return None
             if extra:
                 report.update(extra)
@@ -807,6 +873,8 @@ def maybe_append_run_report(name: str,
             # append must never move the cursor BACKWARDS — that would
             # re-persist events a later entry already carried.
             cursor["events"] = max(int(cursor["events"]), len(events))
+            cursor["trace_spans"] = max(
+                int(cursor.get("trace_spans", 0)), len(trace_spans))
         return entry
     except Exception:
         return None

@@ -38,6 +38,8 @@ import threading
 import time as _time
 from typing import Any, Dict, List, Optional, Tuple
 
+from pipelinedp_tpu.obs import trace_context
+
 ENV_VAR = "PIPELINEDP_TPU_TRACE"
 
 #: Retention caps: a pathological run (millions of batches) must not
@@ -240,6 +242,10 @@ class RunLedger:
             self.dropped_samples += 1
 
     def event(self, name: str, **attrs) -> None:
+        # A bound request context marks the event as part of that
+        # request's causal chain (events record always; the stamp is
+        # one ContextVar read when no context is bound).
+        trace_context.stamp_event_attrs(attrs)
         with self._lock:
             if len(self.events) < MAX_EVENTS:
                 self.events.append({"name": name,
@@ -286,7 +292,8 @@ class _SpanHandle:
     seconds after exit (bench helpers read it directly, replacing their
     two-``perf_counter`` idiom)."""
 
-    __slots__ = ("_tracer", "name", "cat", "args", "_t0", "duration")
+    __slots__ = ("_tracer", "name", "cat", "args", "_t0", "duration",
+                 "_ctx_token")
 
     def __init__(self, tracer: "Tracer", name: str, cat: str,
                  args: Dict[str, Any]):
@@ -296,9 +303,15 @@ class _SpanHandle:
         self.args = args
         self._t0 = 0.0
         self.duration = 0.0
+        self._ctx_token = None
 
     def __enter__(self) -> "_SpanHandle":
         self._t0 = self._tracer._clock.monotonic()
+        sid = self.args.get("span_id")
+        if sid is not None:
+            # A context-stamped span is the parent of everything in its
+            # dynamic extent — /trace/<id> rebuilds the tree from this.
+            self._ctx_token = trace_context.child_of(sid)
         if ACTIVITY.enabled:
             ACTIVITY.span_opened(self)
         return self
@@ -306,6 +319,9 @@ class _SpanHandle:
     def __exit__(self, exc_type, exc, tb) -> bool:
         t1 = self._tracer._clock.monotonic()
         self.duration = t1 - self._t0
+        if self._ctx_token is not None:
+            trace_context.pop(self._ctx_token)
+            self._ctx_token = None
         if ACTIVITY.enabled:
             ACTIVITY.span_closed(self, self.duration)
         self._tracer._finish(self, self._t0, self.duration)
@@ -335,6 +351,12 @@ class Tracer:
         return self._ledger is not None
 
     def span(self, name: str, cat: str = "run", **args) -> _SpanHandle:
+        if self._ledger is not None:
+            # Recording tracer only: spans that land in the ledger carry
+            # the bound request context (trace_id / span_id / parentage)
+            # so a multi-tenant run stays causally separable. Measuring
+            # tracers skip the stamp — the zero-overhead-off discipline.
+            trace_context.stamp_span_args(args)
         return _SpanHandle(self, name, cat, args)
 
     def _finish(self, handle: _SpanHandle, t0: float, dur: float) -> None:

@@ -54,6 +54,9 @@ SCENARIO_NAMES = (
     "sketch_kill",      # fail_sketch_chunks: sketch-first drain proof
     "torn_ledger",      # torn run-ledger tail: fsck repairs it
     "sweep_kill",       # fail_sweep_config_chunks: megasweep resume
+    "obs_endpoint",     # ServeKill under a live wire surface: the
+                        # introspection endpoint answers mid-crash and
+                        # drains with the service (no orphan listener)
 )
 
 
@@ -600,6 +603,81 @@ def _scenario_torn_ledger(rng: random.Random, fx: _Fixtures,
            f"fsck not idempotent: {again}")
 
 
+def _scenario_obs_endpoint(rng: random.Random, fx: _Fixtures,
+                           tmp: str) -> None:
+    """The wire surface under fire: a serve lifetime with the
+    introspection endpoint armed takes a planned ServeKill mid-burst;
+    the endpoint keeps answering (``/healthz`` and a ``/metrics``
+    scrape that carries the tenant's budget gauges) while the crash is
+    live, and ``Service.close`` drains the ``pdp-obs-http`` accept
+    loop with everything else — the campaign's orphan check is the
+    no-leaked-listener proof."""
+    import json as _json
+    import urllib.request
+
+    import numpy as np
+    import pipelinedp_tpu as pdp
+    # lint: disable=noserve(the chaos harness exercises the serve seam by design; serve loads lazily, only in this episode)
+    from pipelinedp_tpu import serve
+    from pipelinedp_tpu.resilience import FaultPlan, injected_faults
+    from pipelinedp_tpu.resilience import faults
+    n_requests = 3
+    kill = rng.randint(0, n_requests - 1)
+    # lint: disable=rng-purity(chaos fixture data synthesis, seeded, never a DP draw)
+    d_rng = np.random.default_rng(11)
+    n = 1_000
+    ds = pdp.ArrayDataset(privacy_ids=d_rng.integers(0, 300, n),
+                          partition_keys=d_rng.integers(0, 4, n),
+                          values=d_rng.uniform(0.0, 10.0, n))
+    params = pdp.AggregateParams(
+        metrics=[pdp.Metrics.COUNT],
+        max_partitions_contributed=4,
+        max_contributions_per_partition=20)
+    saved_port = os.environ.get("PIPELINEDP_TPU_METRICS_PORT")
+    os.environ["PIPELINEDP_TPU_METRICS_PORT"] = "0"
+    try:
+        with injected_faults(FaultPlan(fail_serve_requests=(kill,))):
+            with serve.Service(os.path.join(tmp, "svc"),
+                               tenants={"t": (10.0, 1e-6)}) as svc:
+                _check(svc._http is not None,
+                       "endpoint did not start under METRICS_PORT=0")
+                base = svc._http.url
+                for i in range(n_requests):
+                    ds.invalidate_cache()
+                    try:
+                        out = svc.submit(serve.ServeRequest(
+                            tenant="t", params=params, dataset=ds,
+                            epsilon=1.0, delta=1e-8, rng_seed=7,
+                            request_id=f"req-{i}"))
+                        _check(i != kill,
+                               f"request {kill} was not killed")
+                        _check(out.ok, f"request {i} refused: {out}")
+                    except faults.ServeKill:
+                        _check(i == kill,
+                               f"request {i} killed, planned {kill}")
+                # The surface answers WHILE the crash is on the books.
+                with urllib.request.urlopen(f"{base}/healthz") as r:
+                    hz = _json.loads(r.read())
+                _check(hz["status"] in ("ok", "degraded"),
+                       f"unparseable healthz: {hz}")
+                with urllib.request.urlopen(f"{base}/metrics") as r:
+                    text = r.read().decode("utf-8")
+                _check("pdp_tenant_epsilon_remaining" in text,
+                       "scrape missing the tenant budget gauge")
+                _check('tenant="t"' in text,
+                       "scrape missing the episode's tenant label")
+    finally:
+        if saved_port is None:
+            os.environ.pop("PIPELINEDP_TPU_METRICS_PORT", None)
+        else:
+            os.environ["PIPELINEDP_TPU_METRICS_PORT"] = saved_port
+    # Drained listener: close() already ran (context exit); the accept
+    # thread must be gone NOW, not merely by campaign teardown.
+    _check(not any(t.name == "pdp-obs-http"
+                   for t in threading.enumerate() if t.is_alive()),
+           "pdp-obs-http accept thread survived Service.close")
+
+
 _SCENARIOS: Dict[str, Callable[[random.Random, _Fixtures, str], None]] = {
     "stream_kill": _scenario_stream_kill,
     "device_loss": _scenario_device_loss,
@@ -610,13 +688,14 @@ _SCENARIOS: Dict[str, Callable[[random.Random, _Fixtures, str], None]] = {
     "sketch_kill": _scenario_sketch_kill,
     "sweep_kill": _scenario_sweep_kill,
     "torn_ledger": _scenario_torn_ledger,
+    "obs_endpoint": _scenario_obs_endpoint,
 }
 
 #: Scenarios whose plan is guaranteed to fire at least one fault (the
 #: hold/wedge scenarios record holds/wedges instead of raising).
 _EXPECT_INJECTED = {"stream_kill", "device_loss", "pass_b_kill",
                     "hold_wedge", "wedged_probe", "serve_kill",
-                    "sketch_kill", "sweep_kill"}
+                    "sketch_kill", "sweep_kill", "obs_endpoint"}
 
 
 def schedule_for(seed: int, n_schedules: int) -> List[Dict[str, Any]]:

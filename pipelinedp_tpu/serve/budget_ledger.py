@@ -259,6 +259,46 @@ class TenantBudgetLedger:
                                     f"under {self.directory}")
             return {k: dict(v) for k, v in state["debits"].items()}
 
+    def overview(self) -> Dict[str, Dict[str, Any]]:
+        """Per-tenant budget overview across every tenant this process
+        has loaded (the write-through cache is exactly that set —
+        restart replay loads a tenant on first touch): totals,
+        remaining (eps, delta), committed spend, and reserves still in
+        flight. Read-only — the material behind the heartbeat's
+        ``tenants`` section and the ``/metrics`` per-tenant gauges."""
+        with self._lock:
+            tenants = sorted(self._states)
+        out: Dict[str, Dict[str, Any]] = {}
+        for tenant in tenants:
+            with self._tenant_lock(tenant):
+                state = self._load(tenant)
+                if state is None:
+                    continue
+                reserved_n = 0
+                reserved_eps = reserved_delta = 0.0
+                committed_eps = committed_delta = 0.0
+                for d in state["debits"].values():
+                    if d["state"] == "reserved":
+                        reserved_n += 1
+                        reserved_eps += float(d["epsilon"])
+                        reserved_delta += float(d["delta"])
+                    elif d["state"] == "committed":
+                        committed_eps += float(d["epsilon"])
+                        committed_delta += float(d["delta"])
+                remaining = self._remaining_locked(state)
+                out[tenant] = {
+                    "total_epsilon": float(state["total_epsilon"]),
+                    "total_delta": float(state["total_delta"]),
+                    "remaining_epsilon": remaining.epsilon,
+                    "remaining_delta": remaining.delta,
+                    "committed_epsilon": committed_eps,
+                    "committed_delta": committed_delta,
+                    "reserves_in_flight": reserved_n,
+                    "reserved_epsilon": reserved_eps,
+                    "reserved_delta": reserved_delta,
+                }
+        return out
+
     def reserve(self, tenant: str, request_id: str, epsilon: float,
                 delta: float) -> BudgetLease:
         """Durably debit (eps, delta) for ``request_id`` BEFORE any

@@ -56,6 +56,7 @@ import jax.numpy as jnp
 
 from pipelinedp_tpu import jax_engine as je
 from pipelinedp_tpu.dp_engine import DataExtractors
+from pipelinedp_tpu.obs import trace_context
 
 #: Knob-seam defaults (registered in ``plan/knobs.py`` without module
 #: seams — serve knobs resolve env > plan > default so that resolving
@@ -412,7 +413,11 @@ class Fuser:
 
         ready = []
         for pending in batch.entries:
-            ctx = self._begin(pending)
+            # Explicit per-member context handoff: one fused batch
+            # carries MANY requests' traces, so each member's phase-1
+            # work is stamped under its own admission-time context.
+            with trace_context.restore(pending.ctx):
+                ctx = self._begin(pending)
             if ctx is not None:
                 ready.append(ctx)
         if not ready:
@@ -547,9 +552,11 @@ class Fuser:
                 # re-encodes the rows.
                 ctx = group[0]
                 ctx.lazy._encoded_hint = ctx.prep.encoded
-                with obs_audit.books_context(ctx.pending.lease.tenant,
-                                             ctx.pending.lease.request_id):
-                    results_by_ctx = {id(ctx): list(ctx.lazy)}
+                with trace_context.restore(ctx.pending.ctx):
+                    with obs_audit.books_context(
+                            ctx.pending.lease.tenant,
+                            ctx.pending.lease.request_id):
+                        results_by_ctx = {id(ctx): list(ctx.lazy)}
             else:
                 # The planner resolution for this fused batch: one
                 # resolve at the bucket shape (plan.applied events and
@@ -574,12 +581,13 @@ class Fuser:
                 results_by_ctx = {}
                 for i, ctx in enumerate(group):
                     lease = ctx.pending.lease
-                    with obs_audit.books_context(lease.tenant,
-                                                 lease.request_id):
-                        out = ctx.lazy.finish_from_fused(
-                            ctx.prep, keep_h[i],
-                            {k: v[i] for k, v in raw_h.items()},
-                            key.fx_bits)
+                    with trace_context.restore(ctx.pending.ctx):
+                        with obs_audit.books_context(lease.tenant,
+                                                     lease.request_id):
+                            out = ctx.lazy.finish_from_fused(
+                                ctx.prep, keep_h[i],
+                                {k: v[i] for k, v in raw_h.items()},
+                                key.fx_bits)
                     ctx.lazy.timings["device_s"] = device_s / len(group)
                     results_by_ctx[id(ctx)] = out
                 obs.inc("serve.fused_batches")
@@ -627,8 +635,17 @@ class Fuser:
         padded = [pad_request_to_bucket(ctx.prep.encoded, key.rows,
                                         config.needs_values)
                   for ctx in group]
+        # The batch span carries per-member child links: a comma-joined
+        # list of the members' trace ids (scalar, so the activity ring
+        # keeps it) — each member's own chain stays separable while the
+        # shared dispatch names everyone it served.
+        members = ",".join(
+            (ctx.pending.ctx.trace_id
+             if ctx.pending.ctx is not None else "-")
+            for ctx in group)
         with svc._tr.span("serve.fused_dispatch", cat="serve",
-                          bucket=key.label, size=len(group)) as sp:
+                          bucket=key.label, size=len(group),
+                          members=members) as sp:
             bpid = jnp.asarray(np.stack([p[0] for p in padded]))
             bpk = jnp.asarray(np.stack([p[1] for p in padded]))
             bvalues = jnp.asarray(np.stack([p[2] for p in padded]))

@@ -43,6 +43,7 @@ from pipelinedp_tpu.aggregate_params import AggregateParams, Metrics
 from pipelinedp_tpu.budget_accounting import (Budget,
                                               NaiveBudgetAccountant)
 from pipelinedp_tpu.dp_engine import DataExtractors, DPEngine
+from pipelinedp_tpu.obs import trace_context
 from pipelinedp_tpu.serve.budget_ledger import (BudgetLease,
                                                 DuplicateRequest,
                                                 LedgerError,
@@ -121,6 +122,9 @@ class ServeResponse:
     signature: str
     wall_s: float
     audit: Dict[str, Any]
+    #: The request's causal trace id (obs.trace_context) — the handle
+    #: for ``/trace/<id>`` and ``store --summarize --trace-id``.
+    trace_id: Optional[str] = None
 
     @property
     def ok(self) -> bool:
@@ -199,6 +203,12 @@ class _Pending:
         self.request = request
         self.lease = lease
         self.seq = seq
+        #: The submitting caller's trace context, captured HERE because
+        #: contextvars do not flow into threads: the worker / fuser /
+        #: release tail each re-bind it explicitly
+        #: (``trace_context.restore``), which is what keeps one
+        #: request's spans a single causal chain across the handoffs.
+        self.ctx = trace_context.current()
         self.done = threading.Event()
         self.outcome: Optional[Tuple[str, Any]] = None
         #: Set by the fusion layer at offer time (serve/fusion.py):
@@ -273,6 +283,9 @@ class Service:
             from pipelinedp_tpu.resilience.clock import SystemClock
             clock = SystemClock()
         self._clock = clock
+        #: Service birth on the injectable clock — the denominator of
+        #: the per-tenant budget burn-rate gauges.
+        self._t0 = self._clock.monotonic()
         self._tr = obs.run_tracer(clock=clock)
         self._q: queue.Queue = queue.Queue(maxsize=self.max_queue)
         self._admit = threading.Lock()
@@ -328,10 +341,20 @@ class Service:
                 "(health probe fell back); refusing before reserve")
         for tenant, (eps, delta) in (tenants or {}).items():
             self.register_tenant(tenant, eps, delta)
+        # The read-only introspection endpoint (obs/http.py): off
+        # unless PIPELINEDP_TPU_METRICS_PORT is set; a bind failure is
+        # an event, never a startup failure. Bound into THIS lifecycle:
+        # close() stops it, so the service leaves zero orphan threads.
+        from pipelinedp_tpu.obs import http as obs_http
+        self._http = obs_http.maybe_start()
+        self._push_tenant_state()
+        self._push_occupancy()
         obs.event("serve.started", workers=len(self._workers),
                   max_queue=self.max_queue,
                   max_inflight_per_tenant=self.max_inflight_per_tenant,
                   fusion=bool(self._fuser is not None),
+                  metrics_port=(self._http.port
+                                if self._http is not None else None),
                   ledger_dir=self.ledger_dir)
 
     # --- lifecycle ---
@@ -358,8 +381,10 @@ class Service:
             quotas["reqs_per_s"] = int(max_reqs_per_s)
         if quotas:
             self._quotas[tenant] = quotas
-        return self.budgets.open_tenant(tenant, total_epsilon,
-                                        total_delta)
+        remaining = self.budgets.open_tenant(tenant, total_epsilon,
+                                             total_delta)
+        self._push_tenant_state()
+        return remaining
 
     def _tenant_quota(self, tenant: str, kind: str, default: int) -> int:
         return int(self._quotas.get(tenant, {}).get(kind, default))
@@ -423,6 +448,9 @@ class Service:
                 self._refuse_unworked(
                     pending, "service closed before a worker picked "
                     "this request up")
+        if self._http is not None:
+            self._http.stop()
+            self._http = None
         obs.event("serve.closed")
 
     def _refuse_unworked(self, pending: "_Pending",
@@ -491,8 +519,6 @@ class Service:
         before the queue ever sees it. A request id whose original is
         still in flight is refused as 'duplicate' — admitting the
         retry would let one durable debit release two noisy views."""
-        from pipelinedp_tpu import obs
-        from pipelinedp_tpu.obs import monitor as obs_monitor
         if not isinstance(request, ServeRequest):
             # Refuse before touching any attribute — a non-ServeRequest
             # has no request_id/tenant to read.
@@ -507,6 +533,18 @@ class Service:
             rid = f"req-{uuid.uuid4().hex[:12]}"
         else:
             rid = str(request.request_id)
+        # One trace context per request, bound on the CALLER's thread
+        # for the whole admission path: every span/event under it is
+        # stamped (trace_id, tenant, request_id), and _Pending captures
+        # it for the explicit handoffs to the fuser/worker threads.
+        # Telemetry-only — binding never touches DP arithmetic (PARITY
+        # row 42).
+        with trace_context.bind(tenant=request.tenant, request_id=rid):
+            return self._submit_bound(request, rid)
+
+    def _submit_bound(self, request: ServeRequest, rid: str):
+        """The body of ``submit`` under the request's bound trace
+        context (same contract, same return values)."""
         tenant = request.tenant
         if self._closed.is_set():
             return self._refuse(rid, tenant, "shutdown",
@@ -632,50 +670,61 @@ class Service:
         full_detail = (f"request queue is full ({self.max_queue} "
                        "deep); back off and resubmit")
         verdict: Optional[Tuple[str, str]] = None
-        # Register BEFORE the enqueue: the worker's update/unregister
-        # must always follow the registration, or a fast completion
-        # would leave a phantom live request in every later heartbeat.
-        obs_monitor.register_request(rid, tenant=tenant, phase="queued",
-                                     kind=request.kind)
-        routed = False
-        with self._admit:
-            if self._closed.is_set():  # raced close()
-                verdict = ("shutdown",
-                           "service is draining; submit refused")
-            else:
-                pending = _Pending(request, lease, self._seq)
-                self._seq += 1
-        if (verdict is None and self._fuser is not None
-                and request.kind == "aggregate"):
-            # The fusion layer sits between admission and the workers:
-            # a fusable request joins its shape bucket here (the
-            # host-side encode runs on THIS caller's thread, so it
-            # parallelizes across tenants); everything else falls
-            # through to the solo queue, including anything offered
-            # while the fuser is closing. Tune requests never fuse —
-            # the megasweep is its own batched program.
-            try:
-                routed = self._fuser.offer(pending)
-            except Exception:
-                routed = False
-        if verdict is None and not routed:
+        # The admission span is the request's causal ROOT: _Pending is
+        # constructed inside it, so the captured context carries this
+        # span as parent — the worker/fuser/commit spans nest beneath
+        # it and the Chrome-trace flow arc starts on this thread.
+        with self._tr.span("serve.admit", cat="serve", tenant=tenant,
+                           kind=request.kind):
+            # Register BEFORE the enqueue: the worker's
+            # update/unregister must always follow the registration, or
+            # a fast completion would leave a phantom live request in
+            # every later heartbeat.
+            obs_monitor.register_request(rid, tenant=tenant,
+                                         phase="queued",
+                                         kind=request.kind)
+            routed = False
             with self._admit:
                 if self._closed.is_set():  # raced close()
                     verdict = ("shutdown",
                                "service is draining; submit refused")
                 else:
-                    try:
-                        self._q.put_nowait(pending)
-                    except queue.Full:  # raced another admitter
-                        verdict = ("queue_full", full_detail)
-        if verdict is not None:
-            # Release BEFORE the rollback drops the id from _live —
-            # see _release_lease for the dedup race this order closes.
-            self._release_lease(lease)
-            self._rollback_admission(tenant, rid)
-            obs_monitor.unregister_request(rid)
-            return self._refuse(rid, tenant, *verdict)
-        obs.inc("serve.requests_admitted")
+                    pending = _Pending(request, lease, self._seq)
+                    self._seq += 1
+            if (verdict is None and self._fuser is not None
+                    and request.kind == "aggregate"):
+                # The fusion layer sits between admission and the
+                # workers: a fusable request joins its shape bucket
+                # here (the host-side encode runs on THIS caller's
+                # thread, so it parallelizes across tenants);
+                # everything else falls through to the solo queue,
+                # including anything offered while the fuser is
+                # closing. Tune requests never fuse — the megasweep is
+                # its own batched program.
+                try:
+                    routed = self._fuser.offer(pending)
+                except Exception:
+                    routed = False
+            if verdict is None and not routed:
+                with self._admit:
+                    if self._closed.is_set():  # raced close()
+                        verdict = ("shutdown",
+                                   "service is draining; submit refused")
+                    else:
+                        try:
+                            self._q.put_nowait(pending)
+                        except queue.Full:  # raced another admitter
+                            verdict = ("queue_full", full_detail)
+            if verdict is not None:
+                # Release BEFORE the rollback drops the id from _live —
+                # see _release_lease for the dedup race this order
+                # closes.
+                self._release_lease(lease)
+                self._rollback_admission(tenant, rid)
+                obs_monitor.unregister_request(rid)
+                return self._refuse(rid, tenant, *verdict)
+            obs.inc("serve.requests_admitted")
+            self._push_occupancy()
         pending.done.wait()
         kind, value = pending.outcome
         if kind == "raise":
@@ -737,6 +786,7 @@ class Service:
         except Exception:
             obs.event("serve.release_failed",
                       request_id=lease.request_id, tenant=lease.tenant)
+        self._push_tenant_state()
 
     def _refuse(self, rid: str, tenant: str, reason: str, detail: str,
                 remaining: Optional[Budget] = None) -> Refusal:
@@ -785,9 +835,14 @@ class Service:
                 pending.teardown = self._make_teardown(pending)
             try:
                 if fused:
+                    # Per-member contexts are restored inside the
+                    # fused executor — one batch carries many traces.
                     self._fuser.execute(item)
                 else:
-                    self._execute(item)
+                    # Explicit context handoff: contextvars never flow
+                    # into this worker thread on their own.
+                    with trace_context.restore(item.ctx):
+                        self._execute(item)
             except BaseException as e:  # safety net: a worker must
                 # never die holding an unfinished pending — the
                 # submitter would block forever and the pool would
@@ -924,15 +979,30 @@ class Service:
         remaining budget, snapshot the audit record, append the books
         entry, unblock the submitter. The DP output exists by now, so
         a bookkeeping failure surfaces on the CALLER with the reserve
-        left standing — refunding would be the unsafe direction."""
+        left standing — refunding would be the unsafe direction.
+        Restores the request's context itself: the fused executor
+        reaches here on the fuser/worker thread with a DIFFERENT
+        member's context (or none) bound."""
+        with trace_context.restore(pending.ctx):
+            self._commit_and_respond_bound(pending, accountant, results,
+                                           warm, signature, wall_s,
+                                           fused)
+
+    def _commit_and_respond_bound(self, pending: "_Pending", accountant,
+                                  results, warm: bool, signature: str,
+                                  wall_s: float, fused: bool) -> None:
         from pipelinedp_tpu import obs
         from pipelinedp_tpu.obs import monitor as obs_monitor
         lease = pending.lease
         rid, tenant = lease.request_id, lease.tenant
         try:
-            self.budgets.commit(tenant, rid)
-            remaining = self.budgets.remaining(tenant)
-            audit_record = accountant.audit_record()
+            # The host release tail, as its own span: the last hop of
+            # the request's causal chain (admit -> execute -> commit).
+            with self._tr.span("serve.commit", cat="serve",
+                               tenant=tenant):
+                self.budgets.commit(tenant, rid)
+                remaining = self.budgets.remaining(tenant)
+                audit_record = accountant.audit_record()
         except Exception as e:
             obs.event("serve.commit_failed", request_id=rid,
                       tenant=tenant, error=repr(e))
@@ -953,13 +1023,32 @@ class Service:
         }
         if fused:
             books["fused"] = True
+        if pending.ctx is not None:
+            # The durable half of the causal chain: store --summarize
+            # --trace-id surfaces this books entry in the tree.
+            books["trace_id"] = pending.ctx.trace_id
         self._append_books(tenant, "serve.request", books)
+        if pending.ctx is not None and self._tr.recording:
+            # Flush the commit span itself to the obs store: the
+            # engine's run-report delta was appended BEFORE the span
+            # above closed, so without this tail append the durable
+            # chain would stop at the release — one cursor-delta entry
+            # completes admission-through-commit for --trace-id.
+            from pipelinedp_tpu.obs import store as obs_store
+            obs_store.maybe_append_run_report("serve.commit")
         obs.inc("serve.requests_served")
+        obs.metrics.observe(
+            "serve.request_seconds", wall_s,
+            help="end-to-end serve request wall seconds")
+        self._push_tenant_state()
+        self._push_occupancy()
         obs_monitor.unregister_request(rid)
         pending.finish("response", ServeResponse(
             request_id=rid, tenant=tenant, results=results,
             remaining=remaining, warm=warm, signature=signature,
-            wall_s=wall_s, audit=audit_record))
+            wall_s=wall_s, audit=audit_record,
+            trace_id=(pending.ctx.trace_id
+                      if pending.ctx is not None else None)))
 
     def _execute_tune(self, pending: "_Pending", signature: str) -> None:
         """Serve one ``kind="tune"`` request: contribution histograms +
@@ -1087,15 +1176,98 @@ class Service:
             "remaining_delta": remaining.delta,
             "audit": audit_record,
         }
+        if pending.ctx is not None:
+            books["trace_id"] = pending.ctx.trace_id
         self._append_books(tenant, "serve.request", books)
         obs.inc("serve.requests_served")
         obs.inc("serve.tunes_served")
+        obs.metrics.observe(
+            "serve.request_seconds", wall_s,
+            help="end-to-end serve request wall seconds")
+        self._push_occupancy()
         obs_monitor.unregister_request(rid)
         pending.finish("response", ServeResponse(
             request_id=rid, tenant=tenant,
             results=[("tune", tune_result)],
             remaining=remaining, warm=warm, signature=signature,
-            wall_s=wall_s, audit=audit_record))
+            wall_s=wall_s, audit=audit_record,
+            trace_id=(pending.ctx.trace_id
+                      if pending.ctx is not None else None)))
+
+    # --- the metrics plane (obs/metrics.py + heartbeat tenants) ---
+
+    def _push_occupancy(self) -> None:
+        """Serve occupancy gauges for ``/metrics``: queue depth,
+        admitted-in-flight count, and fusion bucket fill. Pushed at
+        admission and at every completion — cheap last-write-wins
+        writes, recorded whether or not the endpoint is on (the
+        always-on counter discipline)."""
+        from pipelinedp_tpu.obs import metrics
+        metrics.set_gauge("serve.queue_depth", float(self._q.qsize()),
+                          help="serve queue depth (pendings + fused "
+                          "batches)")
+        with self._admit:
+            inflight = sum(self._inflight.values())
+        metrics.set_gauge("serve.inflight", float(inflight),
+                          help="requests admitted and not yet finished")
+        if self._fuser is not None:
+            try:
+                snap = self._fuser.snapshot()
+            except Exception:
+                return
+            metrics.set_gauge("serve.fusion_queued",
+                              float(snap.get("queued", 0)),
+                              help="requests waiting in open fusion "
+                              "windows")
+            for label, b in (snap.get("buckets") or {}).items():
+                metrics.set_gauge("serve.fusion_bucket_fill",
+                                  float(b.get("queued", 0)),
+                                  help="per-bucket fusion window fill",
+                                  bucket=label)
+
+    def _push_tenant_state(self) -> None:
+        """Per-tenant budget gauges for ``/metrics`` plus the
+        heartbeat's ``tenants`` section, both fed by the durable
+        ledger's :meth:`TenantBudgetLedger.overview`. Burn rate is
+        committed epsilon over service uptime on the injectable clock
+        — the metrics plane never reads wall time itself. Never takes
+        a request down."""
+        from pipelinedp_tpu.obs import metrics
+        from pipelinedp_tpu.obs import monitor as obs_monitor
+        try:
+            overview = self.budgets.overview()
+        except Exception:
+            return
+        uptime = max(self._clock.monotonic() - self._t0, 1e-9)
+        with self._admit:
+            inflight = dict(self._inflight)
+        tenants_hb: Dict[str, Any] = {}
+        for tenant, info in overview.items():
+            metrics.set_gauge("tenant.epsilon_remaining",
+                              info["remaining_epsilon"],
+                              help="tenant budget epsilon remaining",
+                              tenant=tenant)
+            metrics.set_gauge("tenant.delta_remaining",
+                              info["remaining_delta"],
+                              help="tenant budget delta remaining",
+                              tenant=tenant)
+            metrics.set_gauge("tenant.reserves_in_flight",
+                              float(info["reserves_in_flight"]),
+                              help="durable reserves neither committed "
+                              "nor released",
+                              tenant=tenant)
+            metrics.set_gauge("tenant.epsilon_burn_per_s",
+                              info["committed_epsilon"] / uptime,
+                              help="committed epsilon per uptime second",
+                              tenant=tenant)
+            tenants_hb[tenant] = {
+                "epsilon_remaining": info["remaining_epsilon"],
+                "delta_remaining": info["remaining_delta"],
+                "reserves_in_flight": info["reserves_in_flight"],
+                "committed_epsilon": info["committed_epsilon"],
+                "inflight": int(inflight.get(tenant, 0)),
+            }
+        obs_monitor.update_tenants(tenants_hb or None)
 
     # --- per-tenant books ---
 

@@ -442,9 +442,9 @@ class TestSchemaToleranceV1V2V3:
     def test_entries_and_last_known_good(self, tmp_path):
         s, fp = _mixed_schema_store(tmp_path)
         entries = s.entries()
-        assert [e["schema_version"] for e in entries] == [1, 2, 5]
+        assert [e["schema_version"] for e in entries] == [1, 2, 6]
         lkg = s.last_known_good("run_report", fp)
-        assert lkg["schema_version"] == 5
+        assert lkg["schema_version"] == 6
 
     def test_summarize_mixes_all_schemas(self, tmp_path):
         s, fp = _mixed_schema_store(tmp_path)
@@ -598,7 +598,7 @@ class TestAcceptanceEndToEnd:
         monkeypatch.setenv("PIPELINEDP_TPU_STREAM_CHUNK", "983")
         run_streamed()
         report = obs.build_run_report()
-        assert report["schema_version"] == 5
+        assert report["schema_version"] == 6
         dc = report["device_costs"]
         assert len(dc["programs"]) >= 1
         assert dc["device_kind"], "device kind not captured"

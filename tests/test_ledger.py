@@ -65,7 +65,7 @@ class TestStoreCore:
         fp = obs_store.fingerprint_key(ENV_A)
         entry = s.append("m1", {"record": {"value": 100}}, env=ENV_A)
         assert entry["fingerprint"] == fp
-        assert entry["schema_version"] == obs.SCHEMA_VERSION == 5
+        assert entry["schema_version"] == obs.SCHEMA_VERSION == 6
         got = s.entries()
         assert len(got) == 1
         assert got[0]["payload"]["record"]["value"] == 100
@@ -93,7 +93,7 @@ class TestStoreCore:
                                 "payload": {}}) + "\n")
         s.append("new", {"record": {"value": 9}}, env=ENV_A)
         entries = s.entries()
-        assert [e["schema_version"] for e in entries] == [1, 1, 5]
+        assert [e["schema_version"] for e in entries] == [1, 1, 6]
         assert all(e["degraded"] is False for e in entries)
         lkg = s.last_known_good("old", fp)
         assert lkg is not None and (
@@ -334,7 +334,7 @@ class TestAuditSection:
                    if e["name"] == "engine.aggregate"]
         assert entries, "traced engine run did not append to the store"
         report = entries[-1]["payload"]["run_report"]
-        assert report["schema_version"] == 5
+        assert report["schema_version"] == 6
         mechs = report["privacy"]["accountants"][0]["mechanisms"]
         assert all("eps" in m and "delta" in m and
                    "noise_standard_deviation" in m for m in mechs)
@@ -394,7 +394,7 @@ class TestBenchCompareAcceptance:
         # Run 1: records + run report land in the store.
         bench.reset_run_state()
         rec1, rep1 = bench_one_run(bench)
-        assert rep1["schema_version"] == 5
+        assert rep1["schema_version"] == 6
         mechs = rep1["privacy"]["accountants"][0]["mechanisms"]
         assert mechs and all(
             "eps" in m and "delta" in m and

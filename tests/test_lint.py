@@ -36,7 +36,8 @@ LEGACY_MAKE_LINTS = {"nosleep", "nofoldin", "nostager", "noperf",
                      "noartifacts", "nocost", "noknobs", "nopallas",
                      "noserve"}
 NEW_ANALYSES = {"rng-purity", "blocking-under-lock", "jit-staticness",
-                "fusion-masking", "sketch-confinement"}
+                "fusion-masking", "sketch-confinement",
+                "socket-confinement"}
 
 
 def findings_for(rule_id, source, rel):
@@ -216,6 +217,25 @@ FIXTURES = {
                   "def shard_of(key, n):\n"
                   "    return stable_hash_any(key) % n\n",
                   "pipelinedp_tpu/streaming.py"),
+    },
+    "socket-confinement": {
+        # A second wire surface growing outside obs/http.py: any raw
+        # socket / http.server / socketserver import elsewhere means
+        # an accept-loop lifecycle the serve drain discipline cannot
+        # see.
+        "bad": ("import socket\n"
+                "from http.server import HTTPServer\n\n"
+                "def listen(port):\n"
+                "    return HTTPServer(('', port), None)\n",
+                "pipelinedp_tpu/serve/service.py"),
+        # Client-side stdlib stays free (urllib is how tests scrape
+        # the endpoint), and prose mentions never trip the AST rule.
+        "clean": ("import urllib.request\n\n\n"
+                  "def scrape(url):\n"
+                  "    # docs may mention http.server freely\n"
+                  "    with urllib.request.urlopen(url) as r:\n"
+                  "        return r.read()\n",
+                  "pipelinedp_tpu/serve/service.py"),
     },
     "jit-staticness": {
         # PR 9's shape-blind knob-read bug class: ambient reads frozen

@@ -426,7 +426,7 @@ class TestRunReport:
         obs.inc("retry.attempts", 2)
         obs.event("health.degraded", target="cpu_platform")
         report = obs.build_run_report(extra={"note": "t"})
-        assert report["schema_version"] == obs.SCHEMA_VERSION == 5
+        assert report["schema_version"] == obs.SCHEMA_VERSION == 6
         assert report["counters"]["retry.attempts"] == 2
         assert report["spans"]["phase"]["count"] == 1
         assert any(e["name"] == "health.degraded"

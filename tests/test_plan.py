@@ -201,7 +201,7 @@ class TestPlanFile:
         assert applied["stream_chunk_rows"]["source"] == "default"
         # ... and the run report grows the schema-v4 plan section.
         report = obs.build_run_report()
-        assert report["schema_version"] == 5
+        assert report["schema_version"] == 6
         assert report["plan"]["knobs"]["subhist_byte_cap"] == {
             "value": 12345678, "source": "plan"}
         assert report["plan"]["plan_hash"] == resolved.plan_hash

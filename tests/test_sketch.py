@@ -306,7 +306,7 @@ class TestEndToEnd:
         out, _ = _run(JaxBackend(rng_seed=7), _string_dataset(),
                       _params(), _keep_all_sketch())
         rep = obs.build_run_report()
-        assert rep["schema_version"] == 5
+        assert rep["schema_version"] == 6
         runs = rep["sketch"]["runs"]
         assert len(runs) == 1
         rec = runs[0]
