@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast bench smoke multichip lint lintcheck dev clean faultcheck chaoscheck nosleep perfcheck nofoldin obscheck noperf nostager ledgercheck noartifacts watchcheck costcheck nocost plancheck noknobs kernelcheck nopallas servecheck noserve fusecheck fusionmask sketchcheck nosketchhash veccheck sweepcheck metricscheck
+.PHONY: test test-fast bench smoke multichip lint lintcheck dev clean faultcheck chaoscheck nosleep perfcheck nofoldin obscheck noperf nostager ledgercheck noartifacts watchcheck costcheck nocost plancheck noknobs kernelcheck nopallas servecheck noserve fusecheck fusionmask sketchcheck nosketchhash veccheck sweepcheck metricscheck topocheck
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -46,8 +46,10 @@ chaoscheck:
 # pass-B sweep suite (planner invariants, multi-tile-vs-per-tile
 # bit-parity, hybrid prefix cache, pass-B fault drain) and the
 # sketch-first suite (sketchcheck: the ingest ring's third consumer,
-# with its own kill-mid-stream drain proof).
-perfcheck: sketchcheck veccheck sweepcheck
+# with its own kill-mid-stream drain proof) and the topology suite
+# (topocheck: hier-vs-flat bit-parity + the collective-confinement
+# lint).
+perfcheck: sketchcheck veccheck sweepcheck topocheck
 	$(PYTHON) -m pipelinedp_tpu.lint --rule nosleep --rule nofoldin \
 	  --rule nostager --rule nopallas
 	$(PYTHON) -m pytest tests/test_ingest.py tests/test_faults.py \
@@ -138,6 +140,16 @@ sketchcheck: nosketchhash
 
 nosketchhash:
 	$(PYTHON) -m pipelinedp_tpu.lint --rule sketch-confinement
+
+# Topology-aware collectives suite (ISSUE 20): hier-vs-flat release
+# bit-parity (single device, 8-device mesh, simulated hosts), the
+# sharded-vs-single-device sketch parity, elastic shrink under hier,
+# the comms byte counters — plus the collective-confinement lint
+# (raw psum/psum_scatter/all_gather confined to parallel/sharded.py,
+# the one seam carrying the parity contract and the byte meter).
+topocheck:
+	$(PYTHON) -m pipelinedp_tpu.lint --rule collective-confinement
+	$(PYTHON) -m pytest tests/test_topology.py -q
 
 # Observability acceptance suite: tracer thread-safety under a live
 # overlapped-ingest run, no-op-mode zero emission, bench-field parity

@@ -234,6 +234,20 @@ def kernel_backend_in_force():
         return "xla"
 
 
+def mesh_topology_in_force():
+    """The resolved ``mesh_topology`` knob (env > seam > plan >
+    default), stamped on every bench record so ``--compare`` can
+    refuse to gate a flat-exchange rate against a hierarchical
+    baseline (two different collective schedules — released values
+    are bit-identical by PARITY row 43, but the rate delta is a
+    topology difference, not a regression)."""
+    try:
+        from pipelinedp_tpu.parallel import sharded as psh
+        return psh.resolved_topology_mode()
+    except Exception:
+        return "flat"
+
+
 def emit(rec):
     """Log one record (with the env fingerprint, the plan provenance
     and the kernel backend merged) as JSON, and append it to the
@@ -241,6 +255,7 @@ def emit(rec):
     rec["env"] = env_fingerprint()
     rec.update(plan_provenance())
     rec.setdefault("kernel_backend", kernel_backend_in_force())
+    rec.setdefault("mesh_topology", mesh_topology_in_force())
     log(json.dumps(rec))
     _RUN_RECORDS.append(rec)
     _bench_ledger().append(rec["metric"], {"record": rec})
@@ -1013,6 +1028,137 @@ def bench_kernel_backend_compare(n_rows, smoke=False):
     return rec
 
 
+def bench_mesh_topology_compare(n_rows, smoke=False):
+    """One-process A/B of the ``mesh_topology`` knob on the 8-device
+    CPU mesh: the same fused aggregation (count/sum/percentiles, same
+    data, same seed) runs once over a ``flat`` mesh and once over a
+    ``hier`` mesh with two SIMULATED hosts (``PIPELINEDP_TPU_MESH_
+    HOSTS=2`` — the flat leg keeps the same host split, so its
+    single-stage exchange bytes are attributed to DCN and the byte
+    comparison is apples-to-apples). Released values are cross-checked
+    BIT-FOR-BIT (the knob's dp-safety, PARITY row 43) and the analytic
+    ``comms.dcn_bytes``/``comms.ici_bytes`` deltas of each side's cold
+    (tracing) run are embedded. On the CPU proxy both topologies run in
+    the same wall-clock class — the record's point is the byte
+    asymmetry (``dcn_hier < dcn_flat``) plus the parity stamp, the
+    evidence a real 2-host slice gates its topology choice on."""
+    import jax
+
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu import obs
+    from pipelinedp_tpu.backends import JaxBackend
+    from pipelinedp_tpu.parallel import sharded as psh
+    from pipelinedp_tpu.plan import knobs as plan_knobs
+
+    if len(jax.devices()) < 8:
+        log("## mesh_topology compare SKIPPED (needs an 8-device mesh)")
+        return None
+    parts = 60 if smoke else 600
+    ds = zipf_dataset(n_rows, max(n_rows // 20, 1_000), parts, seed=29)
+    params = pdp.AggregateParams(
+        metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM,
+                 pdp.Metrics.PERCENTILE(50),
+                 pdp.Metrics.PERCENTILE(90)],
+        noise_kind=pdp.NoiseKind.LAPLACE,
+        max_partitions_contributed=4, max_contributions_per_partition=2,
+        min_value=0.0, max_value=10.0)
+
+    def one(mesh):
+        ds.invalidate_cache()
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                        total_delta=1e-6)
+        engine = pdp.DPEngine(acc, JaxBackend(mesh=mesh, rng_seed=0))
+        res = engine.aggregate(ds, params, pdp.DataExtractors())
+        acc.compute_budgets()
+        with tracer().span("bench.mesh_topology", cat="bench") as sp:
+            out = dict(res)
+        return out, sp.duration
+
+    spec = plan_knobs.BY_NAME["mesh_topology"]
+    prev_topo = os.environ.get(spec.env_var)
+    prev_hosts = os.environ.get(psh._MESH_HOSTS_ENV)
+    sides, outputs = {}, {}
+    try:
+        os.environ[psh._MESH_HOSTS_ENV] = "2"
+        for mode in ("flat", "hier"):
+            # ENV pin, the top of the precedence chain — a plan file
+            # must not flip one leg (run_autotune's isolation trap).
+            os.environ[spec.env_var] = mode
+            mesh = psh.make_mesh(8)
+            topo = psh.topology_of(mesh)
+            # The comms meter records at TRACE time: diff the counters
+            # around the cold run (obs.reset() would erase the wider
+            # bench run's spans, so diff instead of reset).
+            before = dict(obs.ledger().snapshot()["counters"])
+            out, cold_dt = one(mesh)
+            after = dict(obs.ledger().snapshot()["counters"])
+            _, warm_dt = one(mesh)
+
+            def delta(name):
+                return after.get(name, 0) - before.get(name, 0)
+
+            sides[mode] = {
+                "rows_per_s": round(n_rows / warm_dt),
+                "warm_s": round(warm_dt, 3),
+                "cold_s": round(cold_dt, 3),
+                "topology": {"mode": topo.mode, "hosts": topo.n_hosts,
+                             "per_host": topo.per_host,
+                             "simulated_hosts": topo.simulated},
+                "dcn_bytes": delta("comms.dcn_bytes"),
+                "ici_bytes": delta("comms.ici_bytes"),
+                "collectives": delta("comms.collectives"),
+            }
+            outputs[mode] = out
+    finally:
+        for var, prev in ((spec.env_var, prev_topo),
+                          (psh._MESH_HOSTS_ENV, prev_hosts)):
+            if prev is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = prev
+    parity = (set(outputs["flat"]) == set(outputs["hier"]) and all(
+        outputs["flat"][k] == outputs["hier"][k]
+        for k in outputs["flat"]))
+    if not parity:
+        log("## MESH TOPOLOGY PARITY MISMATCH (hier vs flat)")
+    dcn_flat = sides["flat"]["dcn_bytes"]
+    dcn_hier = sides["hier"]["dcn_bytes"]
+    dcn_ok = dcn_flat > 0 and 0 < dcn_hier < dcn_flat
+    if not dcn_ok:
+        log(f"## mesh_topology compare: DCN byte asymmetry NOT "
+            f"witnessed (flat={dcn_flat}, hier={dcn_hier} — a cached "
+            f"trace records no bytes)")
+    rec = {
+        "metric": "mesh_topology_compare",
+        "rows": n_rows,
+        "partitions": parts,
+        "devices": 8,
+        "simulated_hosts": 2,
+        "topologies": sides,
+        "hier_vs_flat": round(
+            sides["hier"]["rows_per_s"] /
+            max(sides["flat"]["rows_per_s"], 1), 3),
+        "dcn_bytes_flat": dcn_flat,
+        "dcn_bytes_hier": dcn_hier,
+        "dcn_reduction": (round(1.0 - dcn_hier / dcn_flat, 3)
+                          if dcn_flat > 0 else None),
+        "dcn_asymmetry": "ok" if dcn_ok else "NOT_WITNESSED",
+        "parity": "ok" if parity else "MISMATCH",
+        # This record ran BOTH topologies; the stamp must not claim
+        # one (the kernel_backend_compare convention).
+        "mesh_topology": "both",
+    }
+    log(f"## mesh_topology compare [{n_rows} rows x {parts} parts, "
+        f"8 devices / 2 simulated hosts]: flat "
+        f"{sides['flat']['rows_per_s']} vs hier "
+        f"{sides['hier']['rows_per_s']} rows/s "
+        f"({rec['hier_vs_flat']}x); dcn bytes {dcn_flat} -> "
+        f"{dcn_hier} ({rec['dcn_reduction']} reduction); parity "
+        f"{rec['parity']}")
+    emit(rec)
+    return rec
+
+
 def bench_dp_vector_sum(n_rows, smoke=False):
     """``dp_vector_sum_rows_per_sec``: VECTOR_SUM at MXU-facing widths
     D in {64, 256, 1024}, streamed through the ingest ring under the
@@ -1586,12 +1732,12 @@ def bench_dp_heavy_hitters(n_rows, smoke=False):
         width=(1 << 12) if smoke else (1 << 16), depth=2,
         candidate_cap=256 if smoke else 2048)
 
-    def one(seed):
+    def one(seed, mesh=None):
         ds = pdp.ArrayDataset(privacy_ids=pids, partition_keys=keys,
                               values=vals)
         acc = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
                                         total_delta=1e-6)
-        engine = pdp.DPEngine(acc, JaxBackend(rng_seed=seed))
+        engine = pdp.DPEngine(acc, JaxBackend(rng_seed=seed, mesh=mesh))
         res = engine.aggregate(ds, params, pdp.DataExtractors(),
                                sketch_first=sketch)
         acc.compute_budgets()
@@ -1600,6 +1746,7 @@ def bench_dp_heavy_hitters(n_rows, smoke=False):
         return out, sp.duration, (res.timings or {})
 
     out, cold_dt, cold_timings = one(31)  # cold: XLA compiles inside
+    single_out31 = out  # seed-31 release: the sharded parity anchor
     best = (out, cold_dt, cold_timings)
     for r in range(2):
         trial = one(31 + r)
@@ -1645,6 +1792,64 @@ def bench_dp_heavy_hitters(n_rows, smoke=False):
         f"{warm_dt:.2f}s warm ({rec['value']} rows/s), top50 recall "
         f"{recall:.2f}")
     emit(rec)
+
+    # Sharded variant: the same workload with the sketch phase's chunk
+    # row axis sharded over the 8-device mesh (sketch/engine.py streams
+    # through ``sharded_sketch_chunk_program`` — the phase-1 ceiling
+    # removal), exact pass riding the same mesh. The sketch totals are
+    # exact integers combined through the one exchange policy, so the
+    # candidate FUNNEL must match the single-device seed-31 run
+    # exactly: same candidate count, same released-partition set.
+    # Released VALUES are compared per the mesh contract (tolerance,
+    # not bits): per-device contribution bounding keeps a different —
+    # equally valid — subset of each user's contributions at tight
+    # bounds, so mesh-vs-single values are layout-dependent. Bit
+    # parity is the hier-vs-flat guarantee, not mesh-vs-single.
+    import jax
+
+    from pipelinedp_tpu.parallel import sharded as psh
+    if len(jax.devices()) >= 8:
+        mesh = psh.make_mesh(8)
+        sh_best = one(31, mesh=mesh)         # cold (compiles inside)
+        sh_cold_dt = sh_best[1]
+        trial = one(31, mesh=mesh)           # warm
+        if trial[1] < sh_best[1]:
+            sh_best = trial
+        sh_out, sh_warm_dt, sh_timings = sh_best
+        sh_parity = (
+            set(sh_out) == set(single_out31)
+            and sh_timings.get("sketch_candidates") ==
+            cold_timings.get("sketch_candidates"))
+        if not sh_parity:
+            log("## DP HEAVY HITTERS SHARDED FUNNEL MISMATCH "
+                "(8-device sketch vs single device: candidate count "
+                "or released set diverged)")
+        sh_rec = {
+            "metric": "dp_heavy_hitters_sharded_rows_per_sec",
+            "value": round(n_rows / sh_warm_dt),
+            "unit": "rows/s",
+            "rows": n_rows,
+            "devices": 8,
+            "sketch_topology": psh.topology_of(mesh).mode,
+            "sketch_width": sketch.resolved_width(),
+            "sketch_depth": sketch.resolved_depth(),
+            "candidates": sh_timings.get("sketch_candidates"),
+            "released_partitions": len(sh_out),
+            "warm_s": round(sh_warm_dt, 3),
+            "cold_s": round(sh_cold_dt, 3),
+            "sketch_accumulate_s": round(
+                sh_timings.get("sketch_accumulate_s", 0.0), 3),
+            "parity": "ok" if sh_parity else "MISMATCH",
+            "single_device_rows_per_s": rec["value"],
+        }
+        log(f"## dp_heavy_hitters sharded: {n_rows} rows over 8 "
+            f"devices in {sh_warm_dt:.2f}s warm "
+            f"({sh_rec['value']} rows/s vs {rec['value']} single), "
+            f"parity {sh_rec['parity']}")
+        emit(sh_rec)
+    else:
+        log("## dp_heavy_hitters sharded variant SKIPPED "
+            "(needs an 8-device mesh)")
     return rec
 
 
@@ -2167,6 +2372,7 @@ def compare_to_baseline(records=None, run_report=None, threshold=0.10):
     fusion_mismatches = 0
     accumulator_mismatches = 0
     sweep_batch_mismatches = 0
+    topology_mismatches = 0
     cur_plan = plan_provenance()
     cur_backend = kernel_backend_in_force()
     # One comparison per metric, at its BEST value this run — the same
@@ -2260,6 +2466,29 @@ def compare_to_baseline(records=None, run_report=None, threshold=0.10):
             log(f"## compare: kernel-backend mismatch on "
                 f"{rec['metric']} (baseline {base_backend}, this run "
                 f"{rec_backend}) — not gated")
+            rates.append(entry)
+            continue
+        # Mesh-topology gate (the kernel_backend refusal's twin): a
+        # flat-exchange rate gated against a hierarchical baseline (or
+        # vice versa) compares two different collective schedules —
+        # released values are bit-identical (PARITY row 43), but the
+        # rate delta is a topology difference, not a regression.
+        # Absent fields on old records read as "flat" (the pre-knob
+        # behavior), so flat-vs-old keeps gating exactly as before.
+        base_topo = base_rec.get("mesh_topology", "flat")
+        rec_topo = rec.get("mesh_topology", "flat")
+        if base_topo != rec_topo:
+            topology_mismatches += 1
+            entry["mesh_topology_mismatch"] = True
+            entry["baseline_mesh_topology"] = base_topo
+            obs.inc("bench.compare_mesh_topology_mismatch")
+            obs.event("bench.compare_mesh_topology_mismatch",
+                      metric=rec["metric"],
+                      baseline_topology=base_topo,
+                      current_topology=rec_topo)
+            log(f"## compare: mesh-topology mismatch on "
+                f"{rec['metric']} (baseline {base_topo}, this run "
+                f"{rec_topo}) — not gated")
             rates.append(entry)
             continue
         # Vector-accumulator gate (the kernel_backend refusal's twin,
@@ -2361,6 +2590,7 @@ def compare_to_baseline(records=None, run_report=None, threshold=0.10):
             "vector_accumulator_mismatches": accumulator_mismatches,
             "fusion_mismatches": fusion_mismatches,
             "sweep_config_batch_mismatches": sweep_batch_mismatches,
+            "mesh_topology_mismatches": topology_mismatches,
             "kernel_backend": cur_backend,
             "plan": cur_plan,
             "regressed": regressed}
@@ -2410,12 +2640,20 @@ def compare_verdict_line(regressions):
                 "(a different dispatch regime of the same "
                 "bit-identical kernel); re-baseline with matching "
                 "widths before gating")
+    if regressions.get("mesh_topology_mismatches"):
+        return (f"COMPARE: mesh-topology mismatch — "
+                f"{regressions['mesh_topology_mismatches']} rate(s) "
+                "not gated: this run ran a different mesh_topology "
+                "(flat vs hier — a different collective schedule of "
+                "the same bit-identical release) than its baseline; "
+                "re-baseline with matching topologies before gating")
     n_based = sum(1 for r in regressions["rates"]
                   if r.get("baseline") is not None and
                   not r.get("plan_mismatch") and
                   not r.get("kernel_backend_mismatch") and
                   not r.get("fusion_mismatch") and
-                  not r.get("sweep_config_batch_mismatch"))
+                  not r.get("sweep_config_batch_mismatch") and
+                  not r.get("mesh_topology_mismatch"))
     if n_based == 0:
         # Nothing was actually gated — say so, instead of an "on pace"
         # that reads as a passing verdict on a first run or a fresh
@@ -2632,6 +2870,12 @@ def main():
         # bit-parity cross-check in one record.
         bench_kernel_backend_compare(30_000 if args.smoke else 500_000,
                                      smoke=args.smoke)
+
+        # The mesh-topology A/B: flat vs hier on the 8-device mesh
+        # with two simulated hosts, same data, bit-parity
+        # cross-checked, dcn/ici byte counters embedded.
+        bench_mesh_topology_compare(30_000 if args.smoke else 500_000,
+                                    smoke=args.smoke)
 
         # Wide-D vector aggregation: VECTOR_SUM at D in {64,256,1024}
         # streamed through the ingest ring under the fx accumulator,

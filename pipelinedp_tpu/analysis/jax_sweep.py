@@ -82,6 +82,51 @@ _CHUNK_ROW_BUDGET = 1 << 26
 # through knobs.value().
 _SWEEP_CONFIG_BATCH = 0
 
+#: HBM byte budget the model-fitted chunk sizing targets — the f32
+#: byte equivalent of the static element budgets above (2^28 elements
+#: x 4 bytes). The fitted sweep-phase HBM peak scales the static width
+#: against this, never against a made-up capacity number.
+_SWEEP_HBM_BUDGET = 1 << 30
+
+
+def _lane_align(chunk: int) -> int:
+    """Round a config-axis width to the TPU lane grid: large chunks
+    down to a 128 multiple, small ones to a power of two (a chunk of
+    133 silently pads every broadcast to 256 lanes)."""
+    if chunk >= 128:
+        return (chunk // 128) * 128
+    if chunk > 1:
+        return 1 << (chunk.bit_length() - 1)
+    return 1
+
+
+def _plan_chunk(static_chunk: int, rows: int, partitions: int
+                ) -> Tuple[int, str]:
+    """(chunk, source) for ``sweep_config_batch=0``: when the current
+    plan carries a fitted sweep-phase HBM-peak sample for this shape
+    bucket (plan/model.py — measured at the STATIC width, so the
+    budget/peak ratio rescales that width directly), size the chunk as
+    ``static * budget/peak``; otherwise keep the static
+    widest-in-HBM-budget formula exactly (source "static" — cold start
+    and poisoned-history ledgers stay byte-identical to the pre-model
+    sizing, because an empty/foreign-fingerprint fit predicts None).
+    The sweep's bucket varies on (rows, partitions) only; quantiles=0
+    matches how autotune trials record sweep shapes."""
+    from pipelinedp_tpu.plan import planner as _planner
+    model = _planner.current_cost_model()
+    if model is None:
+        return static_chunk, "static"
+    try:
+        dk = jax.devices()[0].device_kind
+    except Exception:
+        dk = None
+    peak = model.predict_hbm_peak(dk, "sweep", rows, partitions, 0)
+    if not peak or peak <= 0:
+        return static_chunk, "static"
+    scaled = int(static_chunk * (_SWEEP_HBM_BUDGET / float(peak)))
+    chunk = _lane_align(int(np.clip(scaled, 1, _CHUNK_CAP)))
+    return chunk, "model"
+
 
 def sweep_is_supported(options: data_structures.UtilityAnalysisOptions,
                        data_extractors, return_per_partition: bool) -> bool:
@@ -715,8 +760,11 @@ def _sweep_chunk_sharded(metric_names, strategy, noise_kind, P, public,
                                      per_partition=per_partition)
         pp = _split_pp(out, metric_names) if per_partition else {}
         if multiproc:
+            from pipelinedp_tpu.parallel import sharded as psh
+            topo = psh.topology_of(mesh)
+
             def ag(x, dim):
-                return jax.lax.all_gather(x, axis, axis=dim, tiled=True)
+                return psh.gather_blocks(x, axis, dim=dim, topo=topo)
             out = jax.tree.map(lambda x: ag(x, 0), out)
             sel = jax.tree.map(lambda x: ag(x, 0), sel)
             pp = jax.tree.map(lambda x: ag(x, 1), pp)
@@ -1061,14 +1109,17 @@ class LazySweepResult:
                 1, _CHUNK_CAP))
             # Lane-align the config axis: every [n, Cc] / [P, Cc, w]
             # operand carries Cc in the TPU lane dimension, which tiles
-            # in units of 128 — a chunk of 133 silently pads every
-            # broadcast to 256 lanes (measured 6x on the 10k-config
-            # sweep). Large chunks round DOWN to a 128 multiple, small
-            # ones to a power of two.
-            if chunk >= 128:
-                chunk = (chunk // 128) * 128
-            elif chunk > 1:
-                chunk = 1 << (chunk.bit_length() - 1)
+            # in units of 128 (measured 6x on the 10k-config sweep).
+            chunk = _lane_align(chunk)
+            # Measured-peak refinement: a fitted plan model resolves
+            # chunk=0 through its sweep-phase HBM-peak sample instead
+            # of the static guess; no usable model keeps the static
+            # width bit-for-bit.
+            chunk, chunk_source = _plan_chunk(chunk, n_pad, P_pad)
+            from pipelinedp_tpu import obs as _obs
+            _obs.event("sweep.chunk_planned", chunk=int(chunk),
+                       source=chunk_source, rows=int(n_pad),
+                       partitions=int(P_pad))
         if n_dev > 1:
             # Sharded over the mesh: every device takes an equal slice of
             # the chunk's configuration axis.

@@ -1242,7 +1242,7 @@ def _selection_and_metrics(config: FusedConfig, num_partitions: int, part,
                            part_nseg, noise_scales, keep_table,
                            sel_threshold, sel_scale, sel_min_count,
                            sel_rows_per_uid, k_sel, k_noise, qrows=None,
-                           pk_axis=None, pk_axis_size=1):
+                           pk_axis=None, pk_axis_size=1, pk_topo=None):
     """Batched partition selection + metric noising.
 
     Single-chip: ``num_partitions`` is the full pk axis. Multi-chip
@@ -1326,7 +1326,8 @@ def _selection_and_metrics(config: FusedConfig, num_partitions: int, part,
         else:
             vals = _percentile_values_owned(config, P, qrows,
                                             noise_scales[-1], k_tree,
-                                            pk_axis, pk_axis_size)
+                                            pk_axis, pk_axis_size,
+                                            topo=pk_topo)
         for qi, name in enumerate(_percentile_field_names(
                 config.percentiles)):
             out[name] = vals[:, qi]
@@ -1798,19 +1799,25 @@ def _monotone_in_q(vals, quantiles):
 
 
 def _percentile_values_owned(config: FusedConfig, P_own, qrows, scale,
-                             key, axis, n_dev):
+                             key, axis, n_dev, topo=None):
     """The quantile descent with the partition axis SHARDED over the
     mesh: each device walks only its owned block of ``P_own`` partitions
     (global partition ``axis_index * P_own + i``).
 
-    Per level the collective protocol is: ``all_gather`` the owned walk
-    bases (small [P, Q] int32 — every device's rows may hit any
-    partition's walk), count children locally from this device's rows,
-    then ``psum_scatter`` the [P, Q, b] counts so each owner receives
-    exactly its block's totals — per-device ICI traffic O(P/n_dev·Q·b)
-    instead of the replicated psum's O(P·Q·b). Node noise is keyed by
-    GLOBAL partition index, so the mesh walk is bit-identical to the
-    single-chip walk given the same PRNG key."""
+    Per level the collective protocol is: gather the owned walk bases
+    (small [P, Q] int32 — every device's rows may hit any partition's
+    walk), count children locally from this device's rows, then
+    owner-scatter the [P, Q, b] counts so each owner receives exactly
+    its block's totals — per-device ICI traffic O(P/n_dev·Q·b)
+    instead of the replicated psum's O(P·Q·b). Both collectives go
+    through ``parallel.sharded``'s topology-aware helpers (``topo``
+    from the caller's mesh), so a hierarchical mesh keeps the scatter
+    stage on ICI. Node noise is keyed by GLOBAL partition index, so
+    the mesh walk is bit-identical to the single-chip walk given the
+    same PRNG key."""
+    # Lazy: parallel.sharded imports this module at module scope, and
+    # this path only traces under a mesh sharded.py itself set up.
+    from pipelinedp_tpu.parallel import sharded as psh
     qpk, leaf, kept = qrows
     b = quantile_tree_ops.DEFAULT_BRANCHING_FACTOR
     height = quantile_tree_ops.DEFAULT_TREE_HEIGHT
@@ -1830,8 +1837,8 @@ def _percentile_values_owned(config: FusedConfig, P_own, qrows, scale,
     for level in range(height):
         w = b**(height - 1 - level)
         base_own = leaf_lo // w  # [P_own, Q]
-        base = jax.lax.all_gather(base_own, axis, axis=0,
-                                  tiled=True)  # [P, Q]
+        base = psh.gather_blocks(base_own, axis, dim=0,
+                                 topo=topo)  # [P, Q]
         counts = []
         for q in range(Q):
             slot = leaf // w - base[:, q][qpk]
@@ -1840,9 +1847,9 @@ def _percentile_values_owned(config: FusedConfig, P_own, qrows, scale,
             counts.append(
                 jax.ops.segment_sum(ok.astype(jnp.int32), seg,
                                     num_segments=P * b).reshape(P, b))
-        raw = jax.lax.psum_scatter(jnp.stack(counts, axis=1), axis,
-                                   scatter_dimension=0,
-                                   tiled=True).astype(jnp.float32)
+        raw = psh.scatter_to_owner(jnp.stack(counts, axis=1), axis,
+                                   dim=0,
+                                   topo=topo).astype(jnp.float32)
         lo, hi, target, leaf_lo, done = _walk_level(
             config.noise_kind, key, scale, raw, base_own, level_offset,
             lo, hi, target, leaf_lo, done, b, w, pk_index=pk_index)

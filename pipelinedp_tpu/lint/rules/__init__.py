@@ -1,4 +1,4 @@
-"""The rule registry: 9 ported Makefile lints + 6 born-AST analyses.
+"""The rule registry: 9 ported Makefile lints + 7 born-AST analyses.
 
 Adding a rule: subclass :class:`~pipelinedp_tpu.lint.rules.base.Rule`
 in a module here, list it in :data:`ALL_RULE_CLASSES`, and add a
@@ -12,17 +12,17 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Sequence
 
 from pipelinedp_tpu.lint.rules.base import Rule
-from pipelinedp_tpu.lint.rules.confinement import (FusionMaskingRule,
-                                                   PORTED_RULES,
-                                                   SketchConfinementRule,
-                                                   SocketConfinementRule)
+from pipelinedp_tpu.lint.rules.confinement import (
+    CollectiveConfinementRule, FusionMaskingRule, PORTED_RULES,
+    SketchConfinementRule, SocketConfinementRule)
 from pipelinedp_tpu.lint.rules.jit_static import JitStaticnessRule
 from pipelinedp_tpu.lint.rules.locks import BlockingUnderLockRule
 from pipelinedp_tpu.lint.rules.rng_purity import RngPurityRule
 
 ALL_RULE_CLASSES = tuple(PORTED_RULES) + (
     RngPurityRule, BlockingUnderLockRule, JitStaticnessRule,
-    FusionMaskingRule, SketchConfinementRule, SocketConfinementRule)
+    FusionMaskingRule, SketchConfinementRule, SocketConfinementRule,
+    CollectiveConfinementRule)
 
 _REGISTRY: Dict[str, Rule] = {}
 for _cls in ALL_RULE_CLASSES:

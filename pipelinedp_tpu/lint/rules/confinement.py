@@ -488,6 +488,46 @@ class SocketConfinementRule(Rule):
             yield (line, hits[line])
 
 
+class CollectiveConfinementRule(Rule):
+    """Cross-device collectives (``psum`` / ``psum_scatter`` /
+    ``all_gather``) are confined to ``parallel/sharded.py``.
+
+    The topology layer holds three invariants at its one seam: the
+    exchange ORDER is fixed (hier-vs-flat bit-parity rests on both
+    paths reducing through the same deterministic trees — PARITY row
+    43), every exchange is byte-accounted (``comms.ici_bytes`` /
+    ``comms.dcn_bytes``), and the ``mesh_topology`` knob steers every
+    exchange. A raw ``jax.lax`` collective anywhere else is invisible
+    to all three: it ignores the topology (owner-block traffic back on
+    DCN at ICI cadence), skips the byte meter, and its reduction
+    grouping is outside the parity contract."""
+
+    id = "collective-confinement"
+    legacy_target = None  # born with `make topocheck`, never a grep
+    invariant = ("every cross-device collective goes through "
+                 "parallel/sharded.py's topology-aware helpers "
+                 "(combine_shards / gather_blocks / scatter_to_owner): "
+                 "ONE exchange seam carries the hier-vs-flat parity "
+                 "contract, the mesh_topology knob and the ici/dcn "
+                 "byte accounting")
+    fix_hint = ("call parallel.sharded.combine_shards / gather_blocks "
+                "/ scatter_to_owner (pass topology_of(mesh)) instead "
+                "of raw jax.lax psum/psum_scatter/all_gather")
+    blessed = ("pipelinedp_tpu/parallel/sharded.py",)
+    _COLLECTIVES = frozenset({"psum", "psum_scatter", "all_gather"})
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = terminal_name(node.func)
+            if name in self._COLLECTIVES:
+                yield (node.lineno,
+                       f"raw collective {name}() outside "
+                       "parallel/sharded.py — exchanges go through "
+                       "the topology-aware seam")
+
+
 PORTED_RULES = (NoSleepRule, NoFoldinRule, NoStagerRule, NoPerfRule,
                 NoArtifactsRule, NoCostRule, NoKnobsRule,
                 NoPallasRule, NoServeRule)

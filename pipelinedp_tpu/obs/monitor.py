@@ -571,6 +571,16 @@ class Monitor:
             # Elastic-recovery trail: the mesh re-formed mid-run
             # (old shape -> new shape, reason, reshard count).
             hb["mesh"] = mesh
+        if counters.get("comms.collectives"):
+            # Collective traffic estimate (parallel/sharded.py's
+            # per-traced-exchange byte accounting): how much of the
+            # exchange volume stays on ICI vs crossing DCN — the
+            # number the mesh_topology knob exists to move.
+            hb["comms"] = {
+                "collectives": counters.get("comms.collectives", 0),
+                "ici_bytes": counters.get("comms.ici_bytes", 0),
+                "dcn_bytes": counters.get("comms.dcn_bytes", 0),
+            }
         sweep = sweep_snapshot()
         if sweep is not None:
             # Megasweep progress: configs done vs planned + configs/s,

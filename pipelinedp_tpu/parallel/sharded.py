@@ -34,8 +34,10 @@ global device mesh.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
-from typing import Optional
+import os
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -58,8 +60,210 @@ import inspect
 _CHECK_KW = ("check_vma" if "check_vma" in inspect.signature(
     shard_map).parameters else "check_rep")
 
+# ---------------------------------------------------------------------------
+# Mesh topology: the two-axis ("dcn", "ici") view over the 1-D data axis
+# ---------------------------------------------------------------------------
 
-def combine_shards(x, axis, dim, replicate):
+#: Knob seam (plan/knobs.py "mesh_topology"): "flat" (one exchange over
+#: the whole axis — the historical default, cold start byte-identical),
+#: "hier" (two-stage ICI-then-DCN exchange) or "auto" (hier iff the
+#: mesh spans more than one host). Kept as a module constant purely as
+#: the registry's test seam — consumers go through knobs.value().
+_MESH_TOPOLOGY = "flat"
+
+#: Simulated host count for single-process meshes (tests/bench): splits
+#: the device list into N contiguous "hosts" so the hierarchical
+#: exchange — and the DCN byte attribution — can be exercised on the
+#: 8-device CPU proxy without a second process.
+_MESH_HOSTS_ENV = "PIPELINEDP_TPU_MESH_HOSTS"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshTopology:
+    """How a mesh's 1-D ``data`` axis maps onto the physical fabric.
+
+    Under ``hier`` the mesh's device order is INTERLEAVED: position
+    ``p = j * n_hosts + h`` holds host ``h``'s ``j``-th device. That
+    makes the per-host ("ici") groups the strided position sets
+    ``{p : p % n_hosts == h}`` and the cross-host ("dcn") groups the
+    contiguous runs ``[j * n_hosts, (j+1) * n_hosts)`` — and it is
+    exactly what makes the two-stage owner-block reduction land
+    position ``p`` on global block ``p``, the same owner mapping as
+    the flat single-stage ``psum_scatter`` (see
+    :func:`combine_shards`). It also means ``reform_mesh``'s
+    divisor-prefix shrink policy regroups survivors WITHIN their host
+    first: a prefix of the interleaved order is itself a valid
+    interleaved order at the same host count."""
+    mode: str            #: "flat" | "hier"
+    n_hosts: int
+    per_host: int
+    simulated: bool = False  #: hosts simulated via _MESH_HOSTS_ENV
+
+    @property
+    def hierarchical(self) -> bool:
+        """True when the two-stage exchange actually differs from the
+        flat one (both axes non-degenerate)."""
+        return (self.mode == "hier" and self.n_hosts > 1
+                and self.per_host > 1)
+
+    @property
+    def multi_host(self) -> bool:
+        return self.n_hosts > 1
+
+    @property
+    def n_devices(self) -> int:
+        return self.n_hosts * self.per_host
+
+
+def _flat_topology(n_devices: int, n_hosts: int = 1,
+                   simulated: bool = False) -> MeshTopology:
+    n_hosts = max(1, n_hosts)
+    return MeshTopology("flat", n_hosts,
+                        max(1, n_devices // n_hosts), simulated)
+
+
+#: Registered topology per mesh, keyed by the ORDERED global device-id
+#: tuple. The interleaved hier order differs from the flat order on
+#: every non-degenerate mesh, so distinct topologies produce distinct
+#: meshes (and distinct static jit signatures — a knob flip re-traces).
+#: Meshes built directly by tests (plain ``Mesh(...)``) are absent and
+#: fall back to flat: exactly the pre-topology behavior.
+_TOPOLOGIES: Dict[Tuple[int, ...], MeshTopology] = {}
+
+
+def _mesh_key(mesh: Mesh) -> Tuple[int, ...]:
+    return tuple(int(d.id) for d in mesh.devices.reshape(-1))
+
+
+def topology_of(mesh: Optional[Mesh]) -> MeshTopology:
+    """The topology registered for ``mesh`` at :func:`make_mesh` /
+    :func:`reform_mesh` time, or a flat fallback for meshes built
+    elsewhere (test back-compat: a plain ``Mesh`` behaves exactly as
+    before this layer existed)."""
+    if mesh is None:
+        return _flat_topology(1)
+    topo = _TOPOLOGIES.get(_mesh_key(mesh))
+    if topo is not None:
+        return topo
+    return _flat_topology(int(mesh.devices.size))
+
+
+def _host_groups(devices) -> Tuple[List[List], bool]:
+    """(device groups by host, simulated?). Real grouping is by
+    ``process_index`` (CPU proxy: processes are "hosts" — the same
+    boundary jax.distributed's collectives cross over DCN); the
+    ``PIPELINEDP_TPU_MESH_HOSTS`` env splits a single-process device
+    list into N contiguous simulated hosts instead, so the two-stage
+    exchange is testable in one process."""
+    raw = os.environ.get(_MESH_HOSTS_ENV, "")
+    if raw:
+        try:
+            n = int(raw)
+        except ValueError:
+            n = 0
+        if n > 1 and len(devices) % n == 0:
+            k = len(devices) // n
+            return [list(devices[h * k:(h + 1) * k])
+                    for h in range(n)], True
+    groups: Dict[int, List] = {}
+    for d in devices:
+        groups.setdefault(int(getattr(d, "process_index", 0)),
+                          []).append(d)
+    return [groups[pi] for pi in sorted(groups)], False
+
+
+def resolved_topology_mode() -> str:
+    """The ``mesh_topology`` knob in force (env > seam > plan >
+    default) — the string bench stamps on records."""
+    from pipelinedp_tpu.plan import knobs
+    return str(knobs.value("mesh_topology"))
+
+
+def _build_topology(devices) -> Tuple[List, MeshTopology]:
+    """(possibly reordered device list, topology) for a new mesh under
+    the resolved ``mesh_topology`` knob. ``hier`` interleaves the
+    device order (see :class:`MeshTopology`); unequal per-host counts
+    fall back to flat with a ``mesh.topology_fallback`` event —
+    the two-stage reduction needs a rectangular (dcn, ici) grid."""
+    from pipelinedp_tpu import obs
+    mode = resolved_topology_mode()
+    hosts, simulated = _host_groups(devices)
+    n_hosts = len(hosts)
+    if mode == "auto":
+        mode = "hier" if n_hosts > 1 else "flat"
+    if mode != "hier" or n_hosts <= 1:
+        return list(devices), _flat_topology(len(devices), n_hosts,
+                                             simulated)
+    sizes = {len(g) for g in hosts}
+    if len(sizes) != 1:
+        obs.event("mesh.topology_fallback", reason="ragged_hosts",
+                  hosts=n_hosts, sizes=sorted(sizes))
+        return list(devices), _flat_topology(len(devices), n_hosts,
+                                             simulated)
+    k = len(hosts[0])
+    order = [hosts[h][j] for j in range(k) for h in range(n_hosts)]
+    return order, MeshTopology("hier", n_hosts, k, simulated)
+
+
+def _register(mesh: Mesh, topo: MeshTopology) -> None:
+    _TOPOLOGIES[_mesh_key(mesh)] = topo
+
+
+def _ici_groups(topo: MeshTopology) -> List[List[int]]:
+    """One group per host: the strided positions of that host's
+    devices under the interleaved order (member index j = the device's
+    within-host slot)."""
+    H, k = topo.n_hosts, topo.per_host
+    return [[j * H + h for j in range(k)] for h in range(H)]
+
+
+def _dcn_groups(topo: MeshTopology) -> List[List[int]]:
+    """One group per within-host slot: the contiguous position run
+    ``[j*H, (j+1)*H)`` — exactly one device of every host (member
+    index h = the host)."""
+    H, k = topo.n_hosts, topo.per_host
+    return [[j * H + h for h in range(H)] for j in range(k)]
+
+
+# --- comms accounting -------------------------------------------------------
+
+def _payload_bytes(x) -> int:
+    try:
+        return int(x.size) * int(np.dtype(x.dtype).itemsize)
+    except Exception:
+        return 0
+
+
+def _record_exchange(kind: str, per_device_bytes: int, group_size: int,
+                     crosses_hosts: bool, n_groups: int = 1) -> None:
+    """Analytic byte estimate for one traced collective: a
+    reduce-scatter or all-gather of B per-device bytes over a group of
+    g moves ~B*(g-1) bytes per group (ring schedule); an all-reduce
+    (psum) moves twice that. A group that spans hosts is attributed
+    entirely to DCN, a within-host group entirely to ICI — the
+    attribution that makes ``dcn_bytes(hier) < dcn_bytes(flat)`` a
+    measured number on a multi-host mesh (and dcn_bytes == 0 on a true
+    single-host one).
+
+    Recorded at TRACE time, once per compiled exchange (warm re-
+    dispatches of a cached executable reuse the traced program): an
+    analytic estimate for the heartbeat/bench artifacts, not a
+    per-dispatch wire meter."""
+    if group_size <= 1:
+        return
+    per_group = per_device_bytes * (group_size - 1)
+    if kind == "psum":
+        per_group *= 2
+    total = per_group * max(1, n_groups)
+    from pipelinedp_tpu import obs
+    obs.inc("comms.collectives")
+    obs.inc("comms.dcn_bytes" if crosses_hosts else "comms.ici_bytes",
+            int(total))
+
+
+# --- the exchange policy ----------------------------------------------------
+
+def combine_shards(x, axis, dim, replicate, topo=None):
     """The ONE cross-shard exchange policy for every streaming kernel:
     owner-block ``psum_scatter`` along ``dim`` (state/ICI O(P/n_dev))
     when each device should keep only its owned partition block, a
@@ -67,11 +271,108 @@ def combine_shards(x, axis, dim, replicate):
     output must be host-addressable everywhere — multi-process meshes
     (another process's owner block is not host-addressable) and pass-B
     tile blocks (at most the sub-histogram byte cap by construction,
-    and ``psum`` has no divisibility constraint on the block size)."""
+    and ``psum`` has no divisibility constraint on the block size).
+
+    With a hierarchical ``topo`` the exchange splits into a fixed-order
+    two-stage reduction: an owner-block ``psum_scatter`` over each
+    host's ``ici`` group first, then one batch-boundary block exchange
+    over the ``dcn`` groups — per-host scatter traffic stays on ICI
+    and only ``1/per_host`` of the payload crosses DCN. Both stages
+    run XLA's deterministic fixed reduction tree per group, and every
+    payload this policy combines on the parity-tested paths is exact
+    integer data (packed int32 lane stacks, histograms, subtree
+    counts), so hier and flat land on BIT-IDENTICAL results — the
+    mesh_topology knob's dp-safety (PARITY row 43). The one documented
+    exception is the float32 ``vector_accumulator='f32'`` plane, whose
+    partial-sum grouping was already regroup-sensitive (use ``fx`` for
+    exactness — PARITY row 39).
+
+    Owner mapping under ``hier``: position ``p = j*H + h`` scatters to
+    ici-group member ``j`` (k-way block ``j``), then to dcn-group
+    member ``h`` (H-way sub-block ``h`` of block ``j``) — i.e. global
+    block ``j*H + h == p``, exactly the flat mapping."""
+    topo = topo if topo is not None else _flat_topology(1)
+    if not topo.hierarchical:
+        n_dev = topo.n_devices
+        if replicate:
+            _record_exchange("psum", _payload_bytes(x), n_dev,
+                             topo.multi_host)
+        else:
+            _record_exchange("reduce_scatter", _payload_bytes(x),
+                             n_dev, topo.multi_host)
+        if replicate:
+            return jax.lax.psum(x, axis)
+        return jax.lax.psum_scatter(x, axis, scatter_dimension=dim,
+                                    tiled=True)
+    H, k = topo.n_hosts, topo.per_host
+    ici, dcn = _ici_groups(topo), _dcn_groups(topo)
+    size = int(x.shape[dim])
+    bytes_in = _payload_bytes(x)
     if replicate:
-        return jax.lax.psum(x, axis)
-    return jax.lax.psum_scatter(x, axis, scatter_dimension=dim,
-                                tiled=True)
+        if size % k:
+            # The replicating psum has no divisibility constraint and
+            # the callers rely on that (pass-B tile blocks); a payload
+            # the ici split cannot tile keeps the flat exchange.
+            _record_exchange("psum", bytes_in, topo.n_devices, True)
+            return jax.lax.psum(x, axis)
+        # reduce-scatter on ICI, block all-reduce on DCN, all-gather
+        # back on ICI: the full payload crosses DCN only as 1/k blocks.
+        _record_exchange("reduce_scatter", bytes_in, k, False,
+                         n_groups=H)
+        y = jax.lax.psum_scatter(x, axis, scatter_dimension=dim,
+                                 axis_index_groups=ici, tiled=True)
+        _record_exchange("psum", bytes_in // k, H, True, n_groups=k)
+        y = jax.lax.psum(y, axis, axis_index_groups=dcn)
+        _record_exchange("all_gather", bytes_in // k, k, False,
+                         n_groups=H)
+        return jax.lax.all_gather(y, axis, axis=dim,
+                                  axis_index_groups=ici, tiled=True)
+    # Owner-block scatter: stage 1 within each host (ICI), stage 2
+    # across hosts (DCN) on the k-times-smaller blocks.
+    _record_exchange("reduce_scatter", bytes_in, k, False, n_groups=H)
+    y = jax.lax.psum_scatter(x, axis, scatter_dimension=dim,
+                             axis_index_groups=ici, tiled=True)
+    _record_exchange("reduce_scatter", bytes_in // k, H, True,
+                     n_groups=k)
+    return jax.lax.psum_scatter(y, axis, scatter_dimension=dim,
+                                axis_index_groups=dcn, tiled=True)
+
+
+def gather_blocks(x, axis, dim=0, topo=None):
+    """Hierarchy-aware ``all_gather`` along ``dim`` (tiled): the
+    reassembly dual of :func:`combine_shards`'s owner-block scatter,
+    used by the percentile walk's per-level base fetch and the
+    megasweep's multi-process output replication. Under ``hier`` the
+    small owner blocks cross DCN first (one device per host fetches
+    each foreign block once), then fan out within each host over ICI —
+    concatenation order is position order in both stages, so the
+    result is byte-identical to the flat gather."""
+    topo = topo if topo is not None else _flat_topology(1)
+    if not topo.hierarchical:
+        _record_exchange("all_gather", _payload_bytes(x),
+                         topo.n_devices, topo.multi_host)
+        return jax.lax.all_gather(x, axis, axis=dim, tiled=True)
+    H, k = topo.n_hosts, topo.per_host
+    bytes_in = _payload_bytes(x)
+    # DCN first: dcn group j's members hold blocks [j*H, (j+1)*H) —
+    # gathering over the contiguous group concatenates a contiguous
+    # global run. Then each host's ici group holds runs j=0..k-1 in
+    # member order; gathering concatenates them into the full axis.
+    _record_exchange("all_gather", bytes_in, H, True, n_groups=k)
+    y = jax.lax.all_gather(x, axis, axis=dim,
+                           axis_index_groups=_dcn_groups(topo),
+                           tiled=True)
+    _record_exchange("all_gather", bytes_in * H, k, False, n_groups=H)
+    return jax.lax.all_gather(y, axis, axis=dim,
+                              axis_index_groups=_ici_groups(topo),
+                              tiled=True)
+
+
+def scatter_to_owner(x, axis, dim=0, topo=None):
+    """Owner-block reduce-scatter along ``dim`` — :func:`combine_shards`
+    with ``replicate=False``, named for call sites (the walk's
+    per-level count exchange) that are always owner-sharded."""
+    return combine_shards(x, axis, dim, False, topo=topo)
 
 
 def make_mesh(n_devices: Optional[int] = None, axis_name: str = "data"
@@ -80,10 +381,15 @@ def make_mesh(n_devices: Optional[int] = None, axis_name: str = "data"
     devices = jax.devices()
     if n_devices is not None:
         devices = devices[:n_devices]
+    devices, topo = _build_topology(devices)
     obs.event("mesh.created", n_devices=len(devices),
               axis_name=axis_name,
-              platform=devices[0].platform if devices else None)
-    return Mesh(np.asarray(devices), (axis_name,))
+              platform=devices[0].platform if devices else None,
+              topology=topo.mode, hosts=topo.n_hosts,
+              per_host=topo.per_host, simulated_hosts=topo.simulated)
+    mesh = Mesh(np.asarray(devices), (axis_name,))
+    _register(mesh, topo)
+    return mesh
 
 
 def reform_mesh(mesh: Mesh, axis_name: Optional[str] = None
@@ -106,9 +412,20 @@ def reform_mesh(mesh: Mesh, axis_name: Optional[str] = None
     * single-controller mesh (a device dropped): keep the largest
       proper-divisor prefix of the device list — half, for the
       power-of-two meshes the replay guarantee already assumes.
+
+    Topology is preserved across the shrink: the interleaved ``hier``
+    order puts host ``h``'s ``j``-th device at position ``j*H + h``,
+    so a prefix whose length divides by the host count is itself a
+    valid ``hier`` interleave at the same host count — survivors
+    regroup WITHIN their host first (each host sheds its highest-slot
+    devices), and the two-stage exchange keeps working on the smaller
+    mesh. A prefix the host count does not divide — or a multi-process
+    death, where the survivor falls back to its own local (single-host)
+    devices — degrades to ``flat``.
     """
     from pipelinedp_tpu import obs
     axis_name = axis_name or mesh.axis_names[0]
+    old_topo = topology_of(mesh)
     old_n = int(mesh.devices.size)
     if getattr(mesh, "is_multi_process", False):
         devices = list(jax.local_devices())
@@ -120,11 +437,22 @@ def reform_mesh(mesh: Mesh, axis_name: Optional[str] = None
         devices = list(mesh.devices.reshape(-1)[:survivors])
     if not devices or len(devices) >= old_n:
         return None
+    if (old_topo.mode == "hier" and old_topo.n_hosts > 1
+            and not getattr(mesh, "is_multi_process", False)
+            and len(devices) % old_topo.n_hosts == 0):
+        new_topo = MeshTopology("hier", old_topo.n_hosts,
+                                len(devices) // old_topo.n_hosts,
+                                old_topo.simulated)
+    else:
+        new_topo = _flat_topology(len(devices))
     new = Mesh(np.asarray(devices), (axis_name,))
+    _register(new, new_topo)
     obs.inc("mesh.reformed")
     obs.event("mesh.reformed", old_devices=old_n,
               new_devices=int(new.devices.size), axis_name=axis_name,
-              platform=devices[0].platform)
+              platform=devices[0].platform,
+              topology=new_topo.mode, hosts=new_topo.n_hosts,
+              per_host=new_topo.per_host)
     return new
 
 
@@ -166,6 +494,7 @@ def _sharded_kernel(config, num_partitions, mesh, pid, pk, values, valid,
     the mesh size; outputs come back partition-sharded over the mesh."""
     axis = mesh.axis_names[0]
     n_dev = mesh.devices.size
+    topo = topology_of(mesh)
 
     def local_fn(pid, pk, values, valid, noise_scales, keep_table,
                  sel_threshold, sel_scale, sel_min_count,
@@ -186,10 +515,10 @@ def _sharded_kernel(config, num_partitions, mesh, pid, pk, values, valid,
             fx_bits, kernel_backend=kernel_backend)
         # Cross-chip exchange: each device keeps only the accumulator
         # block it owns (the percentile walk runs its own per-level
-        # all_gather + psum_scatter protocol internally).
+        # gather + owner-scatter protocol internally, with the same
+        # topology).
         def to_owner(x):
-            return jax.lax.psum_scatter(x, axis, scatter_dimension=0,
-                                        tiled=True)
+            return combine_shards(x, axis, 0, False, topo=topo)
 
         part = jax.tree.map(to_owner, part)
         part_nseg = to_owner(part_nseg)
@@ -197,7 +526,7 @@ def _sharded_kernel(config, num_partitions, mesh, pid, pk, values, valid,
             config, num_partitions // n_dev, part, part_nseg,
             noise_scales, keep_table, sel_threshold, sel_scale,
             sel_min_count, sel_rows_per_uid, k_sel, k_noise, qrows=qrows,
-            pk_axis=axis, pk_axis_size=n_dev)
+            pk_axis=axis, pk_axis_size=n_dev, pk_topo=topo)
 
     shard = PSpec(axis)
     repl = PSpec()

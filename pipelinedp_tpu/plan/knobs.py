@@ -246,6 +246,25 @@ REGISTRY: Tuple[KnobSpec, ...] = (
         "resolving the registry never imports sketch/ into non-sketch "
         "runs.", choices=("matmul", "xla")),
     KnobSpec(
+        "mesh_topology", "flat | hier | auto", "flat",
+        "PIPELINEDP_TPU_MESH_TOPOLOGY",
+        ("pipelinedp_tpu.parallel.sharded", "_MESH_TOPOLOGY"),
+        True, str,
+        "Cross-shard exchange layout (parallel/sharded.py): 'flat' "
+        "(one exchange over the whole device axis — the historical "
+        "default; cold start is byte-identical to pre-knob behavior), "
+        "'hier' (two-stage reduction: owner-block psum_scatter over "
+        "each host's ici group, then one batch-boundary block "
+        "exchange over the dcn groups — scatter traffic stays on ICI, "
+        "only 1/per_host of the payload crosses DCN) or 'auto' (hier "
+        "iff the mesh spans more than one host; CPU proxy: processes "
+        "are hosts, PIPELINEDP_TPU_MESH_HOSTS simulates hosts in one "
+        "process). dp-safe: both stages run fixed reduction trees over "
+        "exact-integer payloads, so hier and flat release bit-identical "
+        "values and kept sets (PARITY row 43); ragged host groups fall "
+        "back to flat with a mesh.topology_fallback event.",
+        choices=("flat", "hier", "auto")),
+    KnobSpec(
         "select_units_cap", "privacy units per partition", _I32_MAX,
         None, ("pipelinedp_tpu.streaming", "_SELECT_UNITS_CAP"),
         False, int,

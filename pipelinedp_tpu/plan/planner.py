@@ -263,6 +263,20 @@ def current_plan_knobs(shape: Optional[Dict[str, int]] = None
     return _knobs_from(plan, shape)
 
 
+def current_cost_model() -> Optional[model_mod.CostModel]:
+    """The current plan file's fitted :class:`model_mod.CostModel`, or
+    None when no valid plan is in force — the layer performance
+    consumers (the megasweep's HBM-aware chunk sizing) query for
+    measured-peak predictions. A plan whose history was poisoned
+    (degraded runs, foreign fingerprints) fits an EMPTY model whose
+    predictions are all None, so consumers degrade to their static
+    formulas, never to a bad fit."""
+    plan = load_plan()
+    if plan is None:
+        return None
+    return _plan_model(plan)
+
+
 class Resolved:
     """One request's resolved knob vector: ``values[name]`` and
     ``sources[name]`` (env / seam / plan / default), plus the plan
@@ -437,6 +451,12 @@ def autotune_candidates() -> list:
             # the argmin is a measured walked-vs-batched comparison.
             {"sweep_config_batch": 64},
             {"sweep_config_batch": 256},
+            # The hierarchical exchange: dp-safe (hier and flat are
+            # bit-identical, PARITY row 43). On a single-host trial
+            # the topology layer degrades to flat so this measures a
+            # no-op; on a multi-host (or simulated-hosts) bench box
+            # the argmin is a measured flat-vs-hier comparison.
+            {"mesh_topology": "hier"},
             # The sketch binner's scatter reference: dp-safe (PARITY
             # row 36) so it sweeps with the rest. Every autotune trial
             # dispatches a small sketch-first request with its

@@ -57,6 +57,9 @@ SCENARIO_NAMES = (
     "obs_endpoint",     # ServeKill under a live wire surface: the
                         # introspection endpoint answers mid-crash and
                         # drains with the service (no orphan listener)
+    "topo_kill",        # lose_device_chunks on a hier(2,2) mesh: the
+                        # elastic shrink regroups survivors within
+                        # their host, release stays bit-identical
 )
 
 
@@ -678,6 +681,54 @@ def _scenario_obs_endpoint(rng: random.Random, fx: _Fixtures,
            "pdp-obs-http accept thread survived Service.close")
 
 
+def _scenario_topo_kill(rng: random.Random, fx: _Fixtures,
+                        tmp: str) -> None:
+    """Device loss with the hierarchical topology in force: the mesh
+    comes up ``hier`` over two simulated hosts, a participant dies
+    mid-stream, ``reform_mesh`` regroups the survivors within their
+    host (the divisor prefix of the interleave keeps the topology),
+    and the resumed release is bit-identical to the clean FLAT
+    baseline at the surviving shape — the mesh_topology knob and
+    elastic shrink compose without touching released values."""
+    from pipelinedp_tpu import obs
+    from pipelinedp_tpu.parallel import sharded as psh
+    from pipelinedp_tpu.resilience import (CheckpointStore, FaultPlan,
+                                           injected_faults)
+    losses = (rng.randint(1, 2),)
+    ds, _ = fx.stream_ds()
+    params = fx.params("count_sum")
+    baseline = fx.baseline("count_sum", 2)  # flat clean run, 2 devices
+    store = CheckpointStore(os.path.join(tmp, "topo.ckpt"))
+    saved = {k: os.environ.get(k)
+             for k in ("PIPELINEDP_TPU_MESH_TOPOLOGY",
+                       psh._MESH_HOSTS_ENV)}
+    os.environ["PIPELINEDP_TPU_MESH_TOPOLOGY"] = "hier"
+    os.environ[psh._MESH_HOSTS_ENV] = "2"
+    try:
+        mesh = _make_mesh(4)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    _check(psh.topology_of(mesh).hierarchical,
+           "mesh did not come up hierarchical under the hier knob")
+    with injected_faults(FaultPlan(lose_device_chunks=losses)):
+        survived, timings = run_streamed(ds, params, mesh=mesh,
+                                         checkpoint=store)
+    _check(timings.get("stream_mesh_reshards") == 1,
+           f"expected 1 reshard, got "
+           f"{timings.get('stream_mesh_reshards')}")
+    reformed = [e for e in obs.ledger().snapshot()["events"]
+                if e["name"] == "mesh.reformed"]
+    _check(bool(reformed), "no mesh.reformed event recorded")
+    _check(reformed[-1]["topology"] == "hier"
+           and reformed[-1]["hosts"] == 2,
+           f"shrink lost the hier topology: {reformed[-1]}")
+    assert_bit_identical(baseline, survived, f"topo_kill@{losses}")
+
+
 _SCENARIOS: Dict[str, Callable[[random.Random, _Fixtures, str], None]] = {
     "stream_kill": _scenario_stream_kill,
     "device_loss": _scenario_device_loss,
@@ -689,13 +740,15 @@ _SCENARIOS: Dict[str, Callable[[random.Random, _Fixtures, str], None]] = {
     "sweep_kill": _scenario_sweep_kill,
     "torn_ledger": _scenario_torn_ledger,
     "obs_endpoint": _scenario_obs_endpoint,
+    "topo_kill": _scenario_topo_kill,
 }
 
 #: Scenarios whose plan is guaranteed to fire at least one fault (the
 #: hold/wedge scenarios record holds/wedges instead of raising).
 _EXPECT_INJECTED = {"stream_kill", "device_loss", "pass_b_kill",
                     "hold_wedge", "wedged_probe", "serve_kill",
-                    "sketch_kill", "sweep_kill", "obs_endpoint"}
+                    "sketch_kill", "sweep_kill", "obs_endpoint",
+                    "topo_kill"}
 
 
 def schedule_for(seed: int, n_schedules: int) -> List[Dict[str, Any]]:

@@ -93,17 +93,53 @@ sketch_chunk_program = instrumented_jit(
     phase="sketch", static_argnames=("width", "backend"))(_sketch_chunk)
 
 
-def pad_chunk(buckets: np.ndarray) -> np.ndarray:
+def pad_chunk(buckets: np.ndarray, n_shards: int = 1) -> np.ndarray:
     """Pad a [depth, n] host chunk to a ROW_BLOCK multiple with -1
     rows (matched by neither backend) so every chunk shares a jit
-    signature per (depth, padded-n) pair."""
+    signature per (depth, padded-n) pair. With ``n_shards`` > 1 the
+    padded length is a multiple of ``n_shards * ROW_BLOCK``, so every
+    mesh shard's row slice is itself ROW_BLOCK-aligned."""
     depth, n = buckets.shape
-    n_pad = max(-(-n // ROW_BLOCK) * ROW_BLOCK, ROW_BLOCK)
+    unit = ROW_BLOCK * max(1, int(n_shards))
+    n_pad = max(-(-n // unit) * unit, unit)
     if n_pad == n:
         return buckets
     out = np.full((depth, n_pad), -1, dtype=np.int32)
     out[:, :n] = buckets
     return out
+
+
+@instrumented_jit(phase="sketch", static_argnames=("width", "backend",
+                                                   "mesh"))
+def sharded_sketch_chunk_program(width: int, backend: str, mesh,
+                                 buckets):
+    """Mesh twin of ``sketch_chunk_program``: the chunk's row axis
+    shards over the mesh, each device bins its slice through the SAME
+    per-backend chunk body, and the local [depth, width] exact-integer
+    sketches combine through ``parallel.sharded``'s one exchange
+    policy (owner-block width scatter on a single-controller mesh —
+    width is a 256 multiple, so any power-of-two mesh tiles it — a
+    replicating psum on a multi-process mesh, two-stage under a
+    hierarchical topology). Integer sums associate, so the sharded
+    accumulation is BIT-IDENTICAL to the single-device scan for any
+    mesh size — the phase-1 ceiling removal rides on the same parity
+    argument as the pass-A kernels (PARITY row 43)."""
+    from pipelinedp_tpu.parallel import sharded as psh
+
+    axis = mesh.axis_names[0]
+    topo = psh.topology_of(mesh)
+    multiproc = mesh.is_multi_process
+
+    def local_fn(buckets):
+        local = _sketch_chunk(buckets, width, backend)
+        return psh.combine_shards(local, axis, 1, multiproc, topo=topo)
+
+    row_shard = psh.PSpec(None, axis)
+    mapped = psh.shard_map(
+        local_fn, mesh=mesh, in_specs=(row_shard,),
+        out_specs=psh.PSpec() if multiproc else psh.PSpec(None, axis),
+        **{psh._CHECK_KW: False})
+    return mapped(buckets)
 
 
 def accumulate_chunk(total: np.ndarray, device_counts) -> None:

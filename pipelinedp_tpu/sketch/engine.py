@@ -174,13 +174,19 @@ def bound_pairs(pid_arr: np.ndarray, key_inv: np.ndarray,
 
 
 def _accumulate_stream(pair_buckets: np.ndarray, width: int,
-                       backend: str, chunk_rows: int, tr
+                       backend: str, chunk_rows: int, tr, mesh=None
                        ) -> Tuple[np.ndarray, int]:
     """Stream the bounded pairs' bucket ids through the ingest ring
     into the device sketch: the stager device_puts chunk b+1 while the
     dispatch thread runs chunk b's binner. Returns ([depth, width]
     int64 host counts, chunks). Exact for any chunking (integer sum).
-    """
+
+    With a multi-device ``mesh`` each chunk's row axis shards over the
+    devices and the binner runs through
+    ``sketch_device.sharded_sketch_chunk_program`` — the local exact-
+    integer sketches combine through the topology-aware exchange, so
+    the totals are bit-identical to the single-device stream and the
+    sketch phase no longer serializes on one chip."""
     from pipelinedp_tpu import ingest, obs
     from pipelinedp_tpu.resilience import faults
 
@@ -188,6 +194,14 @@ def _accumulate_stream(pair_buckets: np.ndarray, width: int,
     n = pair_buckets.shape[1]
     total = np.zeros((depth, width), np.int64)
     n_chunks = max(1, -(-n // chunk_rows))
+    n_dev = int(mesh.devices.size) if mesh is not None else 1
+    sharded = n_dev > 1
+    if sharded:
+        from pipelinedp_tpu.parallel import sharded as psh
+        row_sharding = psh.NamedSharding(
+            mesh, psh.PSpec(None, mesh.axis_names[0]))
+        obs.event("sketch.sharded", devices=n_dev,
+                  topology=psh.topology_of(mesh).mode)
 
     def gen_factory(cancelled):
         def gen():
@@ -196,8 +210,12 @@ def _accumulate_stream(pair_buckets: np.ndarray, width: int,
                 hi = min(n, lo + chunk_rows)
                 with tr.span("sketch.stage", cat="sketch", batch=b):
                     chunk = sketch_device.pad_chunk(
-                        np.ascontiguousarray(pair_buckets[:, lo:hi]))
-                    dev = jax.device_put(chunk)
+                        np.ascontiguousarray(pair_buckets[:, lo:hi]),
+                        n_shards=n_dev)
+                    if sharded:
+                        dev = psh.put_global(chunk, row_sharding)
+                    else:
+                        dev = jax.device_put(chunk)
                 yield b, dev
         return gen()
 
@@ -206,8 +224,18 @@ def _accumulate_stream(pair_buckets: np.ndarray, width: int,
             faults.check_sketch_chunk(b)
             with tr.span("sketch.accumulate", cat="sketch", batch=b):
                 with obs.device_annotation("pdp.sketch_chunk"):
-                    out = sketch_device.sketch_chunk_program(
-                        dev, width=width, backend=backend)
+                    if sharded:
+                        out = sketch_device.sharded_sketch_chunk_program(
+                            width, backend, mesh, dev)
+                        if mesh.is_multi_process:
+                            # Replicated output: every device holds the
+                            # full [depth, width] sketch — read this
+                            # process's copy (the global view is not
+                            # host-addressable across processes).
+                            out = out.addressable_shards[0].data
+                    else:
+                        out = sketch_device.sketch_chunk_program(
+                            dev, width=width, backend=backend)
                 sketch_device.accumulate_chunk(total, out)
     return total, n_chunks
 
@@ -322,7 +350,8 @@ class LazySketchFirstResult:
             pair_buckets = np.ascontiguousarray(
                 buckets_of_key[:, kept_keys])
         counts, n_chunks = _accumulate_stream(
-            pair_buckets, width, backend, sp.chunk_rows, tr)
+            pair_buckets, width, backend, sp.chunk_rows, tr,
+            mesh=self._mesh)
 
         with tr.span("sketch.select", cat="sketch"):
             # Phase 1's own books: a dedicated accountant whose

@@ -178,14 +178,16 @@ def _tree_consts():
     return qt.tree_constants()  # (b, height, n_mid, bucket_w == span)
 
 
-def _combine_shards(x, axis, dim, multiproc):
+def _combine_shards(x, axis, dim, multiproc, topo=None):
     """Delegates to :func:`parallel.sharded.combine_shards` — the ONE
     cross-shard exchange policy: owner-block ``psum_scatter`` along
     ``dim`` on a single-controller mesh; replicating ``psum`` on a
     multi-process mesh (another process's owner block is not
-    host-addressable)."""
+    host-addressable). ``topo`` (``parallel.sharded.topology_of`` of
+    the kernel's mesh) steers the hierarchical two-stage exchange and
+    the ici/dcn byte accounting."""
     from pipelinedp_tpu.parallel import sharded as psh
-    return psh.combine_shards(x, axis, dim, multiproc)
+    return psh.combine_shards(x, axis, dim, multiproc, topo=topo)
 
 
 def _chunk_body(config, num_partitions, planes, values, n_valid, key,
@@ -416,9 +418,10 @@ def _sharded_partials_kernel(config, num_partitions, mesh, planes, values,
     axis = mesh.axis_names[0]
     has_vec = "VECTOR_SUM" in config.metrics
     multiproc = mesh.is_multi_process
+    topo = psh.topology_of(mesh)
 
     def _combine(x, dim):
-        return _combine_shards(x, axis, dim, multiproc)
+        return _combine_shards(x, axis, dim, multiproc, topo=topo)
 
     def local_fn(planes, values, n_valid, key):
         # lint: disable=rng-purity(per-shard bound key: fold of the shard index)
@@ -474,6 +477,7 @@ def _sharded_pct_sub_kernel(config, num_partitions, mesh, planes, values,
     _, _, _, span = _tree_consts()
     multiproc = mesh.is_multi_process  # see _sharded_partials_kernel
     blocked = n_block < num_partitions
+    topo = psh.topology_of(mesh)
 
     def local_fn(planes, values, n_valid, key, sub_start, p_offset):
         # lint: disable=rng-purity(per-shard bound key: fold of the shard index)
@@ -484,7 +488,8 @@ def _sharded_pct_sub_kernel(config, num_partitions, mesh, planes, values,
         qpk, leaf, kept = qrows
         sub = je._subtree_counts(qpk, leaf, kept, sub_start, n_block,
                                  span, p_offset=p_offset)
-        return _combine_shards(sub, axis, 0, multiproc or blocked)
+        return _combine_shards(sub, axis, 0, multiproc or blocked,
+                               topo=topo)
 
     shard, repl = psh.PSpec(axis), psh.PSpec()
     mapped = psh.shard_map(
@@ -513,6 +518,7 @@ def _sharded_pct_multi_sub_kernel(config, num_partitions, mesh, planes,
     from pipelinedp_tpu.parallel import sharded as psh
     axis = mesh.axis_names[0]
     _, _, _, span = _tree_consts()
+    topo = psh.topology_of(mesh)
 
     def local_fn(planes, values, n_valid, key, sub_starts, p_offsets):
         # lint: disable=rng-purity(per-shard bound key: fold of the shard index)
@@ -524,7 +530,7 @@ def _sharded_pct_multi_sub_kernel(config, num_partitions, mesh, planes,
         sub = je._subtree_counts_multi(qpk, leaf, kept, sub_starts,
                                        p_offsets, n_block, span,
                                        kernel_backend=kernel_backend)
-        return psh.combine_shards(sub, axis, 0, True)
+        return psh.combine_shards(sub, axis, 0, True, topo=topo)
 
     shard, repl = psh.PSpec(axis), psh.PSpec()
     mapped = psh.shard_map(

@@ -125,7 +125,14 @@ def main() -> None:
         acc.compute_budgets()
         return dict(res)
 
+    from pipelinedp_tpu import obs
+    from pipelinedp_tpu.parallel import sharded as psh
+
+    obs.reset()
     sharded = run(JaxBackend(mesh=mesh, rng_seed=11))
+    # The comms meter records at trace time, so the counters must be
+    # read off the FIRST (cold) dispatch of each topology's programs.
+    flat_comms = dict(obs.ledger().snapshot()["counters"])
     ds.invalidate_cache()
     local = run(JaxBackend(rng_seed=11))
 
@@ -137,6 +144,37 @@ def main() -> None:
         assert abs(sharded[k].count - m.sum()) < 1.0
         assert abs(sharded[k].sum - vals[m].sum()) < 1.0
         assert abs(sharded[k].count - local[k].count) < 1e-6
+
+    # HIER topology leg: the process boundary is a REAL host boundary
+    # here (process_index grouping, nothing simulated), so the two-axis
+    # mesh interleaves devices across the two processes and the
+    # two-stage exchange's DCN stage rides actual gloo collectives.
+    # The release must be BIT-IDENTICAL to the flat run — float for
+    # float, same kept set — while the estimated cross-host bytes drop.
+    os.environ["PIPELINEDP_TPU_MESH_TOPOLOGY"] = "hier"
+    try:
+        hier_mesh = make_mesh()
+    finally:
+        del os.environ["PIPELINEDP_TPU_MESH_TOPOLOGY"]
+    topo = psh.topology_of(hier_mesh)
+    assert topo.hierarchical and not topo.simulated, topo
+    assert (topo.n_hosts, topo.per_host) == (n_proc, 4), topo
+    obs.reset()
+    ds.invalidate_cache()
+    hier = run(JaxBackend(mesh=hier_mesh, rng_seed=11))
+    hier_comms = dict(obs.ledger().snapshot()["counters"])
+    assert set(hier) == set(sharded), (
+        f"hier kept set differs: {sorted(set(hier) ^ set(sharded))}")
+    for k in sharded:
+        assert sharded[k] == hier[k], (k, sharded[k], hier[k])
+    flat_dcn = flat_comms.get("comms.dcn_bytes", 0)
+    hier_dcn = hier_comms.get("comms.dcn_bytes", 0)
+    hier_ici = hier_comms.get("comms.ici_bytes", 0)
+    assert flat_dcn > 0, flat_comms
+    assert hier_dcn > 0 and hier_ici > 0, hier_comms
+    assert hier_dcn < flat_dcn, (hier_dcn, flat_dcn)
+    print(f"proc {proc_id}: comms dcn_flat={flat_dcn} "
+          f"dcn_hier={hier_dcn} ici_hier={hier_ici}", flush=True)
 
     # STREAMING over the cross-process mesh: force tiny per-device
     # chunks so the same dataset streams through >= 3 sharded chunks

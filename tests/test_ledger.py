@@ -611,6 +611,44 @@ class TestBenchCompareAcceptance:
         assert reg2["regressed"] == [
             "utility_megasweep_configs_per_sec"]
 
+    def test_mesh_topology_mismatch_refuses_gate(self, monkeypatch):
+        """A hier-topology rate never gates against a flat baseline:
+        the two-stage exchange is a different collective schedule (its
+        throughput is a property of the topology, not a regression),
+        so only matching topologies compare — the mismatch is
+        recorded, counted and named in the verdict line. Records
+        predating the knob carry no ``mesh_topology`` field and read
+        as \"flat\" on both sides, so historical baselines keep
+        gating unchanged."""
+        bench = _import_bench(monkeypatch)
+        bench.reset_run_state()
+        env = bench.env_fingerprint()
+        store = obs_store.LedgerStore(obs_store.ledger_dir())
+        store.append("m", {"record": {
+            "metric": "m", "value": 1000, "unit": "rows/s"}}, env=env)
+        bench.reset_run_state()
+        reg = bench.compare_to_baseline(records=[
+            {"metric": "m", "value": 500, "unit": "rows/s",
+             "plan_source": "default", "kernel_backend": "xla",
+             "mesh_topology": "hier"}])
+        rate = reg["rates"][0]
+        assert rate.get("mesh_topology_mismatch") is True
+        assert rate["baseline_mesh_topology"] == "flat"
+        assert reg["regressed"] == []
+        assert reg["mesh_topology_mismatches"] == 1
+        assert "mesh-topology mismatch" in \
+            bench.compare_verdict_line(reg)
+        events = [e for e in obs.ledger().snapshot()["events"]
+                  if e["name"] == "bench.compare_mesh_topology_mismatch"]
+        assert events and events[0]["metric"] == "m"
+        # Absent field on the current record reads "flat" too — the
+        # pre-knob record shape still gates (and still regresses).
+        reg2 = bench.compare_to_baseline(records=[
+            {"metric": "m", "value": 500, "unit": "rows/s",
+             "plan_source": "default", "kernel_backend": "xla"}])
+        assert reg2["rates"][0].get("regressed") is True
+        assert reg2["regressed"] == ["m"]
+
 
 class TestNoAdHocArtifactWrites:
     """AST-precise twin of ``make noartifacts``: ``json.dump(`` file

@@ -2,11 +2,11 @@
 
 Covers, per the PR-13 acceptance criteria:
 
-* one bad-fixture + one clean-fixture per rule (14 rules x 2) — the
+* one bad-fixture + one clean-fixture per rule (16 rules x 2) — the
   bad fixture proves the rule FIRES, the clean one proves the blessed
   location/shape passes;
 * the registry meta-test: every legacy Makefile grep lint name is
-  owned by a rule, the five born-AST analyses exist, and every
+  owned by a rule, the born-AST analyses exist, and every
   registered rule has a fixture pair here;
 * the seeded regressions from the issue: a ``time.sleep`` "in"
   ``streaming.py``, an ``atomic_write_json`` inside a
@@ -37,7 +37,7 @@ LEGACY_MAKE_LINTS = {"nosleep", "nofoldin", "nostager", "noperf",
                      "noserve"}
 NEW_ANALYSES = {"rng-purity", "blocking-under-lock", "jit-staticness",
                 "fusion-masking", "sketch-confinement",
-                "socket-confinement"}
+                "socket-confinement", "collective-confinement"}
 
 
 def findings_for(rule_id, source, rel):
@@ -236,6 +236,25 @@ FIXTURES = {
                   "    with urllib.request.urlopen(url) as r:\n"
                   "        return r.read()\n",
                   "pipelinedp_tpu/serve/service.py"),
+    },
+    "collective-confinement": {
+        # A raw collective outside parallel/sharded.py: invisible to
+        # the mesh_topology knob, the ici/dcn byte meter and the
+        # hier-vs-flat parity contract.
+        "bad": ("import jax\n\n"
+                "def combine(x, axis):\n"
+                "    return jax.lax.psum_scatter(\n"
+                "        x, axis, scatter_dimension=0, tiled=True)\n",
+                "pipelinedp_tpu/streaming.py"),
+        # The one blessed seam: sharded.py's exchange helpers own the
+        # raw jax.lax calls.
+        "clean": ("import jax\n\n"
+                  "def combine_shards(x, axis, dim, replicate):\n"
+                  "    if replicate:\n"
+                  "        return jax.lax.psum(x, axis)\n"
+                  "    return jax.lax.psum_scatter(\n"
+                  "        x, axis, scatter_dimension=dim, tiled=True)\n",
+                  "pipelinedp_tpu/parallel/sharded.py"),
     },
     "jit-staticness": {
         # PR 9's shape-blind knob-read bug class: ambient reads frozen

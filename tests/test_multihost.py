@@ -125,6 +125,10 @@ def test_two_process_global_mesh_fused_aggregation(tmp_path):
     assert not failed, joined[-4000:]
     for i, out in enumerate(outs):
         assert f"proc {i}: OK" in out, joined[-4000:]
+        # The hier-topology leg ran and measured the byte asymmetry:
+        # the worker asserts dcn_bytes(hier) < dcn_bytes(flat) across
+        # the real process boundary before printing this line.
+        assert f"proc {i}: comms dcn_flat=" in out, joined[-4000:]
 
 
 def test_elastic_reshard_resume_parity_across_process_loss(tmp_path):

@@ -78,6 +78,7 @@ HARDCODED_DEFAULTS = {
     "sketch_depth": 2,
     "sketch_candidate_cap": 4096,
     "sketch_backend": "matmul",
+    "mesh_topology": "flat",
     "select_units_cap": int(np.iinfo(np.int32).max),
     "tree_rows_cap": int(np.iinfo(np.int32).max),
 }
